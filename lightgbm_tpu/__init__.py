@@ -1,0 +1,41 @@
+"""LightGBM-TPU: a TPU-native gradient boosting framework.
+
+A from-scratch re-design of LightGBM (reference: Crissman/LightGBM v2.3.2)
+for TPU hardware: the binned feature matrix lives in HBM, per-leaf
+grad/hess histograms and the split-gain scan are fused XLA programs on the
+MXU/VPU, and the distributed tree learners run over `jax.lax.psum`-style
+collectives on the ICI mesh instead of sockets/MPI.
+
+Public API mirrors the reference Python package (lightgbm):
+Dataset, Booster, train, cv, sklearn-style estimators, callbacks, plotting.
+"""
+
+from .basic import Booster
+from .callback import (EarlyStopException, early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .dataset import Dataset
+from .engine import CVBooster, cv, train
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "Config", "train", "cv", "CVBooster",
+    "early_stopping", "print_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+]
+
+try:  # sklearn API is optional at import time
+    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,  # noqa: F401
+                          LGBMRegressor)
+    __all__ += ["LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from .plotting import (plot_importance, plot_metric,  # noqa: F401
+                           plot_split_value_histogram, plot_tree)
+    __all__ += ["plot_importance", "plot_metric", "plot_tree",
+                "plot_split_value_histogram"]
+except ImportError:  # pragma: no cover
+    pass

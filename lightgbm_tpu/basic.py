@@ -1,0 +1,467 @@
+"""Booster: the user-facing model handle.
+
+reference: python-package/lightgbm/basic.py:1704 (class Booster) — but where
+the reference Booster is a ctypes shim over the C API
+(src/c_api.cpp:100 Booster wrapper), this one directly owns the boosting
+object; there is no process boundary to cross.  Method surface mirrors the
+reference Python package.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from .binning import BinType, MissingType
+from .boosting import create_boosting
+from .config import Config
+from .dataset import Dataset
+from .metrics import create_metric
+from .model_text import load_model_from_string, save_model_to_string
+from .objectives import create_objective
+from .tree import HostTree
+from .utils.log import log_info, set_verbosity
+
+
+class Booster:
+    def __init__(self, params: Optional[dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 silent: bool = False):
+        self.params = dict(params or {})
+        self.config = Config.from_params(self.params)
+        set_verbosity(-1 if silent else self.config.verbosity)
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._loaded: Optional[dict] = None
+        self.boosting = None
+        self.train_set: Optional[Dataset] = None
+        self.name_valid_sets: List[str] = []
+
+        if train_set is not None:
+            self._init_train(train_set)
+        elif model_file is not None:
+            with open(model_file) as fh:
+                self._init_from_string(fh.read())
+        elif model_str is not None:
+            self._init_from_string(model_str)
+        else:
+            raise ValueError("need train_set, model_file, or model_str")
+
+    # -------------------------------------------------------------- training
+
+    def _init_train(self, train_set: Dataset) -> None:
+        ds_params = self.config.to_dataset_params()
+        merged = dict(ds_params)
+        merged.update(train_set.params)
+        train_set.params = merged
+        train_set.construct()
+        self.train_set = train_set
+        self.objective = create_objective(self.config)
+        self.boosting = create_boosting(self.config, train_set, self.objective)
+        # resolve metrics
+        names = self.config.metric or self.config.default_metric()
+        self._metric_names = [m for m in names if m != "none"]
+        train_metrics = []
+        for m in self._metric_names:
+            mt = create_metric(m, self.config)
+            if mt is not None:
+                mt.init(train_set.metadata, train_set.num_data)
+                train_metrics.append(mt)
+        self.boosting.set_metrics(train_metrics, [])
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if data.reference is None:
+            data.reference = self.train_set
+        data.construct()
+        self.boosting.add_valid(data, name)
+        self.name_valid_sets.append(name)
+        ms = []
+        for m in self._metric_names:
+            mt = create_metric(m, self.config)
+            if mt is not None:
+                mt.init(data.metadata, data.num_data)
+                ms.append(mt)
+        self.boosting.valid_metrics.append(ms)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration. Returns True if stopped (no more splits).
+        reference: basic.py:2089 Booster.update."""
+        if fobj is not None:
+            K = self.boosting.num_tree_per_iteration
+            score = np.asarray(self.boosting.train_score)
+            s = score if K > 1 else score[0]
+            grad, hess = fobj(s if K > 1 else s, self.train_set)
+            return self.boosting.train_one_iter(np.asarray(grad), np.asarray(hess))
+        return self.boosting.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self.boosting.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self.boosting.current_iteration() if self.boosting else \
+            len(self._loaded["models"]) // self._loaded["num_tree_per_iteration"]
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+    def reset_parameter(self, params: dict) -> "Booster":
+        self.params.update(params)
+        self.config.update(params)
+        if self.boosting is not None:
+            self.boosting.shrinkage_rate = self.config.learning_rate
+            self.boosting._build_jit_fns()
+        return self
+
+    # ------------------------------------------------------------------ eval
+
+    def eval_train(self, feval=None):
+        out = [("training", n, v, h) for (d, n, v, h) in self.boosting.eval_train()]
+        return out + self._custom_eval(feval, "training", self.boosting.train_score,
+                                       self.train_set)
+
+    def eval_valid(self, feval=None):
+        out = list(self.boosting.eval_valid())
+        if feval is not None:
+            for i, name in enumerate(self.boosting.valid_names):
+                out += self._custom_eval(feval, name, self.boosting.valid_scores[i],
+                                         self.boosting.valid_sets[i])
+        return out
+
+    def _custom_eval(self, feval, name, score, dataset):
+        if feval is None:
+            return []
+        s = np.asarray(score)
+        if self.boosting.num_tree_per_iteration == 1:
+            s = s[0]
+        ret = feval(s, dataset)
+        if isinstance(ret, tuple):
+            ret = [ret]
+        return [(name, mn, mv, hib) for (mn, mv, hib) in ret]
+
+    # ------------------------------------------------------------- inference
+
+    @property
+    def models(self) -> List[HostTree]:
+        return self.boosting.models if self.boosting is not None else self._loaded["models"]
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        return (self.boosting.num_tree_per_iteration if self.boosting is not None
+                else self._loaded["num_tree_per_iteration"])
+
+    @property
+    def num_class(self) -> int:
+        return self.config.num_class if self.boosting is not None \
+            else self._loaded["num_class"]
+
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, start_iteration: int = 0,
+                **kwargs) -> np.ndarray:
+        """reference: basic.py:2281 Booster.predict / _InnerPredictor."""
+        if hasattr(data, "values"):
+            data = data.values
+        if hasattr(data, "toarray"):
+            data = data.toarray()
+        X = np.ascontiguousarray(np.asarray(data, np.float64))
+        if X.ndim == 1:
+            X = X[None, :]
+        K = self.num_tree_per_iteration
+        models = self.models
+        n_total_iter = len(models) // max(K, 1)
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = (self.best_iteration + 1
+                             if self.best_iteration > 0 else n_total_iter)
+        stop_iter = min(start_iteration + num_iteration, n_total_iter)
+
+        if pred_leaf:
+            out = np.zeros((X.shape[0], (stop_iter - start_iteration) * K), np.int32)
+            for it in range(start_iteration, stop_iter):
+                for k in range(K):
+                    out[:, (it - start_iteration) * K + k] = \
+                        models[it * K + k].predict_leaf_np(X)
+            return out
+        if pred_contrib:
+            F = self.num_features()
+            out = np.zeros((X.shape[0], K, F + 1), np.float64)
+            for it in range(start_iteration, stop_iter):
+                for k in range(K):
+                    out[:, k, :] += models[it * K + k].predict_contrib_np(X, F)
+            return out.reshape(X.shape[0], -1) if K > 1 else out[:, 0, :]
+
+        raw = np.zeros((K, X.shape[0]), np.float64)
+        for it in range(start_iteration, stop_iter):
+            for k in range(K):
+                raw[k] += models[it * K + k].predict_np(X)
+        if self.average_output and stop_iter > start_iteration:
+            raw /= (stop_iter - start_iteration)
+        if raw_score:
+            return raw[0] if K == 1 else raw.T
+        conv = self._convert_output(raw)
+        return conv[0] if (K == 1 and conv.shape[0] == 1) else conv.T
+
+    def _convert_output(self, raw: np.ndarray) -> np.ndarray:
+        obj = self.objective_name.split(" ")[0] if self.objective_name else ""
+        if obj == "binary":
+            sig = self._objective_param("sigmoid", 1.0)
+            return 1.0 / (1.0 + np.exp(-sig * raw))
+        if obj in ("multiclass", "softmax"):
+            e = np.exp(raw - raw.max(axis=0, keepdims=True))
+            return e / e.sum(axis=0, keepdims=True)
+        if obj in ("multiclassova", "ova"):
+            sig = self._objective_param("sigmoid", 1.0)
+            return 1.0 / (1.0 + np.exp(-sig * raw))
+        if obj in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        if obj in ("cross_entropy_lambda", "xentlambda"):
+            return np.log1p(np.exp(raw))
+        if obj in ("cross_entropy", "xentropy"):
+            return 1.0 / (1.0 + np.exp(-raw))
+        if obj == "regression" and self._objective_param_flag("sqrt"):
+            return np.sign(raw) * raw * raw
+        return raw
+
+    def _objective_param(self, key: str, default: float) -> float:
+        for tok in (self.objective_name or "").split(" ")[1:]:
+            if tok.startswith(f"{key}:"):
+                return float(tok.split(":", 1)[1])
+        if self.boosting is not None:
+            return float(getattr(self.config, key, default))
+        return default
+
+    def _objective_param_flag(self, key: str) -> bool:
+        return key in (self.objective_name or "").split(" ")[1:]
+
+    def num_features(self) -> int:
+        if self.boosting is not None:
+            return self.train_set.num_total_features
+        return self._loaded["max_feature_idx"] + 1
+
+    def num_data(self) -> int:
+        return self.train_set.num_data if self.train_set else 0
+
+    def feature_name(self) -> List[str]:
+        if self.boosting is not None:
+            return self.train_set.feature_names
+        return self._loaded["feature_names"]
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        F = self.num_features()
+        imp = np.zeros(F, np.float64)
+        K = self.num_tree_per_iteration
+        models = self.models
+        stop = len(models) if not iteration else iteration * K
+        for ht in models[:stop]:
+            ns = ht.num_leaves - 1
+            for s in range(ns):
+                f = int(ht.split_feature[s])
+                if importance_type == "split":
+                    imp[f] += 1
+                else:
+                    imp[f] += max(float(ht.split_gain[s]), 0.0)
+        if importance_type == "split":
+            return imp.astype(np.int64)
+        return imp
+
+    def trees_to_dataframe(self):
+        """reference: basic.py:1906."""
+        import pandas as pd
+        rows = []
+        fnames = self.feature_name()
+        for ti, t in enumerate(self.models):
+            for s in range(t.num_leaves - 1):
+                rows.append({
+                    "tree_index": ti, "node_index": f"{ti}-S{s}",
+                    "split_feature": fnames[int(t.split_feature[s])],
+                    "threshold": float(t.threshold[s]),
+                    "split_gain": float(t.split_gain[s]),
+                    "internal_value": float(t.internal_value[s]),
+                    "internal_count": int(t.internal_count[s]),
+                    "decision_type": "<=",
+                })
+            for l in range(t.num_leaves):
+                rows.append({
+                    "tree_index": ti, "node_index": f"{ti}-L{l}",
+                    "split_feature": None, "threshold": None, "split_gain": None,
+                    "internal_value": float(t.leaf_value[l]),
+                    "internal_count": int(t.leaf_count[l]) if len(t.leaf_count) else 0,
+                    "decision_type": None,
+                })
+        return pd.DataFrame(rows)
+
+    def get_split_value_histogram(self, feature, bins=None):
+        """reference: basic.py get_split_value_histogram."""
+        fnames = self.feature_name()
+        fidx = fnames.index(feature) if isinstance(feature, str) else int(feature)
+        vals = []
+        for t in self.models:
+            for s in range(t.num_leaves - 1):
+                if int(t.split_feature[s]) == fidx and \
+                        not (int(t.decision_type[s]) & 1):
+                    vals.append(float(t.threshold[s]))
+        vals = np.asarray(vals)
+        if bins is None:
+            bins = max(min(len(vals), 10), 1) if len(vals) else 1
+        hist, edges = np.histogram(vals, bins=bins) if len(vals) else (np.zeros(1, int), np.array([0.0, 1.0]))
+        return hist, edges
+
+    # -------------------------------------------------------------- model IO
+
+    @property
+    def sub_model_name(self) -> str:
+        if self.boosting is not None:
+            return {"gbdt": "tree", "dart": "tree", "goss": "tree", "rf": "tree"}.get(
+                self.config.boosting, "tree")
+        return self._loaded["sub_model_name"]
+
+    @property
+    def average_output(self) -> bool:
+        if self.boosting is not None:
+            return self.config.boosting in ("rf", "random_forest")
+        return self._loaded["average_output"]
+
+    @property
+    def objective_name(self) -> str:
+        if self.boosting is not None and self.objective is not None:
+            return self._objective_to_string()
+        if self._loaded is not None:
+            return self._loaded["objective_name"]
+        return ""
+
+    def _objective_to_string(self) -> str:
+        c = self.config
+        name = self.objective.name
+        if name == "binary":
+            return f"binary sigmoid:{c.sigmoid:g}"
+        if name in ("multiclass", "multiclassova"):
+            s = f"{name} num_class:{c.num_class}"
+            if name == "multiclassova":
+                s += f" sigmoid:{c.sigmoid:g}"
+            return s
+        if name == "lambdarank":
+            return "lambdarank"
+        if name == "regression" and c.reg_sqrt:
+            return "regression sqrt"
+        return name
+
+    @property
+    def label_index(self) -> int:
+        return 0
+
+    @property
+    def max_feature_idx(self) -> int:
+        return self.num_features() - 1
+
+    @property
+    def feature_names(self) -> List[str]:
+        return self.feature_name()
+
+    @property
+    def feature_infos(self) -> List[str]:
+        """reference format: [min:max] per numeric feature, ':'-joined cats."""
+        if self.boosting is None:
+            return self._loaded["feature_infos"]
+        out = []
+        ds = self.train_set
+        for f in range(ds.num_total_features):
+            m = ds.bin_mappers[f] if f < len(ds.bin_mappers) else None
+            if m is None or m.is_trivial:
+                out.append("none")
+            elif m.bin_type == BinType.CATEGORICAL:
+                out.append(":".join(str(c) for c in m.bin_2_categorical))
+            else:
+                out.append(f"[{m.min_val:g}:{m.max_val:g}]")
+        return out
+
+    @property
+    def params_str(self) -> str:
+        return "\n".join(f"[{k}: {v}]" for k, v in sorted(self.params.items()))
+
+    def feature_importance_int(self):
+        imp = self.feature_importance("split")
+        names = self.feature_name()
+        return [(names[i], int(imp[i])) for i in range(len(imp))]
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        return save_model_to_string(self)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def _init_from_string(self, s: str) -> None:
+        self._loaded = load_model_from_string(s)
+        self.objective = None
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> dict:
+        """JSON model dump (reference: gbdt_model_text.cpp:21 DumpModel)."""
+        models = self.models
+
+        def node_to_dict(t: HostTree, node: int) -> dict:
+            if node < 0:
+                li = ~node
+                return {
+                    "leaf_index": int(li),
+                    "leaf_value": float(t.leaf_value[li]),
+                    "leaf_weight": float(t.leaf_weight[li]) if len(t.leaf_weight) > li else 0.0,
+                    "leaf_count": int(t.leaf_count[li]) if len(t.leaf_count) > li else 0,
+                }
+            dt = int(t.decision_type[node])
+            return {
+                "split_index": int(node),
+                "split_feature": int(t.split_feature[node]),
+                "split_gain": float(t.split_gain[node]),
+                "threshold": float(t.threshold[node]),
+                "decision_type": "==" if dt & 1 else "<=",
+                "default_left": bool(dt & 2),
+                "missing_type": ["None", "Zero", "NaN"][(dt >> 2) & 3],
+                "internal_value": float(t.internal_value[node]),
+                "internal_weight": float(t.internal_weight[node]),
+                "internal_count": int(t.internal_count[node]),
+                "left_child": node_to_dict(t, int(t.left_child[node])),
+                "right_child": node_to_dict(t, int(t.right_child[node])),
+            }
+
+        return {
+            "name": self.sub_model_name,
+            "version": "v3",
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_index,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": self.objective_name,
+            "average_output": self.average_output,
+            "feature_names": self.feature_names,
+            "tree_info": [
+                {"tree_index": i, "num_leaves": t.num_leaves,
+                 "num_cat": t.num_cat, "shrinkage": t.shrinkage,
+                 "tree_structure": node_to_dict(t, 0 if t.num_leaves > 1 else -1)}
+                for i, t in enumerate(models)
+            ],
+        }
+
+    def __copy__(self):
+        return self
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self
+
+    def set_network(self, *args, **kwargs) -> "Booster":
+        return self

@@ -1,0 +1,26 @@
+"""Boosting algorithms.
+
+reference: src/boosting/boosting.cpp CreateBoosting factory
+(include/LightGBM/boosting.h:310): gbdt / dart / goss / rf.
+"""
+
+from __future__ import annotations
+
+from ..config import Config
+from .gbdt import GBDT
+from .goss import GOSS
+
+
+def create_boosting(config: Config, train_set, objective):
+    t = config.boosting
+    if t == "gbdt" or t == "gbrt":
+        return GBDT(config, train_set, objective)
+    if t == "goss":
+        return GOSS(config, train_set, objective)
+    if t == "dart":
+        from .dart import DART
+        return DART(config, train_set, objective)
+    if t in ("rf", "random_forest"):
+        from .rf import RF
+        return RF(config, train_set, objective)
+    raise ValueError(f"unknown boosting type {t!r}")

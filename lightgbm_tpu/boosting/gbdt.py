@@ -1,0 +1,343 @@
+"""GBDT training loop.
+
+reference: src/boosting/gbdt.cpp — GBDT::Init (:42), Train (:246),
+TrainOneIter (:338), Boosting (:152), Bagging (:163), BoostFromAverage
+(:302), UpdateScore (:459).
+
+TPU re-design:
+- the whole per-iteration step (gradients -> bagging mask -> K tree grows ->
+  leaf renewal -> shrinkage -> score update) is ONE jitted device program;
+  the host only fetches the finished (tiny) tree arrays per iteration.
+- bagging and GOSS are weight masks, not index subsets: shapes stay static,
+  nothing is compacted (replaces is_use_subset_/bag_data_indices_ machinery,
+  gbdt.cpp:163-244); excluded rows keep leaf routing so out-of-bag score
+  update (gbdt.cpp:459-478) is free.
+- scores live on device [K, n] f32 for train and each valid set.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset, FeatureMeta
+from ..grower import GrowerConfig, TreeArrays, grow_tree, predict_tree_binned
+from ..objectives import ObjectiveFunction
+from ..ops.renew import leaf_percentile
+from ..tree import HostTree, tree_to_host
+from ..utils.log import log_info, log_warning
+
+K_EPSILON = 1e-15
+
+
+class GBDT:
+    """reference: class GBDT (src/boosting/gbdt.h)."""
+
+    boosting_type = "gbdt"
+
+    def __init__(self, config: Config, train_set: Dataset,
+                 objective: Optional[ObjectiveFunction]):
+        self.config = config
+        self.train_set = train_set.construct()
+        self.objective = objective
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = (objective.num_model_per_iteration
+                                       if objective is not None else config.num_class)
+        self.iter = 0
+        self.models: List[HostTree] = []   # length = iter * K
+        self.shrinkage_rate = config.learning_rate
+
+        self.meta = self.train_set.feature_meta()
+        self.num_data = self.train_set.num_data
+        n, F = self.train_set.binned.shape
+        # padded bin axis: power-of-two-ish friendly size
+        self.num_bins = int(self.meta.max_num_bin)
+
+        self.binned = jnp.asarray(self.train_set.binned)
+        if objective is not None:
+            objective.init(self.train_set.metadata, self.num_data)
+
+        self.grower_cfg = GrowerConfig(
+            num_leaves=config.num_leaves,
+            max_depth=config.max_depth,
+            hp=config.split_hyperparams(),
+            hist_method=config.tpu_hist_method,
+            num_bins=self.num_bins,
+            learning_rate=config.learning_rate,
+        )
+
+        K = self.num_tree_per_iteration
+        self.train_score = jnp.zeros((K, n), jnp.float32)
+        self.init_scores = [0.0] * K
+        self._init_score_added = False
+        # user-provided init score (reference: score_updater has_init_score)
+        if self.train_set.metadata.init_score is not None:
+            isc = np.asarray(self.train_set.metadata.init_score, np.float32)
+            self.train_score = self.train_score + jnp.asarray(
+                isc.reshape(-1, n) if isc.size == K * n else
+                np.broadcast_to(isc.reshape(1, n), (K, n)))
+            self._init_score_added = True
+
+        self.valid_sets: List[Dataset] = []
+        self.valid_names: List[str] = []
+        self.valid_binned: List[jax.Array] = []
+        self.valid_scores: List[jax.Array] = []
+        self.train_metrics = []
+        self.valid_metrics: List[list] = []
+
+        self._rng = np.random.RandomState(config.bagging_seed)
+        self._goss_rng_key = jax.random.PRNGKey(config.bagging_seed)
+        self._build_jit_fns()
+
+    # ------------------------------------------------------------------ setup
+
+    def add_valid(self, valid_set: Dataset, name: str) -> None:
+        valid_set.construct()
+        self.valid_sets.append(valid_set)
+        self.valid_names.append(name)
+        self.valid_binned.append(jnp.asarray(valid_set.binned))
+        K = self.num_tree_per_iteration
+        vs = jnp.zeros((K, valid_set.num_data), jnp.float32)
+        if valid_set.metadata.init_score is not None:
+            isc = np.asarray(valid_set.metadata.init_score, np.float32)
+            nv = valid_set.num_data
+            vs = vs + jnp.asarray(isc.reshape(-1, nv) if isc.size == K * nv
+                                  else np.broadcast_to(isc.reshape(1, nv), (K, nv)))
+        self.valid_scores.append(vs)
+
+    def set_metrics(self, train_metrics, valid_metrics_per_set) -> None:
+        self.train_metrics = train_metrics
+        self.valid_metrics = valid_metrics_per_set
+
+    def _build_jit_fns(self) -> None:
+        K = self.num_tree_per_iteration
+        cfg = self.grower_cfg
+        obj = self.objective
+        lr = self.shrinkage_rate
+        renew_pct = obj.renew_percentile if obj is not None else None
+        weight = (jnp.asarray(self.train_set.metadata.weight)
+                  if self.train_set.metadata.weight is not None else None)
+        label = (jnp.asarray(self.train_set.metadata.label)
+                 if obj is not None and obj.renew_percentile is not None else None)
+
+        def one_iter(score, row_mask, grad, hess):
+            """grad/hess: [K, n].  Returns (new_score, stacked trees, leaf_ids)."""
+            trees = []
+            leaf_ids = []
+            new_score = score
+            for k in range(K):
+                tree, leaf_id = grow_tree(self.binned, grad[k], hess[k],
+                                          row_mask, self.meta, cfg)
+                if renew_pct is not None:
+                    residual = label - new_score[k]
+                    w = row_mask if weight is None else row_mask * weight
+                    pct = leaf_percentile(leaf_id, residual, w,
+                                          cfg.num_leaves, float(renew_pct))
+                    active = jnp.arange(cfg.num_leaves) < tree.num_leaves
+                    tree = tree._replace(
+                        leaf_value=jnp.where(active, pct, tree.leaf_value))
+                tree = tree._replace(
+                    leaf_value=tree.leaf_value * lr,
+                    internal_value=tree.internal_value * lr,
+                )
+                new_score = new_score.at[k].add(tree.leaf_value[leaf_id])
+                trees.append(tree)
+                leaf_ids.append(leaf_id)
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+            return new_score, stacked, jnp.stack(leaf_ids)
+
+        self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
+
+        def gradients_fn(score):
+            if obj is None:
+                raise RuntimeError("no objective: gradients must be provided")
+            s = score if K > 1 else score[0]
+            g, h = obj.get_gradients(s)
+            g = g.reshape(K, -1)
+            h = h.reshape(K, -1)
+            return g, h
+
+        self._gradients_fn = jax.jit(gradients_fn)
+
+        def valid_update(vscore, stacked_trees, binned):
+            for k in range(K):
+                tree_k = jax.tree_util.tree_map(lambda x: x[k], stacked_trees)
+                vscore = vscore.at[k].add(
+                    predict_tree_binned(tree_k, binned, self.meta))
+            return vscore
+
+        self._valid_update = jax.jit(valid_update, donate_argnums=(0,))
+
+    # --------------------------------------------------------------- training
+
+    def _bagging_mask(self, it: int) -> jax.Array:
+        """reference: GBDT::Bagging (gbdt.cpp:163-244) as a weight mask."""
+        c = self.config
+        n = self.num_data
+        if self.boosting_type == "goss":
+            raise RuntimeError("GOSS overrides _bagging_mask")
+        need = (c.bagging_freq > 0 and c.bagging_fraction < 1.0)
+        need_posneg = (c.pos_bagging_fraction < 1.0 or c.neg_bagging_fraction < 1.0)
+        if not (need or need_posneg):
+            return jnp.ones(n, jnp.float32)
+        if it % max(c.bagging_freq, 1) != 0 and self._cur_mask is not None:
+            return self._cur_mask
+        if need_posneg:
+            lbl = np.asarray(self.train_set.metadata.label) > 0
+            u = self._rng.rand(n)
+            keep = np.where(lbl, u < c.pos_bagging_fraction, u < c.neg_bagging_fraction)
+        else:
+            # exact count without replacement (matches reference semantics)
+            cnt = int(n * c.bagging_fraction)
+            idx = self._rng.choice(n, size=cnt, replace=False)
+            keep = np.zeros(n, bool)
+            keep[idx] = True
+        self._cur_mask = jnp.asarray(keep.astype(np.float32))
+        return self._cur_mask
+
+    _cur_mask = None
+
+    def _boost(self, score) -> Tuple[jax.Array, jax.Array]:
+        return self._gradients_fn(score)
+
+    def boost_from_average(self) -> None:
+        """reference: GBDT::BoostFromAverage (gbdt.cpp:313)."""
+        if self.iter > 0 or self.objective is None or self._init_score_added:
+            return
+        if not self.config.boost_from_average:
+            return
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            s = self.objective.boost_from_score(k)
+            if abs(s) > K_EPSILON:
+                self.init_scores[k] = s
+                self.train_score = self.train_score.at[k].add(s)
+                for i in range(len(self.valid_scores)):
+                    self.valid_scores[i] = self.valid_scores[i].at[k].add(s)
+                log_info(f"Start training from score {s:.6f}")
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration; returns True if training should STOP
+        (no more splittable leaves).  reference: GBDT::TrainOneIter."""
+        K = self.num_tree_per_iteration
+        n = self.num_data
+        self.boost_from_average()
+        if grad is None:
+            grad, hess = self._boost(self.train_score)
+        else:
+            grad = jnp.asarray(np.asarray(grad, np.float32).reshape(K, n))
+            hess = jnp.asarray(np.asarray(hess, np.float32).reshape(K, n))
+        mask = self._bagging_mask(self.iter)
+
+        self.train_score, stacked, leaf_ids = self._iter_fn(
+            self.train_score, mask, grad, hess)
+
+        # host copies (tiny), bias folding for the first iteration
+        new_models = []
+        should_continue = False
+        for k in range(K):
+            tree_k = jax.tree_util.tree_map(lambda x: np.asarray(x[k]), stacked)
+            ht = tree_to_host(tree_k, self.train_set, self.shrinkage_rate)
+            if ht.num_leaves > 1:
+                should_continue = True
+            if self.iter == 0 and abs(self.init_scores[k]) > K_EPSILON:
+                ht.add_bias(self.init_scores[k])
+            new_models.append(ht)
+        if not should_continue:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        self.models.extend(new_models)
+
+        for i in range(len(self.valid_scores)):
+            self.valid_scores[i] = self._valid_update(
+                self.valid_scores[i], stacked, self.valid_binned[i])
+        self.iter += 1
+        return False
+
+    def rollback_one_iter(self) -> None:
+        """reference: GBDT::RollbackOneIter (gbdt.cpp:422)."""
+        if self.iter <= 0:
+            return
+        K = self.num_tree_per_iteration
+        dropped = self.models[-K:]
+        del self.models[-K:]
+        # subtract the dropped trees' contributions
+        for k, ht in enumerate(dropped):
+            self.train_score = self.train_score.at[k].add(
+                -jnp.asarray(ht.predict_binned_np(self.train_set.binned)))
+        for i, vs in enumerate(self.valid_scores):
+            for k, ht in enumerate(dropped):
+                self.valid_scores[i] = self.valid_scores[i].at[k].add(
+                    -jnp.asarray(ht.predict_binned_np(self.valid_sets[i].binned)))
+        self.iter -= 1
+
+    # ------------------------------------------------------------------- eval
+
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        return self._eval("training", self.train_score, self.train_metrics,
+                          self.objective)
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for i, name in enumerate(self.valid_names):
+            out.extend(self._eval(name, self.valid_scores[i],
+                                  self.valid_metrics[i], self.objective))
+        return out
+
+    def _eval(self, dataname, score, metrics, objective):
+        score_np = np.asarray(score)
+        s = score_np if self.num_tree_per_iteration > 1 else score_np[0]
+        out = []
+        for m in metrics:
+            for (mname, val, hib) in m.eval(s, objective):
+                out.append((dataname, mname, val, hib))
+        return out
+
+    # -------------------------------------------------------------- inference
+
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
+                    start_iteration: int = 0) -> np.ndarray:
+        """Raw scores for a raw-feature matrix (host traversal)."""
+        K = self.num_tree_per_iteration
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        n = X.shape[0]
+        out = np.zeros((K, n), np.float64)
+        K_total = len(self.models) // K if K else 0
+        stop = K_total if num_iteration < 0 else min(start_iteration + num_iteration, K_total)
+        for it in range(start_iteration, stop):
+            for k in range(K):
+                out[k] += self.models[it * K + k].predict_np(X)
+        return out if K > 1 else out[0]
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def current_iteration(self) -> int:
+        return self.iter
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        """reference: GBDT::FeatureImportance (boosting.h:229)."""
+        F = self.train_set.num_total_features
+        imp = np.zeros(F, np.float64)
+        K = self.num_tree_per_iteration
+        stop = len(self.models) if iteration < 0 else iteration * K
+        for ht in self.models[:stop]:
+            for s in range(ht.num_leaves - 1):
+                f = ht.real_feature_index[s] if s < len(ht.real_feature_index) else -1
+                if f < 0:
+                    continue
+                if importance_type == "split":
+                    imp[f] += 1.0
+                else:
+                    imp[f] += max(ht.split_gain[s], 0.0)
+        return imp

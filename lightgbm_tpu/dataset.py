@@ -1,0 +1,422 @@
+"""Dataset: binned feature matrix + metadata, host construction, device views.
+
+TPU-native redesign of LightGBM's Dataset / DatasetLoader / Metadata
+(reference: include/LightGBM/dataset.h:41,333, src/io/dataset_loader.cpp:167,
+src/io/metadata.cpp).  The key inversion vs the reference: instead of
+per-feature-group Bin objects with sparse/dense variants and 4-bit packing,
+the binned matrix is ONE dense row-major uint8 (or uint16) array
+``[num_data, num_features]`` that is transferred once to HBM; histograms are
+then built on-device over the whole matrix (see ops/histogram.py).  Sparse
+inputs are densified at bin time — after binning, "sparse" just means the
+most-frequent bin repeats, which costs nothing on the MXU path.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .binning import BinMapper, BinType, MissingType
+
+_BINARY_MAGIC = b"lgbm_tpu.dataset.v1\n"
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values"):  # pandas
+        data = data.values
+    arr = np.asarray(data)
+    if arr.ndim != 2:
+        raise ValueError(f"data must be 2-D, got shape {arr.shape}")
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def _sample_indices(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
+    if num_data <= sample_cnt:
+        return np.arange(num_data)
+    rng = np.random.RandomState(seed)
+    return np.sort(rng.choice(num_data, size=sample_cnt, replace=False))
+
+
+@dataclass
+class Metadata:
+    """Labels / weights / query boundaries / init scores.
+
+    reference: include/LightGBM/dataset.h:41-249, src/io/metadata.cpp.
+    """
+
+    label: Optional[np.ndarray] = None
+    weight: Optional[np.ndarray] = None
+    query_boundaries: Optional[np.ndarray] = None  # int32 [num_queries + 1]
+    init_score: Optional[np.ndarray] = None
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        if group is None:
+            self.query_boundaries = None
+            return
+        g = np.asarray(group, dtype=np.int64)
+        self.query_boundaries = np.concatenate([[0], np.cumsum(g)]).astype(np.int32)
+
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+    def check(self, num_data: int) -> None:
+        if self.label is not None and len(self.label) != num_data:
+            raise ValueError(f"label length {len(self.label)} != num_data {num_data}")
+        if self.weight is not None and len(self.weight) != num_data:
+            raise ValueError("weight length mismatch")
+        if self.query_boundaries is not None and self.query_boundaries[-1] != num_data:
+            raise ValueError("sum of query group sizes != num_data")
+
+
+class Dataset:
+    """User-facing dataset; lazily constructed (binned) on first use.
+
+    Mirrors the Python-side semantics of the reference's ``lightgbm.Dataset``
+    (python-package/lightgbm/basic.py:730) with construction logic from
+    DatasetLoader (src/io/dataset_loader.cpp:527 ConstructFromSampleData).
+    """
+
+    def __init__(
+        self,
+        data,
+        label=None,
+        *,
+        reference: Optional["Dataset"] = None,
+        weight=None,
+        group=None,
+        init_score=None,
+        feature_name="auto",
+        categorical_feature="auto",
+        params: Optional[dict] = None,
+        free_raw_data: bool = True,
+    ):
+        self.params = dict(params or {})
+        self.raw_data = data
+        self.reference = reference
+        self.free_raw_data = free_raw_data
+        self.metadata = Metadata()
+        if label is not None:
+            self.metadata.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if weight is not None:
+            self.metadata.weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if group is not None:
+            self.metadata.set_group(group)
+        if init_score is not None:
+            self.metadata.init_score = np.asarray(init_score, dtype=np.float64)
+        self._feature_name_param = feature_name
+        self._categorical_feature_param = categorical_feature
+        # filled by construct():
+        self.constructed = False
+        self.bin_mappers: List[BinMapper] = []         # per ORIGINAL feature
+        self.used_features: List[int] = []             # original idx of non-trivial features
+        self.binned: Optional[np.ndarray] = None       # [n, F_used] uint8/uint16
+        self.feature_names: List[str] = []
+        self.num_data = 0
+        self.num_total_features = 0
+
+    # -- construction --------------------------------------------------------
+
+    def construct(self) -> "Dataset":
+        if self.constructed:
+            return self
+        if self.raw_data is None:
+            raise RuntimeError("cannot construct Dataset: raw data was freed")
+        data = self.raw_data
+        if isinstance(data, (str, os.PathLike)):
+            from .io_utils import load_text_dataset
+            data = load_text_dataset(str(data), self)
+        if _is_sparse(data):
+            raw = None
+            sp = data.tocsc()
+            self.num_data, self.num_total_features = sp.shape
+        else:
+            raw = _to_2d_float(data)
+            sp = None
+            self.num_data, self.num_total_features = raw.shape
+
+        p = self.params
+        max_bin = int(p.get("max_bin", 255))
+        min_data_in_bin = int(p.get("min_data_in_bin", 3))
+        min_data_in_leaf = int(p.get("min_data_in_leaf", 20))
+        sample_cnt = int(p.get("bin_construct_sample_cnt", 200000))
+        seed = int(p.get("data_random_seed", 1))
+        use_missing = bool(p.get("use_missing", True))
+        zero_as_missing = bool(p.get("zero_as_missing", False))
+        pre_filter = bool(p.get("feature_pre_filter", True))
+        forced_bounds = _load_forced_bins(p, self.num_total_features)
+
+        if self._feature_name_param == "auto" or self._feature_name_param is None:
+            if hasattr(self.raw_data, "columns"):
+                self.feature_names = [str(c) for c in self.raw_data.columns]
+            else:
+                self.feature_names = [f"Column_{i}" for i in range(self.num_total_features)]
+        else:
+            self.feature_names = list(self._feature_name_param)
+
+        categorical = self._resolve_categorical()
+
+        if self.reference is not None:
+            # validation set: reuse the reference's bin mappers
+            # (reference: DatasetLoader::LoadFromFileAlignWithOtherDataset,
+            # src/io/dataset_loader.cpp:229)
+            ref = self.reference.construct()
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.feature_names = ref.feature_names
+        else:
+            sample_idx = _sample_indices(self.num_data, sample_cnt, seed)
+            total_sample_cnt = len(sample_idx)
+            self.bin_mappers = []
+            for f in range(self.num_total_features):
+                col = _get_col(raw, sp, f, sample_idx)
+                # keep NaN and non-zero samples; zeros are implicit
+                keep = np.isnan(col) | (np.abs(col) > 1e-35)
+                vals = col[keep]
+                m = BinMapper()
+                btype = BinType.CATEGORICAL if f in categorical else BinType.NUMERICAL
+                m.find_bin(
+                    vals, total_sample_cnt, max_bin,
+                    min_data_in_bin=min_data_in_bin,
+                    min_split_data=min_data_in_leaf,
+                    pre_filter=pre_filter,
+                    bin_type=btype,
+                    use_missing=use_missing,
+                    zero_as_missing=zero_as_missing,
+                    forced_upper_bounds=forced_bounds.get(f, ()),
+                )
+                self.bin_mappers.append(m)
+            self.used_features = [f for f, m in enumerate(self.bin_mappers) if not m.is_trivial]
+
+        # second pass: bin every row
+        F = len(self.used_features)
+        max_nb = max((self.bin_mappers[f].num_bin for f in self.used_features), default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        self.binned = np.empty((self.num_data, F), dtype=dtype)
+        for j, f in enumerate(self.used_features):
+            col = _get_col(raw, sp, f, None)
+            self.binned[:, j] = self.bin_mappers[f].value_to_bin(col).astype(dtype)
+
+        self.metadata.check(self.num_data)
+        if self.metadata.label is None:
+            self.metadata.label = np.zeros(self.num_data, dtype=np.float32)
+        self.constructed = True
+        if self.free_raw_data:
+            self.raw_data = None
+        return self
+
+    def _resolve_categorical(self) -> set:
+        cf = self._categorical_feature_param
+        if cf == "auto" or cf is None:
+            cats = set()
+            if hasattr(self.raw_data, "dtypes"):  # pandas: category dtype columns
+                for i, dt in enumerate(self.raw_data.dtypes):
+                    if str(dt) == "category":
+                        cats.add(i)
+            # also honor categorical_feature in params (CLI-style)
+            pcf = self.params.get("categorical_feature") or self.params.get("categorical_column")
+            if pcf:
+                cats |= self._names_to_indices(pcf)
+            return cats
+        return self._names_to_indices(cf)
+
+    def _names_to_indices(self, spec) -> set:
+        if isinstance(spec, str):
+            spec = [s for s in spec.split(",") if s]
+        out = set()
+        for s in spec:
+            if isinstance(s, str) and not s.lstrip("-").isdigit():
+                if s in self.feature_names:
+                    out.add(self.feature_names.index(s))
+                else:
+                    raise ValueError(f"unknown categorical feature {s!r}")
+            else:
+                out.add(int(s))
+        return out
+
+    # -- accessors mirroring reference python API ----------------------------
+
+    def get_label(self):
+        return self.metadata.label
+
+    def set_label(self, label):
+        self.metadata.label = np.asarray(label, dtype=np.float32).reshape(-1)
+
+    def get_weight(self):
+        return self.metadata.weight
+
+    def set_weight(self, weight):
+        self.metadata.weight = None if weight is None else np.asarray(weight, np.float32).reshape(-1)
+
+    def set_group(self, group):
+        self.metadata.set_group(group)
+
+    def set_init_score(self, init_score):
+        self.metadata.init_score = None if init_score is None else np.asarray(init_score, np.float64)
+
+    def get_init_score(self):
+        return self.metadata.init_score
+
+    def num_features(self) -> int:
+        self.construct()
+        return len(self.used_features)
+
+    def get_feature_names(self) -> List[str]:
+        return self.feature_names
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        self.construct()
+        idx = np.asarray(used_indices, dtype=np.int64)
+        sub = Dataset.__new__(Dataset)
+        sub.params = dict(params or self.params)
+        sub.raw_data = None
+        sub.reference = self
+        sub.free_raw_data = True
+        sub.metadata = Metadata(
+            label=None if self.metadata.label is None else self.metadata.label[idx],
+            weight=None if self.metadata.weight is None else self.metadata.weight[idx],
+            init_score=None if self.metadata.init_score is None else
+            np.asarray(self.metadata.init_score).reshape(self.num_data, -1)[idx].reshape(-1),
+        )
+        sub._feature_name_param = self.feature_names
+        sub._categorical_feature_param = self._categorical_feature_param
+        sub.constructed = True
+        sub.bin_mappers = self.bin_mappers
+        sub.used_features = self.used_features
+        sub.binned = self.binned[idx]
+        sub.feature_names = self.feature_names
+        sub.num_data = len(idx)
+        sub.num_total_features = self.num_total_features
+        return sub
+
+    # -- binary serialization (reference: Dataset::SaveBinaryFile dataset.cpp:890)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        meta = {
+            "version": 1,
+            "num_data": int(self.num_data),
+            "num_total_features": int(self.num_total_features),
+            "used_features": list(map(int, self.used_features)),
+            "feature_names": self.feature_names,
+            "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+            "dtype": str(self.binned.dtype),
+            "has_label": self.metadata.label is not None,
+            "has_weight": self.metadata.weight is not None,
+            "has_group": self.metadata.query_boundaries is not None,
+            "has_init_score": self.metadata.init_score is not None,
+        }
+        with open(filename, "wb") as fh:
+            fh.write(_BINARY_MAGIC)
+            hdr = json.dumps(meta).encode()
+            fh.write(len(hdr).to_bytes(8, "little"))
+            fh.write(hdr)
+            fh.write(np.ascontiguousarray(self.binned).tobytes())
+            for arr in (self.metadata.label, self.metadata.weight,
+                        self.metadata.query_boundaries, self.metadata.init_score):
+                if arr is not None:
+                    fh.write(np.ascontiguousarray(arr).tobytes())
+        return self
+
+    @staticmethod
+    def load_binary(filename: str, params: Optional[dict] = None) -> "Dataset":
+        with open(filename, "rb") as fh:
+            magic = fh.read(len(_BINARY_MAGIC))
+            if magic != _BINARY_MAGIC:
+                raise ValueError(f"{filename} is not a lightgbm_tpu binary dataset")
+            n = int.from_bytes(fh.read(8), "little")
+            meta = json.loads(fh.read(n).decode())
+            ds = Dataset.__new__(Dataset)
+            ds.params = dict(params or {})
+            ds.raw_data = None
+            ds.reference = None
+            ds.free_raw_data = True
+            ds._feature_name_param = meta["feature_names"]
+            ds._categorical_feature_param = None
+            ds.constructed = True
+            ds.num_data = meta["num_data"]
+            ds.num_total_features = meta["num_total_features"]
+            ds.used_features = meta["used_features"]
+            ds.feature_names = meta["feature_names"]
+            ds.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
+            F = len(ds.used_features)
+            dtype = np.dtype(meta["dtype"])
+            ds.binned = np.frombuffer(
+                fh.read(ds.num_data * F * dtype.itemsize), dtype=dtype
+            ).reshape(ds.num_data, F).copy()
+            ds.metadata = Metadata()
+            if meta["has_label"]:
+                ds.metadata.label = np.frombuffer(fh.read(ds.num_data * 4), np.float32).copy()
+            if meta["has_weight"]:
+                ds.metadata.weight = np.frombuffer(fh.read(ds.num_data * 4), np.float32).copy()
+            if meta["has_group"]:
+                rest = fh.read()
+                # query boundaries precede init score; length unknown → parse both
+                if meta["has_init_score"]:
+                    qb_len = len(rest) - ds.num_data * 8
+                    ds.metadata.query_boundaries = np.frombuffer(rest[:qb_len], np.int32).copy()
+                    ds.metadata.init_score = np.frombuffer(rest[qb_len:], np.float64).copy()
+                else:
+                    ds.metadata.query_boundaries = np.frombuffer(rest, np.int32).copy()
+            elif meta["has_init_score"]:
+                ds.metadata.init_score = np.frombuffer(fh.read(ds.num_data * 8), np.float64).copy()
+            return ds
+
+    # -- device view ---------------------------------------------------------
+
+    def feature_meta(self) -> "FeatureMeta":
+        self.construct()
+        return FeatureMeta.from_mappers([self.bin_mappers[f] for f in self.used_features])
+
+
+@dataclass(frozen=True)
+class FeatureMeta:
+    """Static (trace-time) per-used-feature metadata arrays for device kernels."""
+
+    num_bin: np.ndarray        # int32 [F]
+    missing_type: np.ndarray   # int32 [F]
+    default_bin: np.ndarray    # int32 [F]
+    most_freq_bin: np.ndarray  # int32 [F]
+    is_categorical: np.ndarray  # bool [F]
+    max_num_bin: int           # padded bin axis size B
+
+    @staticmethod
+    def from_mappers(mappers: Sequence[BinMapper]) -> "FeatureMeta":
+        nb = np.array([m.num_bin for m in mappers], dtype=np.int32)
+        return FeatureMeta(
+            num_bin=nb,
+            missing_type=np.array([m.missing_type for m in mappers], dtype=np.int32),
+            default_bin=np.array([m.default_bin for m in mappers], dtype=np.int32),
+            most_freq_bin=np.array([m.most_freq_bin for m in mappers], dtype=np.int32),
+            is_categorical=np.array([m.bin_type == BinType.CATEGORICAL for m in mappers], dtype=bool),
+            max_num_bin=int(nb.max()) if len(nb) else 2,
+        )
+
+
+def _is_sparse(data) -> bool:
+    return hasattr(data, "tocsc") and hasattr(data, "nnz")
+
+
+def _get_col(raw, sp, f: int, rows: Optional[np.ndarray]) -> np.ndarray:
+    if raw is not None:
+        col = raw[:, f]
+    else:
+        col = np.asarray(sp[:, f].todense()).reshape(-1).astype(np.float64)
+    return col if rows is None else col[rows]
+
+
+def _load_forced_bins(params: dict, num_features: int) -> Dict[int, List[float]]:
+    """reference: forcedbins_filename (dataset_loader.cpp DatasetLoader ctor)."""
+    fn = params.get("forcedbins_filename", "")
+    if not fn:
+        return {}
+    with open(fn) as fh:
+        spec = json.load(fh)
+    out: Dict[int, List[float]] = {}
+    for entry in spec:
+        out[int(entry["feature"])] = [float(x) for x in entry["bin_upper_bound"]]
+    return out

@@ -1,0 +1,249 @@
+"""Training entry points: train() and cv().
+
+reference: python-package/lightgbm/engine.py — train (:18) with the callback
+protocol, cv (:375) with CVBooster and fold aggregation.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster
+from .config import Config
+from .dataset import Dataset
+
+
+def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
+          init_model=None, feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[dict] = None,
+          verbose_eval: Union[bool, int] = True,
+          learning_rates=None, keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """reference: engine.py:18."""
+    params = dict(params)
+    cfg = Config.from_params(params)
+    if "num_iterations" in {Config.canonical_key(k) for k in params}:
+        num_boost_round = cfg.num_iterations
+    if fobj is not None:
+        params["objective"] = "none"
+    if feature_name != "auto":
+        train_set._feature_name_param = feature_name
+    if categorical_feature != "auto":
+        train_set._categorical_feature_param = categorical_feature
+
+    predictor = None
+    init_score_offset = None
+    if init_model is not None:
+        predictor = init_model if isinstance(init_model, Booster) else \
+            Booster(model_file=init_model, params=params)
+
+    booster = Booster(params=params, train_set=train_set)
+
+    # continued training: old model predictions become init scores
+    # (reference: basic.py:840 _set_init_score_by_predictor)
+    if predictor is not None:
+        _apply_init_model(booster, predictor, train_set)
+
+    if valid_sets:
+        valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+        for vs, name in zip(valid_sets, valid_names):
+            if vs is train_set:
+                continue
+            booster.add_valid(vs, name)
+
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(
+            early_stopping_rounds, cfg.first_metric_only,
+            verbose=bool(verbose_eval)))
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        cbs.add(callback_mod.early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only,
+            verbose=bool(verbose_eval)))
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+
+    cbs_before = {cb for cb in cbs if getattr(cb, "before_iteration", False)}
+    cbs_after = cbs - cbs_before
+    cbs_before = sorted(cbs_before, key=lambda cb: getattr(cb, "order", 0))
+    cbs_after = sorted(cbs_after, key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
+        finished = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if (valid_sets and booster.boosting.valid_metrics) or feval is not None \
+                or cfg.is_provide_training_metric:
+            if cfg.is_provide_training_metric:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(booster, params, i, 0,
+                                            num_boost_round, evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for item in e.best_score:
+                booster.best_score.setdefault(item[0], collections.OrderedDict())
+                booster.best_score[item[0]][item[1]] = item[2]
+            break
+        if finished:
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+        for item in evaluation_result_list:
+            booster.best_score.setdefault(item[0], collections.OrderedDict())
+            booster.best_score[item[0]][item[1]] = item[2]
+    return booster
+
+
+def _apply_init_model(booster: Booster, predictor: Booster, train_set: Dataset):
+    raw = predictor.predict(_recover_raw(train_set), raw_score=True)
+    K = booster.boosting.num_tree_per_iteration
+    import jax.numpy as jnp
+    n = train_set.num_data
+    isc = np.asarray(raw, np.float32).reshape(-1, K).T if K > 1 else \
+        np.asarray(raw, np.float32).reshape(1, n)
+    booster.boosting.train_score = booster.boosting.train_score + jnp.asarray(isc)
+    booster.boosting._init_score_added = True
+    booster.boosting.models = list(predictor.models)
+    booster.boosting.iter = len(predictor.models) // K
+
+
+def _recover_raw(train_set: Dataset):
+    if train_set.raw_data is not None:
+        return train_set.raw_data
+    raise ValueError("continued training requires free_raw_data=False on the "
+                     "training Dataset")
+
+
+class CVBooster:
+    """reference: engine.py CVBooster."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
+                  seed: int, stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and hasattr(folds, "split"):
+            group = None
+            if full_data.metadata.query_boundaries is not None:
+                group = np.diff(full_data.metadata.query_boundaries)
+            folds = folds.split(X=np.empty(num_data),
+                                y=full_data.get_label(), groups=group)
+        return list(folds)
+    rng = np.random.RandomState(seed)
+    if stratified:
+        from sklearn.model_selection import StratifiedKFold
+        skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                              random_state=seed if shuffle else None)
+        return list(skf.split(np.empty(num_data), full_data.get_label()))
+    idx = np.arange(num_data)
+    if shuffle:
+        rng.shuffle(idx)
+    chunks = np.array_split(idx, nfold)
+    return [(np.concatenate([c for j, c in enumerate(chunks) if j != i]), chunks[i])
+            for i in range(nfold)]
+
+
+def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv: bool = True, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """reference: engine.py:375."""
+    params = dict(params)
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config.from_params(params)
+    if cfg.objective in ("binary",) or cfg.objective.startswith("multiclass"):
+        pass
+    else:
+        stratified = False
+
+    folds_idx = _make_n_folds(train_set, folds, nfold, params, seed,
+                              stratified, shuffle)
+    cvbooster = CVBooster()
+    results = collections.defaultdict(list)
+
+    boosters = []
+    for (tr_idx, te_idx) in folds_idx:
+        tr = train_set.subset(tr_idx, params)
+        te = train_set.subset(te_idx, params)
+        if fpreproc is not None:
+            tr, te, params = fpreproc(tr, te, dict(params))
+        bst = Booster(params=params, train_set=tr)
+        bst.add_valid(te, "valid")
+        boosters.append(bst)
+        cvbooster._append(bst)
+
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
+                                            cfg.first_metric_only, verbose=False))
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
+    cbs = sorted(cbs, key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        agg: Dict[str, List[float]] = collections.defaultdict(list)
+        for bst in boosters:
+            bst.update(fobj=fobj)
+            res = (bst.eval_train(feval) if eval_train_metric else []) + \
+                bst.eval_valid(feval)
+            for (dname, mname, val, hib) in res:
+                agg[(dname if eval_train_metric else "valid", mname, hib)].append(val)
+        evaluation_result_list = [
+            ("cv_agg", f"{d} {m}" if eval_train_metric else m,
+             float(np.mean(v)), h, float(np.std(v)))
+            for (d, m, h), v in agg.items()]
+        for (_, m, mean, _, std) in evaluation_result_list:
+            results[m + "-mean"].append(mean)
+            results[m + "-stdv"].append(std)
+        try:
+            for cb in cbs:
+                cb(callback_mod.CallbackEnv(cvbooster, params, i, 0,
+                                            num_boost_round, evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for k in results:
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

@@ -1,0 +1,419 @@
+"""Jitted leaf-wise tree growth.
+
+TPU-native redesign of LightGBM's SerialTreeLearner
+(reference: src/treelearner/serial_tree_learner.cpp:149 Train loop).  The
+re-design for XLA:
+
+- No DataPartition / ordered-gradient gather (data_partition.hpp:101,
+  dataset.cpp:1318): a dense per-row ``leaf_id`` vector is carried instead;
+  leaf membership enters the histogram kernel as a multiplicative mask.
+- All shapes static: tree arrays sized by ``num_leaves``; the grow loop is a
+  ``lax.while_loop`` ending early when no split has positive gain — the
+  same best-first (leaf-wise) policy as the reference (:175-193).
+- The histogram cache is a dense [num_leaves, F, B, 3] HBM array; the
+  smaller child is built by a masked pass, the sibling by subtraction
+  (reference "subtraction trick", serial_tree_learner.cpp:380-388).
+- Distributed: pass ``axis_name`` when called under shard_map with rows
+  sharded across the mesh — histograms and scalar sums are psum'd, after
+  which EVERY device computes the identical best split, eliminating the
+  reference's best-split allreduce (parallel_tree_learner.h:190-213).
+
+Node numbering matches the reference Tree (include/LightGBM/tree.h:60-85):
+internal node s = s-th split; child pointers >= 0 are internal nodes,
+negative values are leaves encoded as ``~leaf_index``; the left child keeps
+the parent's leaf index, the right child gets leaf index ``num_leaves``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dataset import FeatureMeta
+from .ops.histogram import build_histogram
+from .ops.split import (MAX_CAT_WORDS, SplitHyperparams, SplitResult,
+                        best_split_for_leaf, leaf_output)
+
+
+class TreeArrays(NamedTuple):
+    """Flat-array tree, fixed shapes; L leaves, L-1 internal nodes."""
+
+    split_feature: jax.Array    # [L-1] i32 (index into used features)
+    threshold_bin: jax.Array    # [L-1] i32
+    default_left: jax.Array     # [L-1] bool
+    is_categorical: jax.Array   # [L-1] bool
+    cat_bitset: jax.Array       # [L-1, MAX_CAT_WORDS] u32 (bins going left)
+    left_child: jax.Array       # [L-1] i32 (>=0 node, <0 ~leaf)
+    right_child: jax.Array      # [L-1] i32
+    split_gain: jax.Array       # [L-1] f32
+    internal_value: jax.Array   # [L-1] f32 (output if node were a leaf)
+    internal_weight: jax.Array  # [L-1] f32 (sum_hess)
+    internal_count: jax.Array   # [L-1] f32
+    leaf_value: jax.Array       # [L] f32
+    leaf_weight: jax.Array      # [L] f32
+    leaf_count: jax.Array       # [L] f32
+    leaf_parent: jax.Array      # [L] i32 (internal node whose child is this leaf)
+    leaf_depth: jax.Array       # [L] i32
+    num_leaves: jax.Array       # scalar i32
+
+    @staticmethod
+    def empty(L: int) -> "TreeArrays":
+        n = max(L - 1, 1)
+        return TreeArrays(
+            split_feature=jnp.zeros(n, jnp.int32),
+            threshold_bin=jnp.zeros(n, jnp.int32),
+            default_left=jnp.zeros(n, bool),
+            is_categorical=jnp.zeros(n, bool),
+            cat_bitset=jnp.zeros((n, MAX_CAT_WORDS), jnp.uint32),
+            left_child=jnp.zeros(n, jnp.int32),
+            right_child=jnp.zeros(n, jnp.int32),
+            split_gain=jnp.zeros(n, jnp.float32),
+            internal_value=jnp.zeros(n, jnp.float32),
+            internal_weight=jnp.zeros(n, jnp.float32),
+            internal_count=jnp.zeros(n, jnp.float32),
+            leaf_value=jnp.zeros(L, jnp.float32),
+            leaf_weight=jnp.zeros(L, jnp.float32),
+            leaf_count=jnp.zeros(L, jnp.float32),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            num_leaves=jnp.array(1, jnp.int32),
+        )
+
+
+class _LeafBest(NamedTuple):
+    """Per-leaf cached best split (SoA over leaves)."""
+
+    gain: jax.Array; feature: jax.Array; threshold: jax.Array
+    default_left: jax.Array; left_sum_grad: jax.Array; left_sum_hess: jax.Array
+    left_count: jax.Array; right_sum_grad: jax.Array; right_sum_hess: jax.Array
+    right_count: jax.Array; is_categorical: jax.Array; cat_bitset: jax.Array
+
+    @staticmethod
+    def empty(L: int) -> "_LeafBest":
+        return _LeafBest(
+            gain=jnp.full(L, -jnp.inf, jnp.float32),
+            feature=jnp.zeros(L, jnp.int32),
+            threshold=jnp.zeros(L, jnp.int32),
+            default_left=jnp.zeros(L, bool),
+            left_sum_grad=jnp.zeros(L, jnp.float32),
+            left_sum_hess=jnp.zeros(L, jnp.float32),
+            left_count=jnp.zeros(L, jnp.float32),
+            right_sum_grad=jnp.zeros(L, jnp.float32),
+            right_sum_hess=jnp.zeros(L, jnp.float32),
+            right_count=jnp.zeros(L, jnp.float32),
+            is_categorical=jnp.zeros(L, bool),
+            cat_bitset=jnp.zeros((L, MAX_CAT_WORDS), jnp.uint32),
+        )
+
+    def store(self, leaf: jax.Array, r: SplitResult) -> "_LeafBest":
+        return _LeafBest(
+            gain=self.gain.at[leaf].set(r.gain),
+            feature=self.feature.at[leaf].set(r.feature),
+            threshold=self.threshold.at[leaf].set(r.threshold),
+            default_left=self.default_left.at[leaf].set(r.default_left),
+            left_sum_grad=self.left_sum_grad.at[leaf].set(r.left_sum_grad),
+            left_sum_hess=self.left_sum_hess.at[leaf].set(r.left_sum_hess),
+            left_count=self.left_count.at[leaf].set(r.left_count),
+            right_sum_grad=self.right_sum_grad.at[leaf].set(r.right_sum_grad),
+            right_sum_hess=self.right_sum_hess.at[leaf].set(r.right_sum_hess),
+            right_count=self.right_count.at[leaf].set(r.right_count),
+            is_categorical=self.is_categorical.at[leaf].set(r.is_categorical),
+            cat_bitset=self.cat_bitset.at[leaf].set(r.cat_bitset),
+        )
+
+
+class GrowerConfig(NamedTuple):
+    """Static (trace-time) grower configuration."""
+
+    num_leaves: int = 31
+    max_depth: int = -1
+    hp: SplitHyperparams = SplitHyperparams()
+    hist_method: str = "auto"
+    num_bins: int = 255            # padded bin axis B
+    learning_rate: float = 0.1
+
+
+def _psum(x, axis_name):
+    return lax.psum(x, axis_name) if axis_name is not None else x
+
+
+def row_goes_left(col: jax.Array, node_thr: jax.Array, node_dl: jax.Array,
+                  node_cat: jax.Array, node_bitset: jax.Array,
+                  missing_type: jax.Array, default_bin: jax.Array,
+                  num_bin: jax.Array) -> jax.Array:
+    """Decision rule in bin space for one node over a column of rows.
+
+    reference: DenseBin::SplitInner (src/io/dense_bin.hpp) — missing rows
+    follow default_left, others compare bin <= threshold; categorical rows
+    test bitset membership.
+    """
+    from .binning import MissingType
+    col = col.astype(jnp.int32)
+    is_missing = ((missing_type == MissingType.NAN) & (col == num_bin - 1)) | \
+                 ((missing_type == MissingType.ZERO) & (col == default_bin))
+    num_left = jnp.where(is_missing, node_dl, col <= node_thr)
+    word = (col // 32).astype(jnp.int32)
+    bit = (col % 32).astype(jnp.uint32)
+    if node_bitset.ndim == 2:  # per-row bitsets (traversal path)
+        w = jnp.take_along_axis(node_bitset, word[:, None], axis=1)[:, 0]
+    else:
+        w = node_bitset[word]
+    cat_left = ((w >> bit) & jnp.uint32(1)) == 1
+    return jnp.where(node_cat, cat_left, num_left)
+
+
+def grow_tree(
+    binned: jax.Array,          # [n, F] uint8/16 (n, F possibly per-shard)
+    grad: jax.Array,            # [n] f32
+    hess: jax.Array,            # [n] f32
+    row_mask: jax.Array,        # [n] f32 bagging/GOSS weights (0 = excluded)
+    meta: FeatureMeta,          # host numpy metadata (trace-time constants)
+    cfg: GrowerConfig,
+    feature_mask: Optional[jax.Array] = None,   # [F] per-tree col sample
+    axis_name: Optional[str] = None,            # mesh axis sharding ROWS
+    feature_axis_name: Optional[str] = None,    # mesh axis sharding FEATURES
+):
+    """Grow one tree; returns (TreeArrays, leaf_id [n] i32).
+
+    Distributed modes (call under shard_map over a Mesh):
+    - ``axis_name``: rows sharded — histograms and scalar sums are psum'd,
+      then every device finds the identical best split (DataParallel
+      semantics, reference data_parallel_tree_learner.cpp, with the
+      best-split sync eliminated).
+    - ``feature_axis_name``: features sharded — each device scans only its
+      own features (meta arrays are full-size; the local slice is taken by
+      ``axis_index``), the best split is merged by all_gather + argmax
+      (reference SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213),
+      and the owner broadcasts the partition mask via psum (replaces the
+      reference's no-op because there every machine holds all features).
+    Both can be combined (2-D mesh).
+    """
+    n, F = binned.shape
+    L = cfg.num_leaves
+    B = cfg.num_bins
+    hp = cfg.hp
+
+    if feature_axis_name is not None:
+        # slice the full meta arrays down to this shard's features
+        fidx = lax.axis_index(feature_axis_name)
+        def shard_slice(arr):
+            return lax.dynamic_slice_in_dim(jnp.asarray(arr), fidx * F, F)
+        num_bin = shard_slice(meta.num_bin)
+        missing_type = shard_slice(meta.missing_type)
+        default_bin = shard_slice(meta.default_bin)
+        is_cat = shard_slice(meta.is_categorical)
+        f_offset = fidx * F
+    else:
+        num_bin = jnp.asarray(meta.num_bin)
+        missing_type = jnp.asarray(meta.missing_type)
+        default_bin = jnp.asarray(meta.default_bin)
+        is_cat = jnp.asarray(meta.is_categorical)
+        f_offset = None
+    has_cat = bool(meta.is_categorical.any())
+
+    hist_fn = functools.partial(build_histogram, num_bins=B, method=cfg.hist_method)
+
+    def leaf_best(hist, sg, sh, cnt, depth):
+        r = best_split_for_leaf(
+            hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
+            hp, feature_mask=feature_mask, has_categorical=has_cat)
+        # depth limit (reference: serial_tree_learner.cpp:261-301 pruning)
+        if cfg.max_depth > 0:
+            r = r._replace(gain=jnp.where(depth >= cfg.max_depth, -jnp.inf, r.gain))
+        if feature_axis_name is not None:
+            # merge best splits across the feature shards
+            r = r._replace(feature=r.feature + f_offset)
+            gathered = jax.tree_util.tree_map(
+                lambda x: lax.all_gather(x, feature_axis_name), r)
+            winner = jnp.argmax(gathered.gain)
+            r = jax.tree_util.tree_map(lambda x: x[winner], gathered)
+        return r
+
+    # ---- root ----
+    root_hist = _psum(hist_fn(binned, grad, hess, row_mask), axis_name)
+    root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
+    root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
+    root_cnt = _psum(jnp.sum(row_mask), axis_name)
+
+    tree = TreeArrays.empty(L)
+    best = _LeafBest.empty(L)
+    hist_cache = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(root_hist)
+    leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
+    leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
+    leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
+    # which internal node points at this leaf, and on which side (0=L,1=R)
+    leaf_parent_side = jnp.zeros(L, jnp.int32)
+    best = best.store(jnp.array(0), leaf_best(root_hist, root_sg, root_sh,
+                                              root_cnt, jnp.array(0)))
+    leaf_id = jnp.zeros(n, jnp.int32)
+
+    class Carry(NamedTuple):
+        tree: TreeArrays
+        best: _LeafBest
+        hist: jax.Array
+        leaf_sg: jax.Array
+        leaf_sh: jax.Array
+        leaf_cnt: jax.Array
+        leaf_parent_side: jax.Array
+        leaf_id: jax.Array
+        split_idx: jax.Array  # number of splits applied so far
+
+    def cond(c: Carry):
+        active = jnp.arange(L) < c.tree.num_leaves
+        best_gain = jnp.max(jnp.where(active, c.best.gain, -jnp.inf))
+        return (c.split_idx < L - 1) & (best_gain > 0.0)
+
+    def body(c: Carry) -> Carry:
+        tree, best = c.tree, c.best
+        active = jnp.arange(L) < tree.num_leaves
+        gains = jnp.where(active, best.gain, -jnp.inf)
+        leaf = jnp.argmax(gains).astype(jnp.int32)   # best-first (leaf-wise)
+        s = c.split_idx                               # new internal node index
+        new_leaf = tree.num_leaves                    # right child leaf index
+
+        feat = best.feature[leaf]
+        thr = best.threshold[leaf]
+        dl = best.default_left[leaf]
+        ncat = best.is_categorical[leaf]
+        nbits = best.cat_bitset[leaf]
+
+        # -- record node (fix the parent's dangling child pointer first)
+        parent_node = tree.leaf_parent[leaf]
+        side = c.leaf_parent_side[leaf]
+        has_parent = parent_node >= 0
+        pn = jnp.maximum(parent_node, 0)
+        left_child = jnp.where(
+            has_parent & (side == 0),
+            tree.left_child.at[pn].set(s), tree.left_child)
+        right_child = jnp.where(
+            has_parent & (side == 1),
+            tree.right_child.at[pn].set(s), tree.right_child)
+        lg, lh, lc = best.left_sum_grad[leaf], best.left_sum_hess[leaf], best.left_count[leaf]
+        rg, rh, rc = best.right_sum_grad[leaf], best.right_sum_hess[leaf], best.right_count[leaf]
+        parent_out = leaf_output(c.leaf_sg[leaf], c.leaf_sh[leaf],
+                                 hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
+        new_depth = tree.leaf_depth[leaf] + 1
+        tree = tree._replace(
+            split_feature=tree.split_feature.at[s].set(feat),
+            threshold_bin=tree.threshold_bin.at[s].set(thr),
+            default_left=tree.default_left.at[s].set(dl),
+            is_categorical=tree.is_categorical.at[s].set(ncat),
+            cat_bitset=tree.cat_bitset.at[s].set(nbits),
+            left_child=left_child.at[s].set(~leaf),
+            right_child=right_child.at[s].set(~new_leaf),
+            split_gain=tree.split_gain.at[s].set(best.gain[leaf]),
+            internal_value=tree.internal_value.at[s].set(parent_out),
+            internal_weight=tree.internal_weight.at[s].set(c.leaf_sh[leaf]),
+            internal_count=tree.internal_count.at[s].set(c.leaf_cnt[leaf]),
+            leaf_parent=tree.leaf_parent.at[leaf].set(s).at[new_leaf].set(s),
+            leaf_depth=tree.leaf_depth.at[leaf].set(new_depth).at[new_leaf].set(new_depth),
+            num_leaves=tree.num_leaves + 1,
+        )
+        leaf_parent_side = c.leaf_parent_side.at[leaf].set(0).at[new_leaf].set(1)
+
+        # -- partition rows of `leaf` (reference: DataPartition::Split)
+        if feature_axis_name is not None:
+            # split feature is global; only the owning shard has the column
+            local_f = feat - f_offset
+            owned = (local_f >= 0) & (local_f < F)
+            lf = jnp.clip(local_f, 0, F - 1)
+            gl_local = row_goes_left(binned[:, lf], thr, dl, ncat, nbits,
+                                     missing_type[lf], default_bin[lf],
+                                     num_bin[lf])
+            goes_left = lax.psum(
+                jnp.where(owned, gl_local.astype(jnp.float32), 0.0),
+                feature_axis_name) > 0.5
+        else:
+            col = binned[:, feat]
+            goes_left = row_goes_left(col, thr, dl, ncat, nbits,
+                                      missing_type[feat], default_bin[feat],
+                                      num_bin[feat])
+        in_leaf = c.leaf_id == leaf
+        leaf_id = jnp.where(in_leaf & ~goes_left, new_leaf, c.leaf_id)
+
+        # -- leaf sums
+        leaf_sg = c.leaf_sg.at[leaf].set(lg).at[new_leaf].set(rg)
+        leaf_sh = c.leaf_sh.at[leaf].set(lh).at[new_leaf].set(rh)
+        leaf_cnt = c.leaf_cnt.at[leaf].set(lc).at[new_leaf].set(rc)
+
+        # -- histograms: masked pass for smaller child, subtraction for sibling
+        left_smaller = lc <= rc
+        small_leaf = jnp.where(left_smaller, leaf, new_leaf)
+        small_mask = row_mask * (leaf_id == small_leaf)
+        parent_hist = c.hist[leaf]
+        small_hist = _psum(hist_fn(binned, grad, hess, small_mask), axis_name)
+        large_hist = parent_hist - small_hist
+        hist_l = jnp.where(left_smaller, small_hist, large_hist)
+        hist_r = jnp.where(left_smaller, large_hist, small_hist)
+        hist = c.hist.at[leaf].set(hist_l).at[new_leaf].set(hist_r)
+
+        # -- best splits for the two children
+        rl = leaf_best(hist_l, lg, lh, lc, new_depth)
+        rr = leaf_best(hist_r, rg, rh, rc, new_depth)
+        best = best.store(leaf, rl).store(new_leaf, rr)
+
+        return Carry(tree, best, hist, leaf_sg, leaf_sh, leaf_cnt,
+                     leaf_parent_side, leaf_id, s + 1)
+
+    init = Carry(tree, best, hist_cache, leaf_sg, leaf_sh, leaf_cnt,
+                 leaf_parent_side, leaf_id, jnp.array(0, jnp.int32))
+    out = lax.while_loop(cond, body, init)
+
+    # finalize leaf values
+    tree = out.tree
+    lv = leaf_output(out.leaf_sg, out.leaf_sh, hp.lambda_l1, hp.lambda_l2,
+                     hp.max_delta_step)
+    active = jnp.arange(L) < tree.num_leaves
+    tree = tree._replace(
+        leaf_value=jnp.where(active, lv, 0.0),
+        leaf_weight=jnp.where(active, out.leaf_sh, 0.0),
+        leaf_count=jnp.where(active, out.leaf_cnt, 0.0),
+    )
+    return tree, out.leaf_id
+
+
+def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
+                              meta: FeatureMeta) -> jax.Array:
+    """Route binned rows to leaf indices by iterative traversal.
+
+    reference: Tree::Predict inline traversal (include/LightGBM/tree.h:190).
+    Vectorized: all rows advance one level per iteration; done when every
+    row has reached a leaf (child pointer < 0).
+    """
+    n = binned.shape[0]
+    num_bin = jnp.asarray(meta.num_bin)
+    missing_type = jnp.asarray(meta.missing_type)
+    default_bin = jnp.asarray(meta.default_bin)
+
+    # node >= 0: internal; node < 0: leaf ~node
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, it = state
+        nd = jnp.maximum(node, 0)
+        feat = tree.split_feature[nd]
+        col = binned[jnp.arange(n), feat].astype(jnp.int32)
+        gl = row_goes_left(col, tree.threshold_bin[nd], tree.default_left[nd],
+                           tree.is_categorical[nd], tree.cat_bitset[nd],
+                           missing_type[feat], default_bin[feat], num_bin[feat])
+        nxt = jnp.where(gl, tree.left_child[nd], tree.right_child[nd])
+        node = jnp.where(node >= 0, nxt, node)
+        return node, it + 1
+
+    has_split = tree.num_leaves > 1
+    init_node = jnp.broadcast_to(
+        jnp.where(has_split, 0, -1).astype(jnp.int32), (n,))
+    node, _ = lax.while_loop(cond, body, (init_node, jnp.array(0)))
+    return ~node  # leaf index
+
+
+def predict_tree_binned(tree: TreeArrays, binned: jax.Array,
+                        meta: FeatureMeta) -> jax.Array:
+    leaf = predict_leaf_index_binned(tree, binned, meta)
+    return tree.leaf_value[leaf]

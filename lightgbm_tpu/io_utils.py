@@ -1,0 +1,133 @@
+"""Text dataset parsing: CSV/TSV/LibSVM auto-detection + sidecar files.
+
+reference: src/io/parser.cpp (Parser::CreateParser format auto-detect),
+src/io/metadata.cpp (LoadWeights/LoadQueryBoundaries from .weight/.query
+sidecar files).  Host-side; the fast path uses pandas' C engine.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def detect_format(path: str, num_probe_lines: int = 32) -> Tuple[str, bool]:
+    """Return (format, has_header); format in {'csv', 'tsv', 'libsvm'}."""
+    lines = []
+    with open(path, "r") as fh:
+        for _ in range(num_probe_lines):
+            ln = fh.readline()
+            if not ln:
+                break
+            if ln.strip():
+                lines.append(ln.rstrip("\n"))
+    if not lines:
+        raise ValueError(f"empty data file: {path}")
+
+    probe = lines[min(1, len(lines) - 1)]
+    tokens = probe.replace("\t", " ").replace(",", " ").split()
+    is_libsvm = any(":" in t for t in tokens[1:])
+    if is_libsvm:
+        return "libsvm", False
+    fmt = "tsv" if "\t" in probe else "csv"
+    # header detection: first line tokens are non-numeric
+    first = lines[0].split("\t" if fmt == "tsv" else ",")
+    def _is_num(s: str) -> bool:
+        try:
+            float(s)
+            return True
+        except ValueError:
+            return s.strip().lower() in ("nan", "na", "")
+    has_header = not all(_is_num(t) for t in first)
+    return fmt, has_header
+
+
+def load_text_dataset(path: str, dataset) -> np.ndarray:
+    """Load a text file into a dense float matrix; sets label/weight/group on
+    ``dataset`` from the label column and sidecar files.  Returns features."""
+    params = dataset.params
+    fmt, has_header = detect_format(path)
+    header_override = params.get("header", None)
+    if header_override is not None:
+        has_header = bool(header_override)
+
+    if fmt == "libsvm":
+        X, y = _load_libsvm(path)
+        label_idx = 0
+        data = X
+        labels = y
+        names = None
+    else:
+        import pandas as pd
+        sep = "\t" if fmt == "tsv" else ","
+        df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
+                         na_values=["nan", "NA", "na", ""])
+        names = [str(c) for c in df.columns] if has_header else None
+        mat = df.to_numpy(dtype=np.float64)
+        label_spec = params.get("label_column", params.get("label", 0))
+        label_idx = _resolve_column(label_spec, names, default=0)
+        labels = mat[:, label_idx].astype(np.float32) if label_idx is not None else None
+        keep = [i for i in range(mat.shape[1]) if i != label_idx]
+        ignore = params.get("ignore_column", params.get("ignore_feature"))
+        if ignore:
+            ignored = {_resolve_column(c, names) for c in str(ignore).split(",")}
+            keep = [i for i in keep if i not in ignored]
+        data = mat[:, keep]
+        if names:
+            dataset.feature_names = [names[i] for i in keep]
+
+    if labels is not None and dataset.metadata.label is None:
+        dataset.metadata.label = labels
+
+    wfile = path + ".weight"
+    if os.path.exists(wfile) and dataset.metadata.weight is None:
+        dataset.metadata.weight = np.loadtxt(wfile, dtype=np.float32).reshape(-1)
+    qfile = path + ".query"
+    if os.path.exists(qfile) and dataset.metadata.query_boundaries is None:
+        group = np.loadtxt(qfile, dtype=np.int64).reshape(-1)
+        dataset.metadata.set_group(group)
+    ifile = path + ".init"
+    if os.path.exists(ifile) and dataset.metadata.init_score is None:
+        dataset.metadata.init_score = np.loadtxt(ifile, dtype=np.float64)
+    return data
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    labels = []
+    rows = []
+    max_feat = -1
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            parts = ln.split()
+            labels.append(float(parts[0]))
+            row = {}
+            for tok in parts[1:]:
+                if ":" not in tok:
+                    continue
+                k, v = tok.split(":", 1)
+                idx = int(k)
+                row[idx] = float(v)
+                max_feat = max(max_feat, idx)
+            rows.append(row)
+    X = np.zeros((len(rows), max_feat + 1), dtype=np.float64)
+    for i, row in enumerate(rows):
+        for k, v in row.items():
+            X[i, k] = v
+    return X, np.asarray(labels, dtype=np.float32)
+
+
+def _resolve_column(spec, names, default=None):
+    if spec is None:
+        return default
+    s = str(spec)
+    if s.startswith("name:"):
+        nm = s[5:]
+        if names and nm in names:
+            return names.index(nm)
+        raise ValueError(f"unknown column {nm!r}")
+    return int(s)

@@ -1,0 +1,212 @@
+"""Ranking objectives: LambdaRank-NDCG and RankXENDCG.
+
+reference: src/objective/rank_objective.hpp — RankingObjective base (:48,
+per-query parallel loop), LambdarankNDCG (:98, pairwise lambdas x deltaNDCG
+with sigmoid table and optional normalization), RankXENDCG (:288).
+
+TPU re-design of the per-query loop (SURVEY hard part (d)): queries are
+**bucketed by padded size** (next power of two) at init; each bucket is a
+dense [num_queries_in_bucket, Q] array of row indices with padding.  The
+pairwise [Q, Q] lambda computation is vmapped over queries and chunked to
+bound memory; results scatter-add back into the flat [n] gradient vector.
+No sigmoid lookup table — the VPU computes exact sigmoids faster than a
+gather would be.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+from .objectives import ObjectiveFunction
+
+K_EPSILON = 1e-15
+_MIN_BUCKET = 8
+_PAIR_BUDGET = 1 << 22  # max elements per [chunk, Q, Q] intermediate
+
+
+def _bucket_queries(qb: np.ndarray) -> Dict[int, np.ndarray]:
+    """Group query ids by padded (next pow2) size. Returns {Q: query_ids}."""
+    sizes = np.diff(qb)
+    buckets: Dict[int, List[int]] = {}
+    for q, s in enumerate(sizes):
+        Q = _MIN_BUCKET
+        while Q < s:
+            Q *= 2
+        buckets.setdefault(Q, []).append(q)
+    return {Q: np.asarray(v, np.int64) for Q, v in buckets.items()}
+
+
+class RankingObjective(ObjectiveFunction):
+    need_group = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise RuntimeError("Ranking tasks require query information")
+        self.qb = np.asarray(metadata.query_boundaries, np.int64)
+        self.num_queries = len(self.qb) - 1
+        lbl = np.asarray(metadata.label, np.float64)
+        self.buckets = _bucket_queries(self.qb)
+        # per bucket: row indices [nq, Q] (n = padding), labels [nq, Q]
+        self.bucket_data = {}
+        n = num_data
+        for Q, qids in self.buckets.items():
+            idx = np.full((len(qids), Q), n, np.int32)   # n = padding slot
+            for r, q in enumerate(qids):
+                lo, hi = self.qb[q], self.qb[q + 1]
+                idx[r, :hi - lo] = np.arange(lo, hi)
+            labels = np.where(idx < n, lbl[np.minimum(idx, n - 1)], -1.0)
+            self.bucket_data[Q] = (jnp.asarray(idx), jnp.asarray(labels, jnp.float32),
+                                   qids)
+
+    def get_gradients(self, score):
+        n = self.num_data
+        grad = jnp.zeros(n + 1, jnp.float32)
+        hess = jnp.zeros(n + 1, jnp.float32)
+        score_pad = jnp.concatenate([score, jnp.zeros(1, score.dtype)])
+        for Q, (idx, labels, qids) in self.bucket_data.items():
+            s = score_pad[idx]                    # [nq, Q]
+            valid = idx < n
+            g, h = self._query_gradients(Q, s, labels, valid, qids)
+            grad = grad.at[idx.reshape(-1)].add(g.reshape(-1))
+            hess = hess.at[idx.reshape(-1)].add(h.reshape(-1))
+        grad, hess = grad[:n], hess[:n]
+        if self.weight is not None:
+            grad = grad * self.weight
+            hess = hess * self.weight
+        return grad, hess
+
+    def _query_gradients(self, Q, s, labels, valid, qids):
+        raise NotImplementedError
+
+
+class LambdarankNDCG(RankingObjective):
+    """reference: LambdarankNDCG (rank_objective.hpp:98)."""
+
+    name = "lambdarank"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sigmoid = config.sigmoid
+        self.norm = config.lambdarank_norm
+        self.truncation_level = config.lambdarank_truncation_level
+        lg = list(config.label_gain)
+        if not lg:
+            lg = [float((1 << i) - 1) for i in range(31)]
+        self.label_gain_np = np.asarray(lg, np.float64)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lbl = np.asarray(metadata.label, np.int64)
+        if lbl.min() < 0 or lbl.max() >= len(self.label_gain_np):
+            raise ValueError("ranking label out of range of label_gain")
+        # inverse max DCG at truncation level per query
+        # (reference: rank_objective.hpp:124-132)
+        inv = np.zeros(self.num_queries, np.float64)
+        for q in range(self.num_queries):
+            ls = np.sort(lbl[self.qb[q]:self.qb[q + 1]])[::-1][:self.truncation_level]
+            dcg = (self.label_gain_np[ls] / np.log2(np.arange(len(ls)) + 2.0)).sum()
+            inv[q] = 1.0 / dcg if dcg > 0 else 0.0
+        self.inverse_max_dcgs = inv
+        self.label_gain_j = jnp.asarray(self.label_gain_np, jnp.float32)
+
+    def _query_gradients(self, Q, s, labels, valid, qids):
+        inv_max_dcg = jnp.asarray(self.inverse_max_dcgs[qids], jnp.float32)
+        sig = self.sigmoid
+        norm = self.norm
+        gain = self.label_gain_j[jnp.maximum(labels, 0.0).astype(jnp.int32)]
+        gain = jnp.where(valid, gain, 0.0)
+
+        def one_chunk(args):
+            s_c, lbl_c, gain_c, valid_c, inv_c = args
+            smask = jnp.where(valid_c, s_c, -jnp.inf)
+            order = jnp.argsort(-smask, axis=1, stable=True)
+            rank = jnp.argsort(order, axis=1, stable=True)      # [c, Q]
+            disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0)
+            nvalid = valid_c.sum(axis=1)
+            best = jnp.max(smask, axis=1)
+            worst = jnp.min(jnp.where(valid_c, s_c, jnp.inf), axis=1)
+            # pair (i=high, j=low): label_i > label_j
+            pair_valid = (lbl_c[:, :, None] > lbl_c[:, None, :]) & \
+                valid_c[:, :, None] & valid_c[:, None, :]
+            dcg_gap = gain_c[:, :, None] - gain_c[:, None, :]
+            paired_disc = jnp.abs(disc[:, :, None] - disc[:, None, :])
+            delta_ndcg = dcg_gap * paired_disc * inv_c[:, None, None]
+            ds = s_c[:, :, None] - s_c[:, None, :]
+            if norm:
+                has_range = (best != worst)[:, None, None]
+                delta_ndcg = jnp.where(has_range,
+                                       delta_ndcg / (0.01 + jnp.abs(ds)), delta_ndcg)
+            p = 1.0 / (1.0 + jnp.exp(sig * ds))
+            p_lambda = -sig * delta_ndcg * p            # negative
+            p_hess = sig * sig * delta_ndcg * p * (1.0 - p)
+            p_lambda = jnp.where(pair_valid, p_lambda, 0.0)
+            p_hess = jnp.where(pair_valid, p_hess, 0.0)
+            lam = p_lambda.sum(axis=2) - p_lambda.sum(axis=1)   # high minus low
+            hes = p_hess.sum(axis=2) + p_hess.sum(axis=1)
+            sum_lambdas = -2.0 * p_lambda.sum(axis=(1, 2))
+            if norm:
+                factor = jnp.where(sum_lambdas > 0,
+                                   jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, K_EPSILON),
+                                   1.0)
+                lam = lam * factor[:, None]
+                hes = hes * factor[:, None]
+            del nvalid
+            return lam, hes
+
+        chunk = max(1, _PAIR_BUDGET // (Q * Q))
+        nq = s.shape[0]
+        pad = (-nq) % chunk
+        def padq(x, fill=0.0):
+            return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1),
+                           constant_values=fill)
+        args = (padq(s), padq(labels, -1.0), padq(gain), padq(valid, False),
+                padq(inv_max_dcg))
+        args = jax.tree_util.tree_map(
+            lambda x: x.reshape((nq + pad) // chunk, chunk, *x.shape[1:]), args)
+        lam, hes = jax.lax.map(one_chunk, args)
+        lam = lam.reshape(nq + pad, Q)[:nq]
+        hes = hes.reshape(nq + pad, Q)[:nq]
+        return lam, hes
+
+
+class RankXENDCG(RankingObjective):
+    """reference: RankXENDCG (rank_objective.hpp:288, arxiv 1911.09798)."""
+
+    name = "rank_xendcg"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self._key = jax.random.PRNGKey(config.objective_seed)
+
+    def get_gradients(self, score):
+        # fresh per-call randomness (reference: rands_[query].NextFloat())
+        self._key, sub = jax.random.split(self._key)
+        self._cur_key = sub
+        return super().get_gradients(score)
+
+    def _query_gradients(self, Q, s, labels, valid, qids):
+        key = jax.random.fold_in(self._cur_key, Q)
+        gammas = jax.random.uniform(key, s.shape)
+        rho = jax.nn.softmax(jnp.where(valid, s, -jnp.inf), axis=1)
+        rho = jnp.where(valid, rho, 0.0)
+        phi = jnp.exp2(jnp.maximum(labels, 0.0)) - gammas
+        phi = jnp.where(valid, phi, 0.0)
+        sum_labels = jnp.maximum(phi.sum(axis=1, keepdims=True), K_EPSILON)
+        l1 = jnp.where(valid, -phi / sum_labels + rho, 0.0)
+        sum_l1 = l1.sum(axis=1, keepdims=True)
+        denom = jnp.maximum(1.0 - rho, K_EPSILON)
+        l2 = jnp.where(valid, (sum_l1 - l1) / denom, 0.0)
+        sum_l2 = l2.sum(axis=1, keepdims=True)
+        l3 = jnp.where(valid, (sum_l2 - l2) / denom, 0.0)
+        cnt = valid.sum(axis=1, keepdims=True)
+        lam_many = l1 + rho * l2 + rho * rho * l3
+        lam = jnp.where(cnt <= 1, l1, lam_many)
+        hes = rho * (1.0 - rho)
+        return jnp.where(valid, lam, 0.0), jnp.where(valid, hes, 0.0)

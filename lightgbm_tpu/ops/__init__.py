@@ -1,0 +1,1 @@
+"""Device-side compute ops: histograms, split search, leaf renewal."""

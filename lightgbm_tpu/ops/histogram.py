@@ -1,0 +1,147 @@
+"""On-device gradient/hessian histogram construction.
+
+TPU-native replacement for LightGBM's histogram kernels
+(reference: src/io/dense_bin.hpp:97 ConstructHistogramInner — CPU scatter-add;
+src/treelearner/ocl/histogram256.cl:317 — GPU atomic scatter).
+
+Design inversion for the MXU: instead of scatter-add (random-access, serializes
+on TPU), the histogram is a **one-hot matmul**: for a block of rows build the
+0/1 matrix ``onehot[C, F*B]`` (row r has a 1 at column f*B + bin(r, f)) in
+bfloat16 (exact for 0/1) and compute ``vals.T @ onehot`` with
+``vals = mask * [grad, hess, 1]`` — a [4, C] x [C, F*B] matmul accumulated in
+float32 over row blocks.  This keeps the hot loop on the systolic array at
+~100% HBM streaming rate instead of scalar scatter.  Leaf membership is folded
+into ``mask``, which replaces the reference's ordered-gradient gather
+(src/io/dataset.cpp:1318-1333) with a branch-free masked pass.
+
+A scatter-based variant is kept for CPU testing / tiny inputs; `auto` probes
+are selected at trace time by platform.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# rows per block of the one-hot matmul; 8 sublanes * 128 lanes friendly
+_DEFAULT_BLOCK_ROWS = 4096
+
+
+def _pad_rows(n: int, block: int) -> int:
+    return (n + block - 1) // block * block
+
+
+def histogram_matmul(
+    binned: jax.Array,   # [n, F] uint8/uint16/int32
+    vals: jax.Array,     # [n, 3] f32 rows already masked: (g, h, 1)*mask
+    num_bins: int,       # padded bin axis B (static)
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> jax.Array:
+    """Histogram via one-hot matmul over row blocks. Returns [F, B, 3] f32."""
+    n, F = binned.shape
+    B = num_bins
+    nb = max(1, _pad_rows(n, block_rows) // block_rows)
+    n_pad = nb * block_rows
+    if n_pad != n:
+        binned = jnp.pad(binned, ((0, n_pad - n), (0, 0)))
+        vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
+    binned_blocks = binned.reshape(nb, block_rows, F)
+    vals_blocks = vals.reshape(nb, block_rows, 3)
+    iota = jnp.arange(B, dtype=binned.dtype)
+
+    def body(acc, blk):
+        b, v = blk
+        onehot = (b[:, :, None] == iota).astype(jnp.bfloat16)  # [C, F, B]
+        onehot2d = onehot.reshape(block_rows, F * B)
+        # [3, C] @ [C, F*B] -> [3, F*B], f32 accumulate
+        part = jax.lax.dot(
+            v.astype(jnp.bfloat16).T, onehot2d,
+            precision=lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        )
+        return acc + part, None
+
+    init = jnp.zeros((3, F * B), dtype=jnp.float32)
+    acc, _ = lax.scan(body, init, (binned_blocks, vals_blocks))
+    return acc.reshape(3, F, B).transpose(1, 2, 0)
+
+
+def histogram_matmul_f32(
+    binned: jax.Array, vals: jax.Array, num_bins: int,
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> jax.Array:
+    """Like histogram_matmul but f32 one-hot (exact grads; ~2x slower MXU)."""
+    n, F = binned.shape
+    B = num_bins
+    nb = max(1, _pad_rows(n, block_rows) // block_rows)
+    n_pad = nb * block_rows
+    if n_pad != n:
+        binned = jnp.pad(binned, ((0, n_pad - n), (0, 0)))
+        vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
+    binned_blocks = binned.reshape(nb, block_rows, F)
+    vals_blocks = vals.reshape(nb, block_rows, 3)
+    iota = jnp.arange(B, dtype=binned.dtype)
+
+    def body(acc, blk):
+        b, v = blk
+        onehot = (b[:, :, None] == iota).astype(jnp.float32).reshape(block_rows, F * B)
+        part = jax.lax.dot(v.T, onehot, preferred_element_type=jnp.float32)
+        return acc + part, None
+
+    init = jnp.zeros((3, F * B), dtype=jnp.float32)
+    acc, _ = lax.scan(body, init, (binned_blocks, vals_blocks))
+    return acc.reshape(3, F, B).transpose(1, 2, 0)
+
+
+def histogram_scatter(
+    binned: jax.Array, vals: jax.Array, num_bins: int,
+) -> jax.Array:
+    """Scatter-add histogram (XLA scatter). Reference semantics check path."""
+    n, F = binned.shape
+    B = num_bins
+    offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    flat_idx = binned.astype(jnp.int32) + offsets          # [n, F]
+    hist = jnp.zeros((F * B, 3), dtype=jnp.float32)
+    # vals broadcast across features: updates [n, F, 3]
+    updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
+    hist = hist.at[flat_idx.reshape(-1)].add(updates.reshape(-1, 3))
+    return hist.reshape(F, B, 3)
+
+
+def build_histogram(
+    binned: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    mask: jax.Array,
+    num_bins: int,
+    method: str = "auto",
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> jax.Array:
+    """Masked histogram [F, B, 3] = sum over rows with mask of (g, h, 1).
+
+    ``mask`` is f32 and may carry bagging weights; leaf membership is encoded
+    by zeroing non-member rows.
+    """
+    vals = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1) * mask[:, None]
+    if method == "auto":
+        platform = jax.default_backend()
+        method = "matmul" if platform in ("tpu", "axon") else "scatter"
+    if method == "matmul":
+        return histogram_matmul(binned, vals, num_bins, block_rows)
+    if method == "matmul_f32":
+        return histogram_matmul_f32(binned, vals, num_bins, block_rows)
+    if method == "scatter":
+        return histogram_scatter(binned, vals, num_bins)
+    raise ValueError(f"unknown histogram method {method!r}")
+
+
+def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
+    """The subtraction trick: sibling = parent - child.
+
+    reference: FeatureHistogram::Subtract (feature_histogram.hpp:79-84).
+    """
+    return parent - child

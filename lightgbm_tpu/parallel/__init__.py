@@ -1,0 +1,1 @@
+"""Distributed tree learners over jax.sharding meshes."""

@@ -1,0 +1,333 @@
+"""scikit-learn API wrappers.
+
+reference: python-package/lightgbm/sklearn.py — LGBMModel (:169),
+LGBMRegressor (:744), LGBMClassifier (:771), LGBMRanker (:913).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster
+from .callback import EarlyStopException
+from .config import Config
+from .dataset import Dataset
+from .engine import train as train_fn
+
+
+class LGBMModel:
+    """Base sklearn-style estimator (reference: sklearn.py:169)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[str] = None, class_weight=None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state=None, n_jobs: int = -1, silent: bool = True,
+                 importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._evals_result: Dict = {}
+        self._best_iteration = -1
+        self._best_score: Dict = {}
+        self._n_features = -1
+        self._classes = None
+        self._n_classes = -1
+        self.set_params(**kwargs)
+
+    # -- sklearn plumbing ----------------------------------------------------
+
+    def get_params(self, deep: bool = True) -> dict:
+        params = {
+            k: getattr(self, k) for k in (
+                "boosting_type", "num_leaves", "max_depth", "learning_rate",
+                "n_estimators", "subsample_for_bin", "objective", "class_weight",
+                "min_split_gain", "min_child_weight", "min_child_samples",
+                "subsample", "subsample_freq", "colsample_bytree", "reg_alpha",
+                "reg_lambda", "random_state", "n_jobs", "silent",
+                "importance_type")
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key) and not key.startswith("_"):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    def _process_params(self, stage: str) -> dict:
+        params = self.get_params()
+        params.pop("silent", None)
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        if callable(self.objective):
+            params["objective"] = "none"
+        elif self.objective is None:
+            params["objective"] = self._default_objective()
+        if self.random_state is not None:
+            params["seed"] = (self.random_state if isinstance(self.random_state, int)
+                              else 0)
+        params.pop("random_state", None)
+        params.pop("n_jobs", None)
+        alias = {
+            "boosting_type": "boosting", "min_split_gain": "min_gain_to_split",
+            "min_child_weight": "min_sum_hessian_in_leaf",
+            "min_child_samples": "min_data_in_leaf", "subsample": "bagging_fraction",
+            "subsample_freq": "bagging_freq", "colsample_bytree": "feature_fraction",
+            "reg_alpha": "lambda_l1", "reg_lambda": "lambda_l2",
+            "subsample_for_bin": "bin_construct_sample_cnt",
+        }
+        for old, new in alias.items():
+            if old in params:
+                params[new] = params.pop(old)
+        if not params.get("verbosity") and self.silent:
+            params["verbosity"] = -1
+        return params
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    # -- fitting -------------------------------------------------------------
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None, eval_group=None,
+            eval_metric=None, early_stopping_rounds=None, verbose=False,
+            feature_name="auto", categorical_feature="auto", callbacks=None,
+            init_model=None) -> "LGBMModel":
+        params = self._process_params("fit")
+        if eval_metric is not None and not callable(eval_metric):
+            params["metric"] = eval_metric
+
+        X = _to_array(X)
+        y = np.asarray(y).reshape(-1)
+        self._n_features = X.shape[1]
+        y_t = self._transform_label(y)
+        if self.class_weight is not None and sample_weight is None:
+            sample_weight = self._class_weights(y_t)
+
+        train_set = Dataset(X, label=y_t, weight=sample_weight, group=group,
+                            init_score=init_score,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature,
+                            params=params, free_raw_data=init_model is None)
+        valid_sets = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vw = eval_sample_weight[i] if eval_sample_weight else None
+                vg = eval_group[i] if eval_group else None
+                vi = eval_init_score[i] if eval_init_score else None
+                if np.asarray(vx).shape == X.shape and np.allclose(
+                        _to_array(vx)[:5], X[:5], equal_nan=True) and \
+                        len(vy) == len(y):
+                    valid_sets.append(train_set)
+                    continue
+                valid_sets.append(Dataset(_to_array(vx),
+                                          label=self._transform_label(np.asarray(vy).reshape(-1)),
+                                          weight=vw, group=vg, init_score=vi,
+                                          reference=train_set, params=params))
+
+        feval = _wrap_eval_metric(eval_metric, self) if callable(eval_metric) else None
+        fobj = _wrap_objective(self.objective) if callable(self.objective) else None
+
+        self._evals_result = {}
+        self._Booster = train_fn(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None, valid_names=eval_names,
+            fobj=fobj, feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=self._evals_result, verbose_eval=verbose,
+            callbacks=callbacks, init_model=init_model)
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        return self
+
+    def _transform_label(self, y):
+        return y.astype(np.float64)
+
+    def _class_weights(self, y):
+        from sklearn.utils.class_weight import compute_sample_weight
+        return compute_sample_weight(self.class_weight, y)
+
+    def predict(self, X, raw_score: bool = False, num_iteration=None,
+                pred_leaf: bool = False, pred_contrib: bool = False, **kwargs):
+        if self._Booster is None:
+            raise ValueError("Estimator not fitted")
+        X = _to_array(X)
+        if X.shape[1] != self._n_features:
+            raise ValueError(f"X has {X.shape[1]} features, expected {self._n_features}")
+        return self._Booster.predict(X, raw_score=raw_score,
+                                     num_iteration=num_iteration,
+                                     pred_leaf=pred_leaf,
+                                     pred_contrib=pred_contrib)
+
+    # -- attributes ----------------------------------------------------------
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise ValueError("No booster found; call fit first")
+        return self._Booster
+
+    @property
+    def best_iteration_(self):
+        return self._best_iteration
+
+    @property
+    def best_score_(self):
+        return self._best_score
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def n_features_(self):
+        return self._n_features
+
+    @property
+    def n_features_in_(self):
+        return self._n_features
+
+    @property
+    def feature_importances_(self):
+        return self.booster_.feature_importance(self.importance_type)
+
+    @property
+    def feature_name_(self):
+        return self.booster_.feature_name()
+
+
+class LGBMRegressor(LGBMModel):
+    """reference: sklearn.py:744."""
+
+    def _default_objective(self):
+        return "regression"
+
+    def score(self, X, y, sample_weight=None):
+        from sklearn.metrics import r2_score
+        return r2_score(y, self.predict(X), sample_weight=sample_weight)
+
+
+class LGBMClassifier(LGBMModel):
+    """reference: sklearn.py:771."""
+
+    def _default_objective(self):
+        return "binary" if (self._n_classes is not None and self._n_classes <= 2) \
+            else "multiclass"
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y).reshape(-1)
+        self._classes, y_enc = np.unique(y, return_inverse=True)
+        self._n_classes = len(self._classes)
+        self._y_encoded = y_enc
+        params_obj = self.objective
+        if params_obj is None:
+            if self._n_classes > 2:
+                self._other_params.setdefault("num_class", self._n_classes)
+                self.objective = "multiclass"
+            else:
+                self.objective = "binary"
+        super().fit(X, y, **kwargs)
+        return self
+
+    def _transform_label(self, y):
+        _, y_enc = np.unique(y, return_inverse=True)
+        return y_enc.astype(np.float64)
+
+    def predict(self, X, raw_score=False, num_iteration=None,
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        result = self.predict_proba(X, raw_score, num_iteration, pred_leaf,
+                                    pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:  # binary probabilities
+            idx = (result > 0.5).astype(int)
+        else:
+            idx = np.argmax(result, axis=1)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score=False, num_iteration=None,
+                      pred_leaf=False, pred_contrib=False, **kwargs):
+        res = super().predict(X, raw_score, num_iteration, pred_leaf,
+                              pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return res
+        if res.ndim == 1:
+            return np.vstack([1.0 - res, res]).T
+        return res
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """reference: sklearn.py:913."""
+
+    def _default_objective(self):
+        return "lambdarank"
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        return super().fit(X, y, group=group, **kwargs)
+
+
+def _to_array(X):
+    if hasattr(X, "values"):
+        return np.ascontiguousarray(X.values, dtype=np.float64)
+    if hasattr(X, "toarray"):
+        return np.ascontiguousarray(X.toarray(), dtype=np.float64)
+    return np.ascontiguousarray(np.asarray(X), dtype=np.float64)
+
+
+def _wrap_objective(func: Callable):
+    def fobj(score, dataset):
+        ret = func(dataset.get_label(), score)
+        if len(ret) == 2:
+            return ret
+        raise ValueError("custom objective must return (grad, hess)")
+    return fobj
+
+
+def _wrap_eval_metric(func: Callable, model):
+    def feval(score, dataset):
+        return func(dataset.get_label(), score)
+    return feval

@@ -1,0 +1,112 @@
+"""Exact TreeSHAP (unique-path algorithm).
+
+reference: src/io/tree.cpp TreeSHAP / Tree::PredictContrib (tree.h:137),
+which implements Lundberg et al.'s algorithm 2.  Host-side NumPy/recursion;
+trees are small so this is fine off the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, f=-1, z=0.0, o=0.0, w=0.0):
+        self.feature_index = f
+        self.zero_fraction = z
+        self.one_fraction = o
+        self.pweight = w
+
+    def copy(self):
+        return _PathElement(self.feature_index, self.zero_fraction,
+                            self.one_fraction, self.pweight)
+
+
+def _extend(path, unique_depth, zero_fraction, one_fraction, feature_index):
+    path.append(_PathElement(feature_index, zero_fraction, one_fraction,
+                             1.0 if unique_depth == 0 else 0.0))
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) / (unique_depth + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind(path, unique_depth, path_index):
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (unique_depth + 1) / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction * (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = path[i].pweight * (unique_depth + 1) / (zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+    path.pop()
+
+
+def _unwound_sum(path, unique_depth, path_index):
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * ((unique_depth - i) / (unique_depth + 1))
+        else:
+            total += path[i].pweight / (zero_fraction * ((unique_depth - i) / (unique_depth + 1)))
+    return total
+
+
+def tree_shap(tree, x: np.ndarray, phi: np.ndarray) -> None:
+    """Accumulate SHAP values of one sample into phi [num_features+1]."""
+
+    def node_count(node):
+        return tree.internal_count[node] if node >= 0 else tree.leaf_count[~node]
+
+    def node_value(node):
+        return tree.internal_value[node] if node >= 0 else tree.leaf_value[~node]
+
+    def recurse(node, path, parent_zero, parent_one, parent_feature):
+        unique_depth = len(path)
+        path = [p.copy() for p in path]
+        _extend(path, unique_depth, parent_zero, parent_one, parent_feature)
+        if node < 0:  # leaf
+            for i in range(1, unique_depth + 1):
+                w = _unwound_sum(path, unique_depth, i)
+                el = path[i]
+                phi[el.feature_index] += w * (el.one_fraction - el.zero_fraction) * node_value(node)
+            return
+        hot = tree.left_child[node] if _goes_left(tree, x, node) else tree.right_child[node]
+        cold = tree.right_child[node] if _goes_left(tree, x, node) else tree.left_child[node]
+        hot_frac = node_count(hot) / max(node_count(node), 1e-30)
+        cold_frac = node_count(cold) / max(node_count(node), 1e-30)
+        incoming_zero, incoming_one = 1.0, 1.0
+        path_index = 0
+        feat = int(tree.split_feature[node])
+        while path_index <= unique_depth:
+            if path[path_index].feature_index == feat:
+                break
+            path_index += 1
+        if path_index != unique_depth + 1:
+            incoming_zero = path[path_index].zero_fraction
+            incoming_one = path[path_index].one_fraction
+            _unwind(path, unique_depth, path_index)
+        recurse(hot, path, hot_frac * incoming_zero, incoming_one, feat)
+        recurse(cold, path, cold_frac * incoming_zero, 0.0, feat)
+
+    recurse(0, [], 1.0, 1.0, -1)
+    # bias term: expected value
+    phi[-1] += tree.expected_value()
+
+
+def _goes_left(tree, x, node):
+    fval = x[tree.split_feature[node]]
+    return bool(np.asarray(tree._decide(np.array([fval]), node))[0])

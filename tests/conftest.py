@@ -1,0 +1,15 @@
+"""Test environment: force a virtual 8-device CPU mesh before JAX imports.
+
+Mirrors SURVEY.md section 4's prescription: multi-host-simulated collective
+tests with one process and 8 XLA CPU devices.  CPU is forced even when the
+session has a real TPU attached so tests are deterministic and parallel-safe;
+bench.py is the TPU entry point.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

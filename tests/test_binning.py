@@ -1,0 +1,107 @@
+"""BinMapper semantics tests (reference: src/io/bin.cpp FindBin)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import BinMapper, BinType, MissingType
+
+
+def test_simple_uniform_binning():
+    rng = np.random.RandomState(0)
+    vals = rng.rand(1000) + 0.5  # all positive, no zeros
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=1000, max_bin=16, min_data_in_bin=3)
+    assert 2 <= m.num_bin <= 16
+    assert m.missing_type == MissingType.NONE
+    bins = m.value_to_bin(vals)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # monotone: larger values -> same or larger bin
+    order = np.argsort(vals)
+    assert (np.diff(bins[order]) >= 0).all()
+
+
+def test_upper_bounds_are_inclusive():
+    m = BinMapper()
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0] * 10)
+    m.find_bin(vals, total_sample_cnt=50, max_bin=5, min_data_in_bin=1)
+    for b in range(m.num_bin - 1):
+        ub = m.bin_upper_bound[b]
+        if np.isfinite(ub):
+            assert m.value_to_bin(np.array([ub]))[0] == b
+            assert m.value_to_bin(np.array([np.nextafter(ub, np.inf)]))[0] == b + 1
+
+
+def test_zero_bin_and_negative():
+    vals = np.array([-2.0, -1.0, 1.0, 2.0] * 25)
+    # 100 stored values of 200 rows -> 100 implicit zeros
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=200, max_bin=10, min_data_in_bin=1)
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    assert m.default_bin == zb
+    assert m.value_to_bin(np.array([-1.0]))[0] < zb
+    assert m.value_to_bin(np.array([1.0]))[0] > zb
+
+
+def test_nan_missing_type():
+    vals = np.array([1.0, 2.0, 3.0, np.nan, np.nan] * 20)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=100, max_bin=10, min_data_in_bin=1)
+    assert m.missing_type == MissingType.NAN
+    assert m.value_to_bin(np.array([np.nan]))[0] == m.num_bin - 1
+
+
+def test_zero_as_missing():
+    vals = np.array([1.0, 2.0, 3.0, 4.0] * 20)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=200, max_bin=10, min_data_in_bin=1,
+               zero_as_missing=True)
+    assert m.missing_type == MissingType.ZERO
+
+
+def test_trivial_feature():
+    vals = np.ones(0)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=100, max_bin=10, min_data_in_bin=1)
+    assert m.is_trivial
+
+
+def test_categorical_count_sort():
+    # category 3 most frequent, then 1, then 7
+    vals = np.array([3.0] * 50 + [1.0] * 30 + [7.0] * 20)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=100, max_bin=10, min_data_in_bin=1,
+               bin_type=BinType.CATEGORICAL)
+    assert m.bin_type == BinType.CATEGORICAL
+    assert m.bin_2_categorical[0] == 3
+    assert m.value_to_bin(np.array([3.0]))[0] == 0
+    assert m.value_to_bin(np.array([1.0]))[0] == 1
+    assert m.value_to_bin(np.array([7.0]))[0] == 2
+    # unseen category -> last bin
+    assert m.value_to_bin(np.array([99.0]))[0] == m.num_bin - 1
+
+
+def test_categorical_zero_not_first_bin():
+    vals = np.array([0.0] * 50 + [1.0] * 30)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=80, max_bin=10, min_data_in_bin=1,
+               bin_type=BinType.CATEGORICAL)
+    # reference avoids bin0 == category 0 (bin.cpp:459-466)
+    assert m.bin_2_categorical[0] != 0
+
+
+def test_min_data_in_bin_respected():
+    vals = np.concatenate([np.full(5, i, float) for i in range(1, 21)])
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=100, max_bin=100, min_data_in_bin=10)
+    # 20 distinct values x5 rows with min 10/bin -> bins hold >= 2 values
+    assert m.num_bin <= 11
+
+
+def test_serialization_roundtrip():
+    rng = np.random.RandomState(1)
+    vals = np.concatenate([rng.randn(500), [np.nan] * 20])
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=600, max_bin=32, min_data_in_bin=3)
+    m2 = BinMapper.from_dict(m.to_dict())
+    test_vals = np.concatenate([rng.randn(100), [np.nan, 0.0]])
+    np.testing.assert_array_equal(m.value_to_bin(test_vals), m2.value_to_bin(test_vals))

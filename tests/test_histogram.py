@@ -1,0 +1,86 @@
+"""Histogram kernel tests: matmul vs scatter vs brute-force NumPy."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import (build_histogram, histogram_matmul,
+                                        histogram_scatter)
+
+
+def brute_force(binned, grad, hess, mask, B):
+    n, F = binned.shape
+    out = np.zeros((F, B, 3), np.float64)
+    for i in range(n):
+        for f in range(F):
+            b = binned[i, f]
+            out[f, b, 0] += grad[i] * mask[i]
+            out[f, b, 1] += hess[i] * mask[i]
+            out[f, b, 2] += mask[i]
+    return out
+
+
+@pytest.mark.parametrize("method", ["scatter", "matmul", "matmul_f32"])
+def test_histogram_matches_brute_force(method):
+    rng = np.random.RandomState(0)
+    n, F, B = 500, 7, 16
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = rng.rand(n).astype(np.float32)
+    mask = (rng.rand(n) < 0.7).astype(np.float32)
+    expect = brute_force(binned, grad, hess, mask, B)
+    got = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                                     jnp.asarray(hess), jnp.asarray(mask),
+                                     B, method=method))
+    np.testing.assert_allclose(got, expect, rtol=2e-2, atol=2e-2)
+
+
+def test_histogram_scatter_exact():
+    rng = np.random.RandomState(1)
+    n, F, B = 300, 4, 8
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    mask = np.ones(n, np.float32)
+    expect = brute_force(binned, grad, hess, mask, B)
+    got = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                                     jnp.asarray(hess), jnp.asarray(mask),
+                                     B, method="scatter"))
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_matmul_block_boundary():
+    # n not a multiple of the block size must still be correct
+    rng = np.random.RandomState(2)
+    n, F, B = 100, 3, 4
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    mask = np.ones(n, np.float32)
+    a = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                                   jnp.asarray(hess), jnp.asarray(mask),
+                                   B, method="matmul", ))
+    b = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                                   jnp.asarray(hess), jnp.asarray(mask),
+                                   B, method="scatter"))
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+
+
+def test_subtraction_trick():
+    rng = np.random.RandomState(3)
+    n, F, B = 400, 5, 16
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    left = (rng.rand(n) < 0.5).astype(np.float32)
+    full = build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                           jnp.asarray(hess), jnp.ones(n, jnp.float32), B,
+                           method="scatter")
+    hl = build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                         jnp.asarray(hess), jnp.asarray(left), B,
+                         method="scatter")
+    hr = np.asarray(full) - np.asarray(hl)
+    expect = brute_force(binned, grad, hess, 1.0 - left, B)
+    np.testing.assert_allclose(hr, expect, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,162 @@
+"""Split search + tree grower vs brute-force NumPy reference."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.dataset import FeatureMeta
+from lightgbm_tpu.binning import BinMapper, MissingType
+from lightgbm_tpu.grower import (GrowerConfig, grow_tree,
+                                 predict_leaf_index_binned, predict_tree_binned)
+from lightgbm_tpu.ops.histogram import build_histogram
+from lightgbm_tpu.ops.split import SplitHyperparams, best_split_for_leaf
+
+
+def _meta(num_bins, F):
+    return FeatureMeta(
+        num_bin=np.full(F, num_bins, np.int32),
+        missing_type=np.zeros(F, np.int32),
+        default_bin=np.zeros(F, np.int32),
+        most_freq_bin=np.zeros(F, np.int32),
+        is_categorical=np.zeros(F, bool),
+        max_num_bin=num_bins,
+    )
+
+
+def brute_force_best_split(binned, grad, hess, hp: SplitHyperparams):
+    """Exhaustive split search directly over rows (no histograms)."""
+    n, F = binned.shape
+    G, H = grad.sum(), hess.sum()
+    parent_gain = G * G / (H + hp.lambda_l2 + 2e-15)
+    best = (-np.inf, -1, -1)
+    for f in range(F):
+        for t in range(binned[:, f].max()):
+            left = binned[:, f] <= t
+            gl, hl = grad[left].sum(), hess[left].sum()
+            gr, hr = G - gl, H - hl
+            nl, nr = left.sum(), n - left.sum()
+            if nl < hp.min_data_in_leaf or nr < hp.min_data_in_leaf:
+                continue
+            if hl < hp.min_sum_hessian_in_leaf or hr < hp.min_sum_hessian_in_leaf:
+                continue
+            gain = gl * gl / (hl + hp.lambda_l2 + 1e-15) + \
+                gr * gr / (hr + hp.lambda_l2 + 1e-15)
+            if gain > best[0] + 1e-9:
+                best = (gain, f, t)
+    return best
+
+
+def test_best_split_matches_brute_force():
+    rng = np.random.RandomState(0)
+    n, F, B = 800, 5, 16
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = (rng.randn(n) + 0.3 * (binned[:, 2] > 7)).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    hp = SplitHyperparams(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+
+    hist = build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                           jnp.asarray(hess), jnp.ones(n, jnp.float32), B,
+                           method="scatter")
+    meta = _meta(B, F)
+    r = best_split_for_leaf(
+        hist, jnp.float32(grad.sum()), jnp.float32(hess.sum()),
+        jnp.float32(n), jnp.asarray(meta.num_bin), jnp.asarray(meta.missing_type),
+        jnp.asarray(meta.default_bin), jnp.asarray(meta.is_categorical), hp)
+    bf_gain, bf_f, bf_t = brute_force_best_split(binned, grad.astype(np.float64),
+                                                 hess.astype(np.float64), hp)
+    assert int(r.feature) == bf_f
+    assert int(r.threshold) == bf_t
+    parent_gain = grad.sum() ** 2 / (hess.sum() + 2e-15)
+    np.testing.assert_allclose(float(r.gain), bf_gain - parent_gain, rtol=1e-3)
+
+
+def test_min_data_in_leaf_enforced():
+    rng = np.random.RandomState(1)
+    n, F, B = 100, 3, 8
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=31, hp=SplitHyperparams(min_data_in_leaf=30),
+                       num_bins=B, hist_method="scatter")
+    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                              jnp.asarray(hess), jnp.ones(n, jnp.float32),
+                              meta, cfg)
+    nl = int(tree.num_leaves)
+    counts = np.asarray(tree.leaf_count[:nl])
+    assert (counts >= 30).all()
+
+
+def test_grower_leaf_ids_match_traversal():
+    rng = np.random.RandomState(2)
+    n, F, B = 600, 6, 32
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = (rng.randn(n) + (binned[:, 0] / B)).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=15, hp=SplitHyperparams(min_data_in_leaf=5),
+                       num_bins=B, hist_method="scatter")
+    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                              jnp.asarray(hess), jnp.ones(n, jnp.float32),
+                              meta, cfg)
+    routed = predict_leaf_index_binned(tree, jnp.asarray(binned), meta)
+    np.testing.assert_array_equal(np.asarray(leaf_id), np.asarray(routed))
+
+
+def test_leaf_values_are_newton_steps():
+    rng = np.random.RandomState(3)
+    n, F, B = 500, 4, 16
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = _meta(B, F)
+    lam = 0.5
+    cfg = GrowerConfig(num_leaves=8,
+                       hp=SplitHyperparams(min_data_in_leaf=10, lambda_l2=lam),
+                       num_bins=B, hist_method="scatter")
+    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                              jnp.asarray(hess), jnp.ones(n, jnp.float32),
+                              meta, cfg)
+    lid = np.asarray(leaf_id)
+    nl = int(tree.num_leaves)
+    for l in range(nl):
+        rows = lid == l
+        if rows.sum() == 0:
+            continue
+        expect = -grad[rows].sum() / (hess[rows].sum() + lam)
+        np.testing.assert_allclose(float(tree.leaf_value[l]), expect,
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_max_depth_limit():
+    rng = np.random.RandomState(4)
+    n, F, B = 500, 5, 16
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=31, max_depth=2,
+                       hp=SplitHyperparams(min_data_in_leaf=1),
+                       num_bins=B, hist_method="scatter")
+    tree, _ = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                        jnp.asarray(hess), jnp.ones(n, jnp.float32), meta, cfg)
+    assert int(tree.num_leaves) <= 4
+    assert int(np.asarray(tree.leaf_depth)[:int(tree.num_leaves)].max()) <= 2
+
+
+def test_predict_tree_binned_values():
+    rng = np.random.RandomState(5)
+    n, F, B = 300, 3, 8
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=6, hp=SplitHyperparams(min_data_in_leaf=10),
+                       num_bins=B, hist_method="scatter")
+    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                              jnp.asarray(hess), jnp.ones(n, jnp.float32),
+                              meta, cfg)
+    vals = np.asarray(predict_tree_binned(tree, jnp.asarray(binned), meta))
+    lv = np.asarray(tree.leaf_value)
+    np.testing.assert_allclose(vals, lv[np.asarray(leaf_id)], rtol=1e-6)
