@@ -1,0 +1,102 @@
+"""Driver benchmark: HIGGS-scale GBDT training wall-clock on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors the reference's headline experiment (docs/Experiments.rst:
+500 trees, 255 leaves, lr=0.1; GPU-comparable max_bin=63 per
+docs/GPU-Performance.rst guidance) on a synthetic dataset with HIGGS's shape
+(11M x 28 dense float features, binary labels).  HIGGS itself cannot be
+downloaded in this environment (zero egress), so the data is synthetic with
+label structure (linear + pairwise signal, 20% noise) to keep trees growing
+to the leaf budget as on real data.
+
+Baseline: 130.094 s — LightGBM CPU on 2x Xeon E5-2690 v4
+(docs/Experiments.rst:114).  vs_baseline = baseline_seconds / our_seconds
+(>1 means faster than the reference).
+
+Timing excludes binning/dataset construction (as does the reference's
+experiment, which times the training phase) and excludes the one-time XLA
+compile: the clock starts after iteration 1 and the total is rescaled by
+T/(T-1).
+
+Env overrides for local/quick runs: BENCH_ROWS, BENCH_TREES, BENCH_LEAVES,
+BENCH_BIN.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_SECONDS = 130.094
+
+N = int(os.environ.get("BENCH_ROWS", 11_000_000))
+F = 28
+TREES = int(os.environ.get("BENCH_TREES", 500))
+LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+MAX_BIN = int(os.environ.get("BENCH_BIN", 63))
+
+
+def make_higgs_like(n, f, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32)
+    signal = X @ w
+    signal += 2.0 * X[:, 0] * X[:, 1] - 1.5 * (X[:, 2] > 0.5) * X[:, 3]
+    signal += rng.randn(n).astype(np.float32) * 0.2 * signal.std()
+    y = (signal > np.median(signal)).astype(np.float32)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(N, F)
+    params = {
+        "objective": "binary",
+        "num_leaves": LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": MAX_BIN,
+        "metric": "None",
+        "verbosity": -1,
+    }
+    train_set = lgb.Dataset(X, label=y)
+    train_set.construct()          # binning happens here, outside the clock
+    del X
+
+    booster = lgb.Booster(params=params, train_set=train_set)
+    booster.update()               # iteration 1: triggers XLA compile
+    import jax
+    jax.block_until_ready(booster.boosting.train_score)
+
+    t0 = time.perf_counter()
+    for _ in range(TREES - 1):
+        booster.update()
+    jax.block_until_ready(booster.boosting.train_score)
+    elapsed = (time.perf_counter() - t0) * TREES / max(TREES - 1, 1)
+
+    # sanity: training must actually have learned something
+    Xh, yh = make_higgs_like(200_000, F, seed=1)
+    pred = booster.predict(Xh)
+    order = np.argsort(pred)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(pred) + 1)
+    npos = yh.sum()
+    auc = (ranks[yh > 0].sum() - npos * (npos + 1) / 2) / (npos * (len(yh) - npos))
+
+    result = {
+        "metric": f"synthetic-HIGGS {N}x{F} train wall-clock, "
+                  f"{TREES} trees x {LEAVES} leaves, max_bin={MAX_BIN} "
+                  f"(holdout AUC {auc:.4f})",
+        "value": round(elapsed, 3),
+        "unit": "seconds",
+        "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

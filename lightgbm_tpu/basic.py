@@ -64,7 +64,8 @@ class Booster:
         self.boosting = create_boosting(self.config, train_set, self.objective)
         # resolve metrics
         names = self.config.metric or self.config.default_metric()
-        self._metric_names = [m for m in names if m != "none"]
+        self._metric_names = [m for m in names
+                              if m.lower() not in ("none", "na", "null", "custom")]
         train_metrics = []
         for m in self._metric_names:
             mt = create_metric(m, self.config)
@@ -118,7 +119,10 @@ class Booster:
         self.config.update(params)
         if self.boosting is not None:
             self.boosting.shrinkage_rate = self.config.learning_rate
-            self.boosting._build_jit_fns()
+            # learning_rate is a traced scalar in the jitted step, so a
+            # per-iteration lr schedule must NOT trigger a rebuild/recompile
+            if set(params) - {"learning_rate"}:
+                self.boosting._build_jit_fns()
         return self
 
     # ------------------------------------------------------------------ eval
@@ -179,7 +183,8 @@ class Booster:
         models = self.models
         n_total_iter = len(models) // max(K, 1)
         if num_iteration is None or num_iteration < 0:
-            num_iteration = (self.best_iteration + 1
+            # best_iteration is already a 1-based count of iterations to keep
+            num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else n_total_iter)
         stop_iter = min(start_iteration + num_iteration, n_total_iter)
 

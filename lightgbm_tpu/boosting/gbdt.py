@@ -118,23 +118,45 @@ class GBDT:
 
     def _build_jit_fns(self) -> None:
         K = self.num_tree_per_iteration
+        # re-derive the grower config so reset_parameter() of tree
+        # hyper-parameters (lambda_l1, min_data_in_leaf, ...) takes effect
+        self.grower_cfg = GrowerConfig(
+            num_leaves=self.config.num_leaves,
+            max_depth=self.config.max_depth,
+            hp=self.config.split_hyperparams(),
+            hist_method=self.config.tpu_hist_method,
+            num_bins=self.num_bins,
+            learning_rate=self.config.learning_rate,
+        )
         cfg = self.grower_cfg
         obj = self.objective
-        lr = self.shrinkage_rate
         renew_pct = obj.renew_percentile if obj is not None else None
         weight = (jnp.asarray(self.train_set.metadata.weight)
                   if self.train_set.metadata.weight is not None else None)
         label = (jnp.asarray(self.train_set.metadata.label)
                  if obj is not None and obj.renew_percentile is not None else None)
+        mc = self.config.monotone_constraints
+        if mc:
+            # align per-original-feature constraints with the used (binned)
+            # feature columns — trivial features are dropped at binning
+            mc_full = np.zeros(self.train_set.num_total_features, np.int32)
+            mc_full[:len(mc)] = np.asarray(mc, np.int32)
+            mc = jnp.asarray(mc_full[self.train_set.used_features])
+        else:
+            mc = None
 
-        def one_iter(score, row_mask, grad, hess):
-            """grad/hess: [K, n].  Returns (new_score, stacked trees, leaf_ids)."""
+        def one_iter(score, row_mask, grad, hess, fmask, lr):
+            """grad/hess: [K, n]; fmask: [K, F] col-sample masks; lr: traced
+            scalar so a learning_rates schedule never recompiles.
+            Returns (new_score, stacked trees, leaf_ids)."""
             trees = []
             leaf_ids = []
             new_score = score
             for k in range(K):
                 tree, leaf_id = grow_tree(self.binned, grad[k], hess[k],
-                                          row_mask, self.meta, cfg)
+                                          row_mask, self.meta, cfg,
+                                          feature_mask=fmask[k],
+                                          monotone_constraints=mc)
                 if renew_pct is not None:
                     residual = label - new_score[k]
                     w = row_mask if weight is None else row_mask * weight
@@ -154,6 +176,10 @@ class GBDT:
             return new_score, stacked, jnp.stack(leaf_ids)
 
         self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
+        if not hasattr(self, "_feature_rng"):  # survive jit-fn rebuilds
+            self._feature_rng = np.random.RandomState(
+                self.config.feature_fraction_seed)
+        self._ones_fmask = None
 
         def gradients_fn(score):
             if obj is None:
@@ -204,6 +230,22 @@ class GBDT:
 
     _cur_mask = None
 
+    def _feature_masks(self) -> jax.Array:
+        """Per-tree column sampling (reference: ColSampler by-tree,
+        src/treelearner/col_sampler.hpp:19)."""
+        K = self.num_tree_per_iteration
+        F = self.train_set.binned.shape[1]
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            if self._ones_fmask is None:
+                self._ones_fmask = jnp.ones((K, F), jnp.float32)
+            return self._ones_fmask
+        cnt = max(1, int(round(F * frac)))
+        masks = np.zeros((K, F), np.float32)
+        for k in range(K):
+            masks[k, self._feature_rng.choice(F, size=cnt, replace=False)] = 1.0
+        return jnp.asarray(masks)
+
     def _boost(self, score) -> Tuple[jax.Array, jax.Array]:
         return self._gradients_fn(score)
 
@@ -238,9 +280,15 @@ class GBDT:
         mask = self._bagging_mask(self.iter)
 
         self.train_score, stacked, leaf_ids = self._iter_fn(
-            self.train_score, mask, grad, hess)
+            self.train_score, mask, grad, hess, self._feature_masks(),
+            jnp.float32(self.shrinkage_rate))
+        return self._finish_iter(stacked)
 
-        # host copies (tiny), bias folding for the first iteration
+    def _finish_iter(self, stacked) -> bool:
+        """Post-step bookkeeping shared by GBDT/GOSS/DART/RF: host copies of
+        the (tiny) tree arrays, first-iteration bias folding, valid-score
+        updates.  Returns True when training should stop."""
+        K = self.num_tree_per_iteration
         new_models = []
         should_continue = False
         for k in range(K):
@@ -324,20 +372,3 @@ class GBDT:
     def current_iteration(self) -> int:
         return self.iter
 
-    def feature_importance(self, importance_type: str = "split",
-                           iteration: int = -1) -> np.ndarray:
-        """reference: GBDT::FeatureImportance (boosting.h:229)."""
-        F = self.train_set.num_total_features
-        imp = np.zeros(F, np.float64)
-        K = self.num_tree_per_iteration
-        stop = len(self.models) if iteration < 0 else iteration * K
-        for ht in self.models[:stop]:
-            for s in range(ht.num_leaves - 1):
-                f = ht.real_feature_index[s] if s < len(ht.real_feature_index) else -1
-                if f < 0:
-                    continue
-                if importance_type == "split":
-                    imp[f] += 1.0
-                else:
-                    imp[f] += max(ht.split_gain[s], 0.0)
-        return imp

@@ -60,24 +60,7 @@ class GOSS(GBDT):
         return super().train_one_iter(grad, hess)
 
     def _train_with(self, grad, hess, mask):
-        K = self.num_tree_per_iteration
         self.train_score, stacked, leaf_ids = self._iter_fn(
-            self.train_score, mask, grad, hess)
-        from ..tree import tree_to_host
-        import numpy as np
-        new_models = []
-        should_continue = False
-        for k in range(K):
-            tree_k = jax.tree_util.tree_map(lambda x: np.asarray(x[k]), stacked)
-            ht = tree_to_host(tree_k, self.train_set, self.shrinkage_rate)
-            if ht.num_leaves > 1:
-                should_continue = True
-            new_models.append(ht)
-        if not should_continue:
-            return True
-        self.models.extend(new_models)
-        for i in range(len(self.valid_scores)):
-            self.valid_scores[i] = self._valid_update(
-                self.valid_scores[i], stacked, self.valid_binned[i])
-        self.iter += 1
-        return False
+            self.train_score, mask, grad, hess, self._feature_masks(),
+            jnp.float32(self.shrinkage_rate))
+        return self._finish_iter(stacked)

@@ -276,11 +276,24 @@ class Dataset:
         sub.raw_data = None
         sub.reference = self
         sub.free_raw_data = True
+        qb = None
+        if self.metadata.query_boundaries is not None:
+            # rows of one query must stay contiguous in the subset (true for
+            # group-aware fold splits); rebuild boundaries from run-lengths
+            gid = np.searchsorted(self.metadata.query_boundaries, idx,
+                                  side="right") - 1
+            if np.any(np.diff(gid) < 0):
+                raise ValueError(
+                    "subset() of grouped (ranking) data requires used_indices "
+                    "to keep each query's rows contiguous and in order")
+            change = np.flatnonzero(np.diff(gid)) + 1
+            qb = np.concatenate([[0], change, [len(idx)]]).astype(np.int32)
         sub.metadata = Metadata(
             label=None if self.metadata.label is None else self.metadata.label[idx],
             weight=None if self.metadata.weight is None else self.metadata.weight[idx],
             init_score=None if self.metadata.init_score is None else
             np.asarray(self.metadata.init_score).reshape(self.num_data, -1)[idx].reshape(-1),
+            query_boundaries=qb,
         )
         sub._feature_name_param = self.feature_names
         sub._categorical_feature_param = self._categorical_feature_param

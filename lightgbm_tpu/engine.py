@@ -83,6 +83,7 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     cbs_before = sorted(cbs_before, key=lambda cb: getattr(cb, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda cb: getattr(cb, "order", 0))
 
+    evaluation_result_list = []
     for i in range(num_boost_round):
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
@@ -162,6 +163,23 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
                                 y=full_data.get_label(), groups=group)
         return list(folds)
     rng = np.random.RandomState(seed)
+    qb = full_data.metadata.query_boundaries
+    if qb is not None:
+        # ranking: split whole queries across folds (reference: engine.py:301
+        # GroupKFold over the flattened group array); rows of each query stay
+        # contiguous and in order, as Dataset.subset() requires
+        nq = len(qb) - 1
+        q_idx = np.arange(nq)
+        if shuffle:
+            rng.shuffle(q_idx)
+        q_chunks = np.array_split(q_idx, nfold)
+
+        def rows(qs):
+            qs = np.sort(qs)
+            return np.concatenate([np.arange(qb[q], qb[q + 1]) for q in qs])
+
+        return [(rows(np.concatenate([c for j, c in enumerate(q_chunks) if j != i])),
+                 rows(q_chunks[i])) for i in range(nfold)]
     if stratified:
         from sklearn.model_selection import StratifiedKFold
         skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,

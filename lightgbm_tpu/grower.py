@@ -176,6 +176,7 @@ def grow_tree(
     feature_mask: Optional[jax.Array] = None,   # [F] per-tree col sample
     axis_name: Optional[str] = None,            # mesh axis sharding ROWS
     feature_axis_name: Optional[str] = None,    # mesh axis sharding FEATURES
+    monotone_constraints: Optional[jax.Array] = None,  # [F] i32 in {-1,0,1}
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32).
 
@@ -220,7 +221,9 @@ def grow_tree(
     def leaf_best(hist, sg, sh, cnt, depth):
         r = best_split_for_leaf(
             hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
-            hp, feature_mask=feature_mask, has_categorical=has_cat)
+            hp, feature_mask=feature_mask,
+            monotone_constraints=monotone_constraints,
+            has_categorical=has_cat)
         # depth limit (reference: serial_tree_learner.cpp:261-301 pruning)
         if cfg.max_depth > 0:
             r = r._replace(gain=jnp.where(depth >= cfg.max_depth, -jnp.inf, r.gain))

@@ -405,7 +405,8 @@ def create_metric(name: str, config: Config) -> Optional[Metric]:
     """reference: Metric::CreateMetric (src/metric/metric.cpp:17)."""
     from .config import _METRIC_ALIASES
     name = _METRIC_ALIASES.get(name, name)
-    if name in ("none",):
+    # reference: "na"/"null"/"custom" disable built-in metrics (metric.cpp:17)
+    if name.lower() in ("none", "na", "null", "custom"):
         return None
     if name not in _REGISTRY:
         raise ValueError(f"unknown metric {name!r}")

@@ -283,18 +283,24 @@ def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin, hp
     pg, ph, pc = jnp.cumsum(sg, 1), jnp.cumsum(sh, 1), jnp.cumsum(sc, 1)
     k_idx = jnp.arange(B, dtype=jnp.int32)[None, :]
     max_k = jnp.minimum(hp.max_cat_threshold, B)
+    n_usable = jnp.sum(s_usable, axis=1).astype(jnp.int32)[:, None]  # [F, 1]
 
     def scan_dir(from_low: bool):
         if from_low:
             clg, clh, clc = pg, ph + K_EPSILON, pc
+            # left set = sorted[0..k]: size k+1 bounded by max_cat_threshold
+            size_ok = k_idx < max_k
         else:
             clg = pg[:, -1:] - pg
             clh = ph[:, -1:] - ph + K_EPSILON
             clc = pc[:, -1:] - pc
+            # left set = sorted[k+1..]: bound the SUFFIX size, not k itself
+            left_size = n_usable - 1 - k_idx
+            size_ok = (left_size <= max_k) & (left_size >= 1)
         crg, crh, crc = total_g - clg, total_h - clh, num_data - clc
         okd = ((clc >= hp.min_data_in_leaf) & (crc >= hp.min_data_in_leaf)
                & (clh >= hp.min_sum_hessian_in_leaf) & (crh >= hp.min_sum_hessian_in_leaf)
-               & (k_idx < max_k))
+               & size_ok)
         gn = leaf_gain(clg, clh, hp.lambda_l1, l2) + leaf_gain(crg, crh, hp.lambda_l1, l2)
         gn = jnp.where(okd & (gn > min_gain_shift), gn, K_MIN_SCORE)
         kk = jnp.argmax(gn, axis=1)
