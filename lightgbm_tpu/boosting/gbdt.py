@@ -127,6 +127,7 @@ class GBDT:
             hist_method=self.config.tpu_hist_method,
             num_bins=self.num_bins,
             learning_rate=self.config.learning_rate,
+            compact=self.config.tpu_compact_hist,
         )
         cfg = self.grower_cfg
         obj = self.objective

@@ -169,6 +169,9 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
         # GroupKFold over the flattened group array); rows of each query stay
         # contiguous and in order, as Dataset.subset() requires
         nq = len(qb) - 1
+        if nfold > nq:
+            raise ValueError(
+                f"nfold={nfold} exceeds the number of query groups ({nq})")
         q_idx = np.arange(nq)
         if shuffle:
             rng.shuffle(q_idx)

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .dataset import FeatureMeta
-from .ops.histogram import build_histogram
+from .ops.histogram import (build_histogram, capacity_schedule,
+                            compacted_histogram)
 from .ops.split import (MAX_CAT_WORDS, SplitHyperparams, SplitResult,
                         best_split_for_leaf, leaf_output)
 
@@ -135,6 +136,8 @@ class GrowerConfig(NamedTuple):
     hist_method: str = "auto"
     num_bins: int = 255            # padded bin axis B
     learning_rate: float = 0.1
+    compact: bool = True           # bucketed leaf-row compaction (see
+                                   # ops/histogram.py capacity_schedule)
 
 
 def _psum(x, axis_name):
@@ -217,6 +220,9 @@ def grow_tree(
     has_cat = bool(meta.is_categorical.any())
 
     hist_fn = functools.partial(build_histogram, num_bins=B, method=cfg.hist_method)
+    # full-n first capacity: the "smaller" child is chosen by WEIGHTED count
+    # (GOSS amplifies weights), so its raw row count may exceed n/2
+    caps = capacity_schedule(n) if cfg.compact else [n]
 
     def leaf_best(hist, sg, sh, cnt, depth):
         r = best_split_for_leaf(
@@ -346,9 +352,16 @@ def grow_tree(
         # -- histograms: masked pass for smaller child, subtraction for sibling
         left_smaller = lc <= rc
         small_leaf = jnp.where(left_smaller, leaf, new_leaf)
-        small_mask = row_mask * (leaf_id == small_leaf)
         parent_hist = c.hist[leaf]
-        small_hist = _psum(hist_fn(binned, grad, hess, small_mask), axis_name)
+        small_member = leaf_id == small_leaf
+        if cfg.compact and len(caps) > 1:
+            small_hist = _psum(
+                compacted_histogram(binned, grad, hess, row_mask, small_member,
+                                    B, caps, method=cfg.hist_method),
+                axis_name)
+        else:
+            small_hist = _psum(
+                hist_fn(binned, grad, hess, row_mask * small_member), axis_name)
         large_hist = parent_hist - small_hist
         hist_l = jnp.where(left_smaller, small_hist, large_hist)
         hist_r = jnp.where(left_smaller, large_hist, small_hist)

@@ -139,6 +139,73 @@ def build_histogram(
     raise ValueError(f"unknown histogram method {method!r}")
 
 
+def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS) -> list:
+    """Descending power-of-two-ish capacities n, n/2, ... >= min_cap.
+
+    Trace-time constants for the bucketed compaction below.  The smaller
+    child of a split never exceeds n/2 rows, and leaf sizes shrink roughly
+    geometrically in leaf-wise growth, so per-tree histogram work drops from
+    O(n * num_leaves) (full masked pass per split) to ~O(n * log(num_leaves))
+    — the same asymptotic the reference gets from per-leaf ordered gradients
+    (src/io/dataset.cpp:1318-1333) without data-dependent shapes.
+    """
+    caps = []
+    c = _pad_rows(n, min_cap)
+    while c >= min_cap:
+        caps.append(c)
+        if c == min_cap:
+            break
+        c = _pad_rows((c + 1) // 2, min_cap)
+        if caps and c == caps[-1]:
+            break
+    if not caps:
+        caps = [_pad_rows(max(n, 1), min_cap)]
+    return caps
+
+
+def compacted_histogram(
+    binned: jax.Array,       # [n, F]
+    grad: jax.Array,         # [n]
+    hess: jax.Array,         # [n]
+    weights: jax.Array,      # [n] f32 bagging/GOSS weights
+    member: jax.Array,       # [n] bool leaf membership
+    num_bins: int,
+    caps: list,              # static descending capacities from capacity_schedule
+    method: str = "auto",
+) -> jax.Array:
+    """Masked histogram restricted to `member` rows via gather compaction.
+
+    The member row-ids are compacted into the smallest static capacity that
+    fits (lax.switch over precompiled bucket sizes); the histogram kernel
+    then runs over `cap` rows instead of n.  Returns [F, B, 3] f32.
+    """
+    n, F = binned.shape
+    # zero-weight rows (bagged-out / GOSS-dropped) contribute nothing, so
+    # exclude them from compaction too — same result, tighter capacity
+    member = member & (weights > 0)
+    count = jnp.sum(member)
+
+    def branch(cap: int):
+        def run():
+            idx = jnp.nonzero(member, size=cap, fill_value=n)[0]
+            valid = idx < n
+            idxc = jnp.minimum(idx, n - 1)
+            rows = jnp.take(binned, idxc, axis=0)
+            w = jnp.where(valid, jnp.take(weights, idxc), 0.0)
+            g = jnp.take(grad, idxc)
+            h = jnp.take(hess, idxc)
+            return build_histogram(rows, g, h, w, num_bins, method=method)
+        return run
+
+    if len(caps) == 1:
+        return build_histogram(binned, grad, hess,
+                               weights * member, num_bins, method=method)
+    caps_arr = jnp.asarray(caps, jnp.int32)
+    # smallest capacity >= count (caps[0] >= n covers everything)
+    bucket = jnp.sum(caps_arr >= count) - 1
+    return lax.switch(bucket, [branch(c) for c in caps])
+
+
 def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """The subtraction trick: sibling = parent - child.
 

@@ -160,7 +160,8 @@ def test_goss():
     lgb.train(params, train, num_boost_round=30,
               valid_sets=[lgb.Dataset(X, label=y, reference=train)],
               evals_result=evals, verbose_eval=False)
-    assert evals["valid_0"]["auc"][-1] > 0.85
+    # measured 0.8679; sklearn HistGBM plateau on this data is ~0.883
+    assert evals["valid_0"]["auc"][-1] > 0.86
 
 
 def test_bagging():
@@ -172,7 +173,7 @@ def test_bagging():
     lgb.train(params, train, num_boost_round=30,
               valid_sets=[lgb.Dataset(X, label=y, reference=train)],
               evals_result=evals, verbose_eval=False)
-    assert evals["valid_0"]["auc"][-1] > 0.85
+    assert evals["valid_0"]["auc"][-1] > 0.87   # measured 0.8817
 
 
 def test_model_save_load_roundtrip(tmp_path, binary_data):
@@ -228,7 +229,7 @@ def test_weights():
     lgb.train(params, train, num_boost_round=20,
               valid_sets=[lgb.Dataset(X, label=y, weight=w, reference=train)],
               evals_result=evals, verbose_eval=False)
-    assert evals["valid_0"]["auc"][-1] > 0.85
+    assert evals["valid_0"]["auc"][-1] > 0.85   # measured 0.8574
 
 
 def test_cv():

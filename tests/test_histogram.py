@@ -84,3 +84,28 @@ def test_subtraction_trick():
     hr = np.asarray(full) - np.asarray(hl)
     expect = brute_force(binned, grad, hess, 1.0 - left, B)
     np.testing.assert_allclose(hr, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_compacted_histogram_matches_masked():
+    """Bucketed compaction must be numerically identical to the full
+    masked pass (ops/histogram.py compacted_histogram)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import (build_histogram,
+                                            capacity_schedule,
+                                            compacted_histogram)
+    rng = np.random.RandomState(42)
+    n, F, B = 10_000, 6, 16
+    binned = jnp.asarray(rng.randint(0, B, size=(n, F)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.asarray(rng.rand(n).astype(np.float32))
+    weights = jnp.asarray((rng.rand(n) < 0.8).astype(np.float32) * 1.5)
+    caps = capacity_schedule(n, min_cap=256)
+    assert len(caps) > 3
+    for frac in (0.001, 0.3, 0.9):   # exercises several capacity buckets
+        member = jnp.asarray(rng.rand(n) < frac)
+        full = build_histogram(binned, grad, hess,
+                               weights * member, B, method="scatter")
+        comp = compacted_histogram(binned, grad, hess, weights, member, B,
+                                   caps, method="scatter")
+        np.testing.assert_allclose(np.asarray(comp), np.asarray(full),
+                                   rtol=1e-5, atol=1e-5)
