@@ -1,0 +1,8 @@
+"""CLI entry: ``python -m lightgbm_tpu config=train.conf [key=value ...]``.
+
+reference: src/main.cpp:11.
+"""
+from .application import main
+
+if __name__ == "__main__":
+    main()

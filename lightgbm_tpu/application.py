@@ -1,0 +1,161 @@
+"""CLI application: config-file driven train / predict.
+
+reference: src/application/application.cpp — LoadParameters (:49),
+LoadData (:84), InitTrain (:164), Train (:201), Predict (:212), driven by
+``task=`` (src/main.cpp:11).  Usage mirrors the reference CLI:
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+
+Config files are ``key = value`` lines with ``#`` comments; command-line
+pairs override file entries (reference application.cpp:49-82).  Relative
+data paths resolve against the config file's directory so the stock
+``examples/*/train.conf`` files run unchanged; outputs go to the CWD.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .engine import train as train_fn
+from .utils.log import log_info
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.split("#", 1)[0].strip()
+            if not ln or "=" not in ln:
+                continue
+            k, v = ln.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_argv(argv: List[str]) -> Dict[str, str]:
+    """key=value pairs; ``config=`` pulls in a config file (CLI wins)."""
+    cli: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            raise SystemExit(f"unknown argument {a!r}; expected key=value")
+        k, v = a.split("=", 1)
+        cli[k.strip()] = v.strip()
+    params: Dict[str, str] = {}
+    cfg_path = cli.get("config", cli.get("config_file"))
+    if cfg_path:
+        params.update(parse_config_file(cfg_path))
+        params["__config_dir__"] = os.path.dirname(os.path.abspath(cfg_path))
+    params.update(cli)
+    return params
+
+
+def _resolve(path: str, params: Dict[str, str]) -> str:
+    if os.path.isabs(path) or os.path.exists(path):
+        return path
+    base = params.get("__config_dir__", "")
+    cand = os.path.join(base, path)
+    return cand if os.path.exists(cand) else path
+
+
+class Application:
+    """reference: class Application (src/application/application.h)."""
+
+    def __init__(self, argv: List[str]):
+        self.params = parse_argv(argv)
+        self.task = self.params.get("task", "train")
+
+    def run(self) -> None:
+        if self.task in ("train", "refit", "refit_tree"):
+            self.train()
+        elif self.task in ("predict", "prediction", "test"):
+            self.predict()
+        elif self.task == "convert_model":
+            self.convert_model()
+        else:
+            raise SystemExit(f"unknown task {self.task!r}")
+
+    # ------------------------------------------------------------------ train
+
+    def train(self) -> None:
+        p = dict(self.params)
+        data_path = p.pop("data", None)
+        if not data_path:
+            raise SystemExit("no training data: set data=...")
+        valid_paths = [v for v in p.pop("valid_data",
+                                        p.pop("valid", "")).split(",") if v]
+        output_model = p.pop("output_model", "LightGBM_model.txt")
+        input_model = p.pop("input_model", None)
+        p.pop("__config_dir__", None)
+
+        cfg = Config.from_params(p)
+        train_set = Dataset(_resolve(data_path, self.params), params=p)
+        valid_sets = [Dataset(_resolve(v, self.params), params=p,
+                              reference=train_set) for v in valid_paths]
+        valid_names = [os.path.basename(v) for v in valid_paths]
+
+        num_round = cfg.num_iterations
+        booster = train_fn(
+            p, train_set, num_boost_round=num_round,
+            valid_sets=valid_sets, valid_names=valid_names,
+            init_model=input_model,
+            verbose_eval=max(cfg.metric_freq, 1),
+            snapshot_freq=cfg.snapshot_freq,
+            snapshot_out=output_model,
+        )
+        booster.save_model(output_model)
+        log_info(f"Finished training; model saved to {output_model}")
+
+    # ---------------------------------------------------------------- predict
+
+    def predict(self) -> None:
+        p = dict(self.params)
+        data_path = p.pop("data", None)
+        if not data_path:
+            raise SystemExit("no data to predict: set data=...")
+        input_model = p.pop("input_model", "LightGBM_model.txt")
+        output_result = p.pop("output_result", "LightGBM_predict_result.txt")
+        booster = Booster(model_file=_resolve(input_model, self.params),
+                          params=p)
+        from .io_utils import load_text_dataset
+        tmp_ds = Dataset(None, params=p)
+        X = load_text_dataset(_resolve(data_path, self.params), tmp_ds)
+        pred = booster.predict(
+            X,
+            raw_score=str(p.get("predict_raw_score", "false")).lower() == "true",
+            pred_leaf=str(p.get("predict_leaf_index", "false")).lower() == "true",
+            pred_contrib=str(p.get("predict_contrib", "false")).lower() == "true",
+        )
+        pred = np.atleast_1d(pred)
+        with open(output_result, "w") as fh:
+            if pred.ndim == 1:
+                for v in pred:
+                    fh.write(f"{v:.18g}\n")
+            else:
+                for row in pred:
+                    fh.write("\t".join(f"{v:.18g}" for v in row) + "\n")
+        log_info(f"Finished prediction; results saved to {output_result}")
+
+    # ---------------------------------------------------------- convert_model
+
+    def convert_model(self) -> None:
+        from .model_text import model_to_if_else
+        p = self.params
+        input_model = p.get("input_model", "LightGBM_model.txt")
+        out = p.get("convert_model", "gbdt_prediction.cpp")
+        booster = Booster(model_file=_resolve(input_model, p))
+        with open(out, "w") as fh:
+            fh.write(model_to_if_else(booster))
+        log_info(f"Finished converting model; saved to {out}")
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        raise SystemExit(__doc__)
+    Application(argv).run()

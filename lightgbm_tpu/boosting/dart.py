@@ -1,0 +1,139 @@
+"""DART: dropouts meet multiple additive regression trees.
+
+reference: src/boosting/dart.hpp — DroppingTrees (:97), Normalize (:158),
+TrainOneIter (:58).  Behavioral contract reproduced:
+
+- each iteration drops a random subset of existing trees (probability
+  ``drop_rate``, at most ``max_drop``; whole dropout skipped with
+  probability ``skip_drop``; non-uniform mode weights the pick by stored
+  tree weight), computes gradients on the score WITHOUT the dropped trees,
+  and trains the new tree with shrinkage lr/(1+k) (xgboost mode:
+  lr/(lr+k)), k = number dropped;
+- afterwards each dropped tree is renormalized to k/(k+1) (xgboost mode:
+  k/(lr+k)) of its old weight, i.e. train and valid scores both end up
+  down-shifted by (1-w) of the dropped tree's old contribution.
+
+TPU form: the dropped trees' contributions are evaluated by host traversal
+over the binned matrix (tiny trees, vectorized numpy) and pushed to the
+device scores as deltas — the grow step itself is the shared jitted
+``one_iter``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    boosting_type = "dart"
+
+    def __init__(self, config, train_set, objective):
+        super().__init__(config, train_set, objective)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []   # non-uniform drop weighting
+        self.sum_weight = 0.0
+
+    # -- helpers ----------------------------------------------------------
+
+    def _tree_pred_train(self, model_idx: int) -> np.ndarray:
+        return self.models[model_idx].predict_binned_np(self.train_set.binned)
+
+    def _tree_pred_valid(self, model_idx: int, vi: int) -> np.ndarray:
+        return self.models[model_idx].predict_binned_np(self.valid_sets[vi].binned)
+
+    def _dropping_trees(self) -> List[int]:
+        """Pick iteration indices to drop; set the new tree's shrinkage.
+        reference: dart.hpp:97-151."""
+        c = self.config
+        drop: List[int] = []
+        if self._drop_rng.rand() >= c.skip_drop:
+            drop_rate = c.drop_rate
+            # only trees trained in THIS run are drop candidates
+            # (reference: dart.hpp drops num_init_iteration_ + i)
+            n_own = min(self.iter, len(self.models)
+                        // max(self.num_tree_per_iteration, 1))
+            if not c.uniform_drop and self.sum_weight > 0:
+                n_own = min(n_own, len(self.tree_weight))
+                inv_avg = len(self.tree_weight) / self.sum_weight
+                if c.max_drop > 0:
+                    drop_rate = min(drop_rate,
+                                    c.max_drop * inv_avg / self.sum_weight)
+                for i in range(n_own):
+                    if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                        drop.append(i)
+                        if c.max_drop > 0 and len(drop) >= c.max_drop:
+                            break
+            else:
+                if c.max_drop > 0 and n_own > 0:
+                    drop_rate = min(drop_rate, c.max_drop / n_own)
+                for i in range(n_own):
+                    if self._drop_rng.rand() < drop_rate:
+                        drop.append(i)
+                        if c.max_drop > 0 and len(drop) >= c.max_drop:
+                            break
+        k = len(drop)
+        if not c.xgboost_dart_mode:
+            self.shrinkage_rate = c.learning_rate / (1.0 + k)
+        else:
+            self.shrinkage_rate = (c.learning_rate if k == 0 else
+                                   c.learning_rate / (c.learning_rate + k))
+        return drop
+
+    # -- training ---------------------------------------------------------
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        c = self.config
+        K = self.num_tree_per_iteration
+        self.boost_from_average()
+        drop = self._dropping_trees()
+        k = len(drop)
+
+        # remove dropped trees from the train score before gradients
+        # (reference: GetTrainingScore -> DroppingTrees, dart.hpp:131-137)
+        drop_preds = {}
+        for i in drop:
+            for kk in range(K):
+                p = self._tree_pred_train(i * K + kk)
+                drop_preds[(i, kk)] = p
+                self.train_score = self.train_score.at[kk].add(
+                    -jnp.asarray(p, jnp.float32))
+
+        stopped = super().train_one_iter(grad, hess)
+        if stopped:
+            # restore the removed contributions; nothing was trained
+            for (i, kk), p in drop_preds.items():
+                self.train_score = self.train_score.at[kk].add(
+                    jnp.asarray(p, jnp.float32))
+            return True
+
+        # normalize dropped trees to weight w of their old contribution
+        # (reference: Normalize, dart.hpp:158-199)
+        if k > 0:
+            w = (k / (k + 1.0) if not c.xgboost_dart_mode
+                 else k / (k + c.learning_rate))
+            for (i, kk), p in drop_preds.items():
+                self.train_score = self.train_score.at[kk].add(
+                    jnp.asarray(w * p, jnp.float32))
+                for vi in range(len(self.valid_scores)):
+                    vp = self._tree_pred_valid(i * K + kk, vi)
+                    self.valid_scores[vi] = self.valid_scores[vi].at[kk].add(
+                        jnp.asarray(-(1.0 - w) * vp, jnp.float32))
+                self.models[i * K + kk].scale(w)
+            if not c.uniform_drop:
+                # reference Normalize: sum_weight -= tw/(k+1) (default) or
+                # tw/(k+lr) (xgboost mode), then tw *= w  (dart.hpp:176,195)
+                denom = (k + 1.0 if not c.xgboost_dart_mode
+                         else k + c.learning_rate)
+                for i in drop:
+                    self.sum_weight -= self.tree_weight[i] / denom
+                    self.tree_weight[i] *= w
+
+        if not c.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False

@@ -1,0 +1,89 @@
+"""Random forest mode.
+
+reference: src/boosting/rf.hpp — bagging is mandatory, no shrinkage,
+gradients are computed ONCE from the constant boost-from-average scores
+(Boosting override, rf.hpp:77-98), every tree carries its class's init
+score as a bias (AddBias, rf.hpp:137), and train/valid scores are the
+RUNNING MEAN of the trees' outputs (MultiplyScore dance, rf.hpp:140-142);
+prediction averages over iterations (average_output).
+
+Known deviation: for percentile-renewing objectives (L1/quantile/MAPE) the
+shared jitted step renews leaf outputs against the running-average score
+rather than the constant init score (reference residual_getter, rf.hpp:133)
+— the difference vanishes as the forest converges.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gbdt import GBDT, K_EPSILON
+from ..tree import tree_to_host
+from ..utils.log import log_warning
+
+
+class RF(GBDT):
+    boosting_type = "rf"
+
+    def __init__(self, config, train_set, objective):
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
+            raise ValueError("random forest requires bagging "
+                             "(bagging_freq > 0 and bagging_fraction < 1)")
+        if objective is None:
+            raise ValueError("RF mode does not support custom objective "
+                             "functions, please use built-in objectives")
+        super().__init__(config, train_set, objective)
+        self.shrinkage_rate = 1.0
+        K = self.num_tree_per_iteration
+        # constant per-class init scores; NOT added to the score vectors —
+        # they ride inside each tree as a bias (reference rf.hpp:84,137)
+        if config.boost_from_average:
+            self.init_scores = [objective.boost_from_score(k) for k in range(K)]
+        self._init_score_added = True   # disable GBDT.boost_from_average
+        # gradients once, from the constant init scores (rf.hpp:77-98)
+        init_col = jnp.asarray(self.init_scores, jnp.float32)[:, None]
+        score0 = jnp.broadcast_to(init_col, self.train_score.shape)
+        g, h = self._gradients_fn(score0)
+        self._grad, self._hess = g, h
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is not None:
+            raise ValueError("RF mode does not support custom objectives")
+        it = self.iter
+        mask = self._bagging_mask(it)
+        # run the shared step on it*mean (so "+ tree" keeps the sum), then
+        # renormalize to the running mean including the per-tree bias
+        s1 = self.train_score * it
+        s2, stacked, _ = self._iter_fn(s1, mask, self._grad, self._hess,
+                                       self._feature_masks(), jnp.float32(1.0))
+        init_col = jnp.asarray(self.init_scores, jnp.float32)[:, None]
+        self.train_score = (s2 + init_col) / (it + 1)
+        return self._finish_iter(stacked)
+
+    def _finish_iter(self, stacked) -> bool:
+        K = self.num_tree_per_iteration
+        it = self.iter
+        import jax
+        new_models = []
+        should_continue = False
+        for k in range(K):
+            tree_k = jax.tree_util.tree_map(lambda x: np.asarray(x[k]), stacked)
+            ht = tree_to_host(tree_k, self.train_set, 1.0)
+            if ht.num_leaves > 1:
+                should_continue = True
+            if abs(self.init_scores[k]) > K_EPSILON:
+                ht.add_bias(self.init_scores[k])
+            new_models.append(ht)
+        if not should_continue:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        self.models.extend(new_models)
+        init_col = jnp.asarray(self.init_scores, jnp.float32)[:, None]
+        for i in range(len(self.valid_scores)):
+            vs = self._valid_update(self.valid_scores[i] * it, stacked,
+                                    self.valid_binned[i])
+            self.valid_scores[i] = (vs + init_col) / (it + 1)
+        self.iter += 1
+        return False

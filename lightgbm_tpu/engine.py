@@ -27,8 +27,10 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
           evals_result: Optional[dict] = None,
           verbose_eval: Union[bool, int] = True,
           learning_rates=None, keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """reference: engine.py:18."""
+          callbacks: Optional[List[Callable]] = None,
+          snapshot_freq: int = -1, snapshot_out: str = "model.txt") -> Booster:
+    """reference: engine.py:18; snapshot_freq mirrors the CLI's periodic
+    model snapshots (gbdt.cpp:259-263, saved as <out>.snapshot_iter_N)."""
     params = dict(params)
     cfg = Config.from_params(params)
     if "num_iterations" in {Config.canonical_key(k) for k in params}:
@@ -94,6 +96,7 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
             if cfg.is_provide_training_metric:
                 evaluation_result_list.extend(booster.eval_train(feval))
             evaluation_result_list.extend(booster.eval_valid(feval))
+        early_stopped = False
         try:
             for cb in cbs_after:
                 cb(callback_mod.CallbackEnv(booster, params, i, 0,
@@ -103,8 +106,12 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
             for item in e.best_score:
                 booster.best_score.setdefault(item[0], collections.OrderedDict())
                 booster.best_score[item[0]][item[1]] = item[2]
-            break
-        if finished:
+            early_stopped = True
+        # snapshot even on the iteration that triggered early stop
+        # (reference: GBDT::Train reaches the snapshot write, gbdt.cpp:259-263)
+        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+        if early_stopped or finished:
             break
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()

@@ -270,3 +270,55 @@ def test_dataset_save_binary(tmp_path):
 def _auc(y, p):
     from sklearn.metrics import roc_auc_score
     return roc_auc_score(y, p)
+
+
+def test_dart():
+    X, y = _load(f"{EXAMPLES}/binary_classification/binary.train")
+    params = {"objective": "binary", "boosting": "dart", "metric": "auc",
+              "verbosity": -1, "drop_rate": 0.5, "skip_drop": 0.0}
+    evals = {}
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, train, num_boost_round=25,
+                    valid_sets=[lgb.Dataset(X, label=y, reference=train)],
+                    evals_result=evals, verbose_eval=False)
+    traj = evals["valid_0"]["auc"]
+    # drop_rate=0.5 + skip_drop=0 is aggressive dropout; measured 0.798
+    assert traj[-1] > 0.78
+    p = bst.predict(X)
+    assert np.isfinite(p).all() and 0 <= p.min() and p.max() <= 1
+
+
+def test_random_forest():
+    X, y = _load(f"{EXAMPLES}/binary_classification/binary.train")
+    params = {"objective": "binary", "boosting": "rf", "metric": "auc",
+              "verbosity": -1, "bagging_freq": 1, "bagging_fraction": 0.6,
+              "feature_fraction": 0.8}
+    evals = {}
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, train, num_boost_round=20,
+                    valid_sets=[lgb.Dataset(X, label=y, reference=train)],
+                    evals_result=evals, verbose_eval=False)
+    # measured 0.8165; sklearn RandomForest at matched capacity gets 0.8121
+    assert evals["valid_0"]["auc"][-1] > 0.80
+    p = bst.predict(X)
+    # averaged probabilities, not a boosted sum
+    assert np.isfinite(p).all() and 0 <= p.min() and p.max() <= 1
+    # rf without bagging must be rejected (reference CHECK, rf.hpp:28)
+    with pytest.raises(ValueError):
+        lgb.train({"objective": "binary", "boosting": "rf", "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+
+
+def test_dart_rf_model_roundtrip(tmp_path):
+    X, y = _load(f"{EXAMPLES}/binary_classification/binary.train")
+    for boosting, extra in (("dart", {"drop_rate": 0.3, "skip_drop": 0.2}),
+                            ("rf", {"bagging_freq": 1, "bagging_fraction": 0.7})):
+        params = {"objective": "binary", "verbosity": -1, "boosting": boosting,
+                  "num_leaves": 7, **extra}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6,
+                        verbose_eval=False)
+        pred = bst.predict(X)
+        path = str(tmp_path / f"{boosting}.txt")
+        bst.save_model(path)
+        pred2 = lgb.Booster(model_file=path).predict(X)
+        np.testing.assert_allclose(pred, pred2, rtol=1e-6, atol=1e-9)
