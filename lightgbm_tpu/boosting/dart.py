@@ -41,10 +41,14 @@ class DART(GBDT):
     # -- helpers ----------------------------------------------------------
 
     def _tree_pred_train(self, model_idx: int) -> np.ndarray:
-        return self.models[model_idx].predict_binned_np(self.train_set.binned)
+        ds = self.train_set
+        return self.models[model_idx].predict_binned_np(
+            ds.binned, ds.feat_group, ds.feat_start)
 
     def _tree_pred_valid(self, model_idx: int, vi: int) -> np.ndarray:
-        return self.models[model_idx].predict_binned_np(self.valid_sets[vi].binned)
+        ds = self.valid_sets[vi]
+        return self.models[model_idx].predict_binned_np(
+            ds.binned, ds.feat_group, ds.feat_start)
 
     def _dropping_trees(self) -> List[int]:
         """Pick iteration indices to drop; set the new tree's shrinkage.

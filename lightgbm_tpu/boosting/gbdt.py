@@ -235,7 +235,7 @@ class GBDT:
         """Per-tree column sampling (reference: ColSampler by-tree,
         src/treelearner/col_sampler.hpp:19)."""
         K = self.num_tree_per_iteration
-        F = self.train_set.binned.shape[1]
+        F = len(self.train_set.used_features)   # features, not EFB columns
         frac = self.config.feature_fraction
         if frac >= 1.0:
             if self._ones_fmask is None:
@@ -322,11 +322,15 @@ class GBDT:
         # subtract the dropped trees' contributions
         for k, ht in enumerate(dropped):
             self.train_score = self.train_score.at[k].add(
-                -jnp.asarray(ht.predict_binned_np(self.train_set.binned)))
+                -jnp.asarray(ht.predict_binned_np(
+                    self.train_set.binned, self.train_set.feat_group,
+                    self.train_set.feat_start)))
         for i, vs in enumerate(self.valid_scores):
             for k, ht in enumerate(dropped):
                 self.valid_scores[i] = self.valid_scores[i].at[k].add(
-                    -jnp.asarray(ht.predict_binned_np(self.valid_sets[i].binned)))
+                    -jnp.asarray(ht.predict_binned_np(
+                        self.valid_sets[i].binned, self.valid_sets[i].feat_group,
+                        self.valid_sets[i].feat_start)))
         self.iter -= 1
 
     # ------------------------------------------------------------------- eval

@@ -168,9 +168,17 @@ class Dataset:
             self.bin_mappers = ref.bin_mappers
             self.used_features = ref.used_features
             self.feature_names = ref.feature_names
+            # identical EFB layout so valid sets bin into the same columns
+            self.feat_group = ref.feat_group
+            self.feat_start = ref.feat_start
+            self.num_groups = ref.num_groups
+            self._group_size = ref._group_size
+            self.group_num_bin = ref.group_num_bin
+            self.max_group_bin = ref.max_group_bin
         else:
             sample_idx = _sample_indices(self.num_data, sample_cnt, seed)
             total_sample_cnt = len(sample_idx)
+            sample_nonzero = {}           # used-feature pos -> bool [S]
             self.bin_mappers = []
             for f in range(self.num_total_features):
                 col = _get_col(raw, sp, f, sample_idx)
@@ -191,15 +199,27 @@ class Dataset:
                 )
                 self.bin_mappers.append(m)
             self.used_features = [f for f, m in enumerate(self.bin_mappers) if not m.is_trivial]
+            # EFB grouping from the sample (reference: FindGroups /
+            # FastFeatureBundling, dataset.cpp:97-313)
+            for j, f in enumerate(self.used_features):
+                col = _get_col(raw, sp, f, sample_idx)
+                sample_nonzero[j] = ~(np.isnan(col) | (np.abs(col) <= 1e-35))
+            self._build_groups(sample_nonzero, total_sample_cnt)
 
-        # second pass: bin every row
+        # second pass: bin every row into the per-GROUP merged columns
         F = len(self.used_features)
-        max_nb = max((self.bin_mappers[f].num_bin for f in self.used_features), default=2)
-        dtype = np.uint8 if max_nb <= 256 else np.uint16
-        self.binned = np.empty((self.num_data, F), dtype=dtype)
+        G = self.num_groups
+        dtype = np.uint8 if self.max_group_bin <= 256 else np.uint16
+        self.binned = np.zeros((self.num_data, G), dtype=dtype)
         for j, f in enumerate(self.used_features):
             col = _get_col(raw, sp, f, None)
-            self.binned[:, j] = self.bin_mappers[f].value_to_bin(col).astype(dtype)
+            bins = self.bin_mappers[f].value_to_bin(col)
+            g, start = int(self.feat_group[j]), int(self.feat_start[j])
+            if start == 1 and self._group_size[g] == 1:
+                self.binned[:, g] = bins.astype(dtype)
+            else:
+                nz = bins != 0       # bundled features are zero-default
+                self.binned[nz, g] = (start + bins[nz] - 1).astype(dtype)
 
         self.metadata.check(self.num_data)
         if self.metadata.label is None:
@@ -208,6 +228,88 @@ class Dataset:
         if self.free_raw_data:
             self.raw_data = None
         return self
+
+    def _build_groups(self, sample_nonzero: dict, total_sample_cnt: int) -> None:
+        """Greedy conflict-bounded exclusive feature bundling.
+
+        reference: Dataset::FindGroups (dataset.cpp:97-234) — features whose
+        non-default rows rarely overlap share one stored column; conflict
+        budget is total_sample_cnt/10000 (dataset.cpp:105), bins per merged
+        column capped at 256 (dataset.cpp:104,127 — the GPU cap, which TPU
+        uint8 storage likes too).  Only zero-default numerical features are
+        bundled; everything else gets a singleton column.
+        """
+        F = len(self.used_features)
+        enable = str(self.params.get("enable_bundle", True)).lower() not in (
+            "false", "0", "no")
+        eligible = []
+        for j, f in enumerate(self.used_features):
+            m = self.bin_mappers[f]
+            if (enable and m.bin_type == BinType.NUMERICAL
+                    and m.most_freq_bin == 0 and m.default_bin == 0
+                    and m.num_bin <= 256 and j in sample_nonzero):
+                eligible.append(j)
+        budget = max(total_sample_cnt // 10000, 0)
+
+        groups: List[List[int]] = []       # positions (into used_features)
+        group_nz: List[np.ndarray] = []    # bool [S] union of nonzeros
+        group_conflict: List[int] = []
+        group_bins: List[int] = []         # 1 + sum(nb_f - 1)
+        eligible.sort(key=lambda j: int(sample_nonzero[j].sum()), reverse=True)
+        for j in eligible:
+            nz = sample_nonzero[j]
+            nb = self.bin_mappers[self.used_features[j]].num_bin
+            placed = False
+            for gi in range(len(groups)):
+                conflict = int((group_nz[gi] & nz).sum())
+                if (group_conflict[gi] + conflict <= budget
+                        and group_bins[gi] + nb - 1 <= 256):
+                    groups[gi].append(j)
+                    group_nz[gi] = group_nz[gi] | nz
+                    group_conflict[gi] += conflict
+                    group_bins[gi] += nb - 1
+                    placed = True
+                    break
+            if not placed:
+                groups.append([j])
+                group_nz.append(nz.copy())
+                group_conflict.append(0)
+                group_bins.append(1 + (nb - 1))
+
+        feat_group = np.zeros(F, np.int32)
+        feat_start = np.ones(F, np.int32)
+        group_size: List[int] = []
+        group_num_bin: List[int] = []
+        gid = 0
+        bundled_pos = set()
+        for gi, members in enumerate(groups):
+            if len(members) == 1:
+                continue   # singletons handled below for stable ordering
+            off = 1
+            for j in members:
+                feat_group[j] = gid
+                feat_start[j] = off
+                off += self.bin_mappers[self.used_features[j]].num_bin - 1
+                bundled_pos.add(j)
+            group_size.append(len(members))
+            group_num_bin.append(off)
+            gid += 1
+        for j in range(F):
+            if j in bundled_pos:
+                continue
+            feat_group[j] = gid
+            feat_start[j] = 1
+            group_size.append(1)
+            group_num_bin.append(
+                self.bin_mappers[self.used_features[j]].num_bin)
+            gid += 1
+
+        self.feat_group = feat_group
+        self.feat_start = feat_start
+        self.num_groups = gid
+        self._group_size = group_size
+        self.group_num_bin = group_num_bin
+        self.max_group_bin = max(group_num_bin, default=2)
 
     def _resolve_categorical(self) -> set:
         cf = self._categorical_feature_param
@@ -301,6 +403,12 @@ class Dataset:
         sub.bin_mappers = self.bin_mappers
         sub.used_features = self.used_features
         sub.binned = self.binned[idx]
+        sub.feat_group = self.feat_group
+        sub.feat_start = self.feat_start
+        sub.num_groups = self.num_groups
+        sub._group_size = self._group_size
+        sub.group_num_bin = self.group_num_bin
+        sub.max_group_bin = self.max_group_bin
         sub.feature_names = self.feature_names
         sub.num_data = len(idx)
         sub.num_total_features = self.num_total_features
@@ -318,6 +426,11 @@ class Dataset:
             "feature_names": self.feature_names,
             "bin_mappers": [m.to_dict() for m in self.bin_mappers],
             "dtype": str(self.binned.dtype),
+            "feat_group": list(map(int, self.feat_group)),
+            "feat_start": list(map(int, self.feat_start)),
+            "num_groups": int(self.num_groups),
+            "group_size": list(map(int, self._group_size)),
+            "group_num_bin": list(map(int, self.group_num_bin)),
             "has_label": self.metadata.label is not None,
             "has_weight": self.metadata.weight is not None,
             "has_group": self.metadata.query_boundaries is not None,
@@ -357,10 +470,26 @@ class Dataset:
             ds.feature_names = meta["feature_names"]
             ds.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
             F = len(ds.used_features)
+            if "feat_group" in meta:
+                ds.feat_group = np.asarray(meta["feat_group"], np.int32)
+                ds.feat_start = np.asarray(meta["feat_start"], np.int32)
+                ds.num_groups = int(meta["num_groups"])
+                ds._group_size = list(meta["group_size"])
+                ds.group_num_bin = list(meta["group_num_bin"])
+                ds.max_group_bin = max(ds.group_num_bin, default=2)
+            else:   # pre-EFB file: identity groups
+                ds.feat_group = np.arange(F, dtype=np.int32)
+                ds.feat_start = np.ones(F, np.int32)
+                ds.num_groups = F
+                ds._group_size = [1] * F
+                ds.group_num_bin = [ds.bin_mappers[f].num_bin
+                                    for f in ds.used_features]
+                ds.max_group_bin = max(ds.group_num_bin, default=2)
+            ncols = ds.num_groups
             dtype = np.dtype(meta["dtype"])
             ds.binned = np.frombuffer(
-                fh.read(ds.num_data * F * dtype.itemsize), dtype=dtype
-            ).reshape(ds.num_data, F).copy()
+                fh.read(ds.num_data * ncols * dtype.itemsize), dtype=dtype
+            ).reshape(ds.num_data, ncols).copy()
             ds.metadata = Metadata()
             if meta["has_label"]:
                 ds.metadata.label = np.frombuffer(fh.read(ds.num_data * 4), np.float32).copy()
@@ -383,31 +512,71 @@ class Dataset:
 
     def feature_meta(self) -> "FeatureMeta":
         self.construct()
-        return FeatureMeta.from_mappers([self.bin_mappers[f] for f in self.used_features])
+        return FeatureMeta.from_mappers(
+            [self.bin_mappers[f] for f in self.used_features],
+            feat_group=self.feat_group, feat_start=self.feat_start,
+            num_groups=self.num_groups, max_group_bin=self.max_group_bin)
 
 
 @dataclass(frozen=True)
 class FeatureMeta:
-    """Static (trace-time) per-used-feature metadata arrays for device kernels."""
+    """Static (trace-time) per-used-feature metadata arrays for device kernels.
+
+    EFB mapping (reference: FeatureGroup bin stacking, feature_group.h:32-50):
+    scan/tree/partition all operate on ORIGINAL used features; the stored
+    matrix has one column per GROUP.  Feature f's non-default bins b>=1 live
+    at merged bin ``feat_start[f] + b - 1`` of column ``feat_group[f]``; its
+    bin 0 (the shared default) is reconstructed from leaf totals at scan time
+    (the reference's FixHistogram trick, dataset.cpp:1410).  Singleton groups
+    use feat_start=1 so the same formulas hold (merged bin == feature bin).
+    """
 
     num_bin: np.ndarray        # int32 [F]
     missing_type: np.ndarray   # int32 [F]
     default_bin: np.ndarray    # int32 [F]
     most_freq_bin: np.ndarray  # int32 [F]
     is_categorical: np.ndarray  # bool [F]
-    max_num_bin: int           # padded bin axis size B
+    max_num_bin: int           # padded per-feature bin axis size B
+    feat_group: Optional[np.ndarray] = None   # int32 [F] column of feature
+    feat_start: Optional[np.ndarray] = None   # int32 [F] merged-bin start
+    num_groups: int = 0                       # G (0 -> identity: G == F)
+    max_group_bin: int = 0                    # padded group bin axis Bg
+
+    def with_identity_groups(self) -> "FeatureMeta":
+        F = len(self.num_bin)
+        import dataclasses
+        return dataclasses.replace(
+            self,
+            feat_group=np.arange(F, dtype=np.int32),
+            feat_start=np.ones(F, np.int32),
+            num_groups=F,
+            max_group_bin=self.max_num_bin,
+        )
+
+    @property
+    def has_bundles(self) -> bool:
+        return (self.num_groups != 0 and
+                self.num_groups != len(self.num_bin))
+
+    def resolved(self) -> "FeatureMeta":
+        return self if self.num_groups else self.with_identity_groups()
 
     @staticmethod
-    def from_mappers(mappers: Sequence[BinMapper]) -> "FeatureMeta":
+    def from_mappers(mappers: Sequence[BinMapper],
+                     feat_group=None, feat_start=None,
+                     num_groups: int = 0, max_group_bin: int = 0) -> "FeatureMeta":
         nb = np.array([m.num_bin for m in mappers], dtype=np.int32)
-        return FeatureMeta(
+        meta = FeatureMeta(
             num_bin=nb,
             missing_type=np.array([m.missing_type for m in mappers], dtype=np.int32),
             default_bin=np.array([m.default_bin for m in mappers], dtype=np.int32),
             most_freq_bin=np.array([m.most_freq_bin for m in mappers], dtype=np.int32),
             is_categorical=np.array([m.bin_type == BinType.CATEGORICAL for m in mappers], dtype=bool),
             max_num_bin=int(nb.max()) if len(nb) else 2,
+            feat_group=feat_group, feat_start=feat_start,
+            num_groups=num_groups, max_group_bin=max_group_bin,
         )
+        return meta.resolved()
 
 
 def _is_sparse(data) -> bool:

@@ -196,13 +196,22 @@ def grow_tree(
       reference's no-op because there every machine holds all features).
     Both can be combined (2-D mesh).
     """
-    n, F = binned.shape
+    meta = meta.resolved()
+    n, G = binned.shape
     L = cfg.num_leaves
     B = cfg.num_bins
+    Bg = meta.max_group_bin if meta.has_bundles else B
     hp = cfg.hp
 
+    if feature_axis_name is not None and meta.has_bundles:
+        raise NotImplementedError(
+            "feature-axis sharding requires enable_bundle=false (EFB merges "
+            "features into shared columns, which cannot be row-sliced per "
+            "feature shard)")
     if feature_axis_name is not None:
-        # slice the full meta arrays down to this shard's features
+        # features sharded: each device's binned holds G columns of the full
+        # feature axis (identity groups); slice the full meta arrays
+        F = G
         fidx = lax.axis_index(feature_axis_name)
         def shard_slice(arr):
             return lax.dynamic_slice_in_dim(jnp.asarray(arr), fidx * F, F)
@@ -211,20 +220,47 @@ def grow_tree(
         default_bin = shard_slice(meta.default_bin)
         is_cat = shard_slice(meta.is_categorical)
         f_offset = fidx * F
+        feat_group = jnp.arange(F, dtype=jnp.int32)
+        feat_start = jnp.ones(F, jnp.int32)
     else:
+        F = len(meta.num_bin)
         num_bin = jnp.asarray(meta.num_bin)
         missing_type = jnp.asarray(meta.missing_type)
         default_bin = jnp.asarray(meta.default_bin)
         is_cat = jnp.asarray(meta.is_categorical)
         f_offset = None
+        feat_group = jnp.asarray(meta.feat_group)
+        feat_start = jnp.asarray(meta.feat_start)
     has_cat = bool(meta.is_categorical.any())
 
-    hist_fn = functools.partial(build_histogram, num_bins=B, method=cfg.hist_method)
+    hist_fn = functools.partial(build_histogram, num_bins=Bg, method=cfg.hist_method)
     # full-n first capacity: the "smaller" child is chosen by WEIGHTED count
     # (GOSS amplifies weights), so its raw row count may exceed n/2
     caps = capacity_schedule(n) if cfg.compact else [n]
 
-    def leaf_best(hist, sg, sh, cnt, depth):
+    if meta.has_bundles:
+        b_idx = jnp.arange(B, dtype=jnp.int32)
+
+        def expand_hist(ghist, sg, sh, cnt):
+            """[G, Bg, 3] group histogram -> [F, B, 3] per-feature histogram.
+
+            Feature bins b>=1 gather from merged bins feat_start+b-1; bin 0
+            (the shared default) is reconstructed from the leaf totals
+            (reference: Dataset::FixHistogram, dataset.cpp:1410).
+            """
+            gather_bins = jnp.clip(feat_start[:, None] + b_idx[None, :] - 1,
+                                   0, Bg - 1)                       # [F, B]
+            taken = ghist[feat_group[:, None], gather_bins]         # [F, B, 3]
+            valid = (b_idx[None, :] >= 1) & (b_idx[None, :] < num_bin[:, None])
+            h = jnp.where(valid[:, :, None], taken, 0.0)
+            totals = jnp.stack([sg, sh, cnt])                       # [3]
+            return h.at[:, 0, :].set(totals[None, :] - h.sum(axis=1))
+    else:
+        def expand_hist(ghist, sg, sh, cnt):
+            return ghist   # identity groups: group hist IS the feature hist
+
+    def leaf_best(ghist, sg, sh, cnt, depth):
+        hist = expand_hist(ghist, sg, sh, cnt)
         r = best_split_for_leaf(
             hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
             hp, feature_mask=feature_mask,
@@ -250,7 +286,7 @@ def grow_tree(
 
     tree = TreeArrays.empty(L)
     best = _LeafBest.empty(L)
-    hist_cache = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(root_hist)
+    hist_cache = jnp.zeros((L, G, Bg, 3), jnp.float32).at[0].set(root_hist)
     leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
     leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
     leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
@@ -337,8 +373,13 @@ def grow_tree(
                 jnp.where(owned, gl_local.astype(jnp.float32), 0.0),
                 feature_axis_name) > 0.5
         else:
-            col = binned[:, feat]
-            goes_left = row_goes_left(col, thr, dl, ncat, nbits,
+            # decode the feature's bin from its (possibly bundled) column
+            g = feat_group[feat]
+            st = feat_start[feat]
+            col = jnp.take(binned, g, axis=1).astype(jnp.int32)
+            dec = col - st + 1
+            binf = jnp.where((dec >= 1) & (dec < num_bin[feat]), dec, 0)
+            goes_left = row_goes_left(binf, thr, dl, ncat, nbits,
                                       missing_type[feat], default_bin[feat],
                                       num_bin[feat])
         in_leaf = c.leaf_id == leaf
@@ -400,10 +441,13 @@ def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
     Vectorized: all rows advance one level per iteration; done when every
     row has reached a leaf (child pointer < 0).
     """
+    meta = meta.resolved()
     n = binned.shape[0]
     num_bin = jnp.asarray(meta.num_bin)
     missing_type = jnp.asarray(meta.missing_type)
     default_bin = jnp.asarray(meta.default_bin)
+    feat_group = jnp.asarray(meta.feat_group)
+    feat_start = jnp.asarray(meta.feat_start)
 
     # node >= 0: internal; node < 0: leaf ~node
     def cond(state):
@@ -414,8 +458,10 @@ def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
         node, it = state
         nd = jnp.maximum(node, 0)
         feat = tree.split_feature[nd]
-        col = binned[jnp.arange(n), feat].astype(jnp.int32)
-        gl = row_goes_left(col, tree.threshold_bin[nd], tree.default_left[nd],
+        col = binned[jnp.arange(n), feat_group[feat]].astype(jnp.int32)
+        dec = col - feat_start[feat] + 1
+        binf = jnp.where((dec >= 1) & (dec < num_bin[feat]), dec, 0)
+        gl = row_goes_left(binf, tree.threshold_bin[nd], tree.default_left[nd],
                            tree.is_categorical[nd], tree.cat_bitset[nd],
                            missing_type[feat], default_bin[feat], num_bin[feat])
         nxt = jnp.where(gl, tree.left_child[nd], tree.right_child[nd])

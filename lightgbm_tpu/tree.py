@@ -142,8 +142,14 @@ class HostTree:
             active = active & (node >= 0)
         return (~node).astype(np.int32)
 
-    def predict_binned_np(self, binned: np.ndarray) -> np.ndarray:
-        """Bin-space batch prediction (used for rollback on binned data)."""
+    def predict_binned_np(self, binned: np.ndarray,
+                          feat_group: Optional[np.ndarray] = None,
+                          feat_start: Optional[np.ndarray] = None) -> np.ndarray:
+        """Bin-space batch prediction (used for rollback/DART on binned data).
+
+        With EFB, ``binned`` holds merged group columns; pass the dataset's
+        feat_group/feat_start to decode each feature's bin (see
+        FeatureMeta docstring in dataset.py)."""
         n = binned.shape[0]
         if self.num_leaves <= 1:
             return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
@@ -153,7 +159,15 @@ class HostTree:
         while active.any():
             for nd in np.unique(node[active]):
                 rows = active & (node == nd)
-                b = binned[rows, self.split_feature_inner[nd]].astype(np.int64)
+                fi = self.split_feature_inner[nd]
+                if feat_group is not None:
+                    col = binned[rows, feat_group[fi]].astype(np.int64)
+                    dec = col - int(feat_start[fi]) + 1
+                    nb = int(self._feat_num_bin[nd]) if hasattr(
+                        self, "_feat_num_bin") else 1 << 30
+                    b = np.where((dec >= 1) & (dec < nb), dec, 0)
+                else:
+                    b = binned[rows, fi].astype(np.int64)
                 dt = int(self.decision_type[nd])
                 if dt & K_CATEGORICAL_MASK:
                     gl = self._bin_cat_decide(b, nd)
@@ -300,5 +314,7 @@ def tree_to_host(tree_arrays, train_set, shrinkage: float) -> HostTree:
         real_feature_index=real_feat,
     )
     ht._missing_bin = missing_bin
+    ht._feat_num_bin = np.array(
+        [mappers[used[f]].num_bin for f in split_feature_inner], np.int32)
     ht._bin_cat_bitset = bin_cat_bitsets
     return ht

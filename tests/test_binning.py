@@ -105,3 +105,67 @@ def test_serialization_roundtrip():
     m2 = BinMapper.from_dict(m.to_dict())
     test_vals = np.concatenate([rng.randn(100), [np.nan, 0.0]])
     np.testing.assert_array_equal(m.value_to_bin(test_vals), m2.value_to_bin(test_vals))
+
+
+def test_efb_bundling_exact_parity():
+    """Mutually-exclusive one-hot features bundle into few columns and give
+    IDENTICAL models to enable_bundle=false (zero conflicts -> EFB exact).
+    reference: Dataset::FindGroups / FastFeatureBundling (dataset.cpp:97-313).
+    """
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    n = 3000
+    cat = rng.randint(0, 12, n)
+    onehot = np.zeros((n, 12), np.float64)
+    onehot[np.arange(n), cat] = 1.0
+    dense = rng.randn(n, 3)
+    X = np.column_stack([onehot, dense])
+    y = ((cat % 3 == 0) * 1.0 + 0.4 * dense[:, 0] + 0.2 * rng.randn(n) > 0.5)
+
+    ds_on = lgb.Dataset(X, label=y.astype(np.float64))
+    ds_on.construct()
+    ds_off = lgb.Dataset(X, label=y.astype(np.float64),
+                         params={"enable_bundle": False})
+    ds_off.construct()
+    # the 12 one-hot columns must share a handful of merged columns
+    assert ds_on.num_groups < ds_off.num_groups == len(ds_off.used_features)
+    assert ds_on.binned.shape[1] == ds_on.num_groups
+
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    b_on = lgb.train(params, ds_on, num_boost_round=8, verbose_eval=False)
+    b_off = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y.astype(np.float64),
+                                  params={"enable_bundle": False}),
+                      num_boost_round=8, verbose_eval=False)
+    # bundled histograms reconstruct the shared default bin from f32 leaf
+    # totals (FixHistogram), so gains match only to float precision; the
+    # FIRST tree must still pick identical splits, and model quality match.
+    t_on, t_off = b_on.boosting.models[0], b_off.boosting.models[0]
+    np.testing.assert_array_equal(t_on.split_feature, t_off.split_feature)
+    np.testing.assert_allclose(t_on.threshold, t_off.threshold, rtol=1e-6)
+    p_on = b_on.predict(X)
+    p_off = b_off.predict(X)
+    from sklearn.metrics import log_loss
+    assert abs(log_loss(y, p_on) - log_loss(y, p_off)) < 1e-3
+
+
+def test_efb_binary_cache_roundtrip(tmp_path):
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    n = 500
+    onehot = np.eye(8)[rng.randint(0, 8, n)]
+    X = np.column_stack([onehot, rng.randn(n, 2)])
+    y = (onehot[:, 0] + rng.randn(n) * 0.1 > 0.5).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    path = str(tmp_path / "efb.bin")
+    ds.save_binary(path)
+    ds2 = lgb.Dataset.load_binary(path)
+    np.testing.assert_array_equal(ds.binned, ds2.binned)
+    np.testing.assert_array_equal(ds.feat_group, ds2.feat_group)
+    np.testing.assert_array_equal(ds.feat_start, ds2.feat_start)
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7,
+                     "min_data_in_leaf": 5}, ds2, num_boost_round=3,
+                    verbose_eval=False)
+    assert bst.num_trees() == 3
