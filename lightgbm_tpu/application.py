@@ -71,8 +71,10 @@ class Application:
         self.task = self.params.get("task", "train")
 
     def run(self) -> None:
-        if self.task in ("train", "refit", "refit_tree"):
+        if self.task == "train":
             self.train()
+        elif self.task in ("refit", "refit_tree"):
+            self.refit()
         elif self.task in ("predict", "prediction", "test"):
             self.predict()
         elif self.task == "convert_model":
@@ -110,6 +112,30 @@ class Application:
         )
         booster.save_model(output_model)
         log_info(f"Finished training; model saved to {output_model}")
+
+    # ------------------------------------------------------------------ refit
+
+    def refit(self) -> None:
+        """reference: Application task=refit (application.cpp:212-248) —
+        load input_model, re-fit its leaf values on `data`, save."""
+        p = dict(self.params)
+        data_path = p.pop("data", None)
+        if not data_path:
+            raise SystemExit("no refit data: set data=...")
+        input_model = p.pop("input_model", "LightGBM_model.txt")
+        output_model = p.pop("output_model", "LightGBM_model.txt")
+        p.pop("__config_dir__", None)
+        p.pop("task", None)
+        cfg = Config.from_params(p)
+        booster = Booster(model_file=_resolve(input_model, self.params),
+                          params=p)
+        from .io_utils import load_text_dataset
+        tmp_ds = Dataset(None, params=p)
+        X = load_text_dataset(_resolve(data_path, self.params), tmp_ds)
+        y = tmp_ds.metadata.label
+        refitted = booster.refit(X, y, decay_rate=cfg.refit_decay_rate, **p)
+        refitted.save_model(output_model)
+        log_info(f"Finished refit; model saved to {output_model}")
 
     # ---------------------------------------------------------------- predict
 

@@ -104,6 +104,30 @@ class Booster:
         self.boosting.rollback_one_iter()
         return self
 
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing trees' leaf values to new data (structures
+        unchanged).  reference: basic.py:2521 Booster.refit ->
+        LGBM_BoosterRefit -> GBDT::RefitTree (gbdt.cpp:267)."""
+        import copy as _copy
+        leaf_pred = self.predict(data, pred_leaf=True)
+        if self.boosting is not None:
+            params = dict(self.params)
+        else:   # loaded from model text: rebuild params from the header
+            params = {"objective": (self._loaded["objective_name"] or
+                                    "regression").split(" ")[0],
+                      "num_class": self._loaded["num_class"]}
+        params.update(kwargs)
+        params["refit_decay_rate"] = decay_rate
+        new_booster = Booster(params=params,
+                              train_set=Dataset(data, label=label))
+        new_booster.boosting.models = [_copy.deepcopy(m) for m in self.models]
+        new_booster.boosting.iter = (
+            len(new_booster.boosting.models)
+            // max(new_booster.boosting.num_tree_per_iteration, 1))
+        new_booster.boosting.refit_leaf_values(leaf_pred, decay_rate)
+        return new_booster
+
     def current_iteration(self) -> int:
         return self.boosting.current_iteration() if self.boosting else \
             len(self._loaded["models"]) // self._loaded["num_tree_per_iteration"]

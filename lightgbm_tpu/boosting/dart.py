@@ -51,16 +51,19 @@ class DART(GBDT):
             ds.binned, ds.feat_group, ds.feat_start)
 
     def _dropping_trees(self) -> List[int]:
-        """Pick iteration indices to drop; set the new tree's shrinkage.
-        reference: dart.hpp:97-151."""
+        """Pick THIS-RUN iteration indices to drop (0 = first iteration
+        trained in this run; init_model trees are never dropped — the
+        model index of drop i is ``(num_init_iteration + i) * K``); set
+        the new tree's shrinkage.  reference: dart.hpp:97-151."""
         c = self.config
         drop: List[int] = []
         if self._drop_rng.rand() >= c.skip_drop:
             drop_rate = c.drop_rate
             # only trees trained in THIS run are drop candidates
             # (reference: dart.hpp drops num_init_iteration_ + i)
-            n_own = min(self.iter, len(self.models)
-                        // max(self.num_tree_per_iteration, 1))
+            K = max(self.num_tree_per_iteration, 1)
+            n_own = min(self.iter, len(self.models) // K) \
+                - self.num_init_iteration
             if not c.uniform_drop and self.sum_weight > 0:
                 n_own = min(n_own, len(self.tree_weight))
                 inv_avg = len(self.tree_weight) / self.sum_weight
@@ -93,16 +96,18 @@ class DART(GBDT):
     def train_one_iter(self, grad=None, hess=None) -> bool:
         c = self.config
         K = self.num_tree_per_iteration
-        self.boost_from_average()
+        # (boost_from_average happens inside super().train_one_iter —
+        # calling it here too would double-add the init score at iter 0)
         drop = self._dropping_trees()
         k = len(drop)
+        off = self.num_init_iteration    # drop i -> model (off + i) * K + kk
 
         # remove dropped trees from the train score before gradients
         # (reference: GetTrainingScore -> DroppingTrees, dart.hpp:131-137)
         drop_preds = {}
         for i in drop:
             for kk in range(K):
-                p = self._tree_pred_train(i * K + kk)
+                p = self._pad_rows_np(self._tree_pred_train((off + i) * K + kk))
                 drop_preds[(i, kk)] = p
                 self.train_score = self.train_score.at[kk].add(
                     -jnp.asarray(p, jnp.float32))
@@ -124,10 +129,10 @@ class DART(GBDT):
                 self.train_score = self.train_score.at[kk].add(
                     jnp.asarray(w * p, jnp.float32))
                 for vi in range(len(self.valid_scores)):
-                    vp = self._tree_pred_valid(i * K + kk, vi)
+                    vp = self._tree_pred_valid((off + i) * K + kk, vi)
                     self.valid_scores[vi] = self.valid_scores[vi].at[kk].add(
                         jnp.asarray(-(1.0 - w) * vp, jnp.float32))
-                self.models[i * K + kk].scale(w)
+                self.models[(off + i) * K + kk].scale(w)
             if not c.uniform_drop:
                 # reference Normalize: sum_weight -= tw/(k+1) (default) or
                 # tw/(k+lr) (xgboost mode), then tw *= w  (dart.hpp:176,195)

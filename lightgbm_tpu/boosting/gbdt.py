@@ -51,6 +51,7 @@ class GBDT:
         self.num_tree_per_iteration = (objective.num_model_per_iteration
                                        if objective is not None else config.num_class)
         self.iter = 0
+        self.num_init_iteration = 0        # iterations loaded via init_model
         self.models: List[HostTree] = []   # length = iter * K
         self.shrinkage_rate = config.learning_rate
 
@@ -60,29 +61,48 @@ class GBDT:
         # padded bin axis: power-of-two-ish friendly size
         self.num_bins = int(self.meta.max_num_bin)
 
-        self.binned = jnp.asarray(self.train_set.binned)
+        # distributed dispatch (reference: GBDT::Init -> CreateTreeLearner,
+        # gbdt.cpp:79 + tree_learner.cpp:13-36) — rows (tree_learner=data,
+        # voting) or features (tree_learner=feature) are sharded over a
+        # device mesh and the WHOLE per-iteration step runs under shard_map
+        self._setup_distribution()
+        n_pad = self._n_pad
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if self._data_axis is not None:
+                b = np.pad(self.train_set.binned, ((0, n_pad - n), (0, 0)))
+                self.binned = jax.device_put(
+                    b, NamedSharding(self._mesh, P(self._data_axis, None)))
+            else:
+                F_pad = self._f_pad
+                b = np.pad(self.train_set.binned, ((0, 0), (0, F_pad - F)))
+                self.binned = jax.device_put(
+                    b, NamedSharding(self._mesh, P(None, self._feature_axis)))
+        else:
+            self.binned = jnp.asarray(self.train_set.binned)
+        rv = np.zeros(n_pad, np.float32)
+        rv[:n] = 1.0
+        self._row_valid = jnp.asarray(rv)
         if objective is not None:
             objective.init(self.train_set.metadata, self.num_data)
 
-        self.grower_cfg = GrowerConfig(
-            num_leaves=config.num_leaves,
-            max_depth=config.max_depth,
-            hp=config.split_hyperparams(),
-            hist_method=config.tpu_hist_method,
-            num_bins=self.num_bins,
-            learning_rate=config.learning_rate,
-        )
-
+        # (self.grower_cfg is derived inside _build_jit_fns, called below)
         K = self.num_tree_per_iteration
-        self.train_score = jnp.zeros((K, n), jnp.float32)
+        self.train_score = jnp.zeros((K, n_pad), jnp.float32)
+        if self._mesh is not None and self._data_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self.train_score = jax.device_put(
+                self.train_score,
+                NamedSharding(self._mesh, P(None, self._data_axis)))
         self.init_scores = [0.0] * K
         self._init_score_added = False
         # user-provided init score (reference: score_updater has_init_score)
         if self.train_set.metadata.init_score is not None:
             isc = np.asarray(self.train_set.metadata.init_score, np.float32)
+            isc = (isc.reshape(-1, n) if isc.size == K * n else
+                   np.broadcast_to(isc.reshape(1, n), (K, n)))
             self.train_score = self.train_score + jnp.asarray(
-                isc.reshape(-1, n) if isc.size == K * n else
-                np.broadcast_to(isc.reshape(1, n), (K, n)))
+                np.pad(isc, ((0, 0), (0, n_pad - n))))
             self._init_score_added = True
 
         self.valid_sets: List[Dataset] = []
@@ -97,6 +117,74 @@ class GBDT:
         self._build_jit_fns()
 
     # ------------------------------------------------------------------ setup
+
+    def _setup_distribution(self) -> None:
+        """Pick the parallel mode from config.tree_learner and build the
+        mesh.  reference: CreateTreeLearner (tree_learner.cpp:13-36); with
+        one device every mode degenerates to serial (identical results)."""
+        self._mesh = None
+        self._data_axis = None
+        self._feature_axis = None
+        self._n_pad = self.num_data
+        self._f_pad = self.train_set.binned.shape[1]
+        self._meta_dist = None
+        tl = str(self.config.tree_learner).lower()
+        aliases = {"data_parallel": "data", "feature_parallel": "feature",
+                   "voting_parallel": "voting", "serial_tree_learner": "serial"}
+        tl = aliases.get(tl, tl)
+        if tl not in ("serial", "data", "feature", "voting"):
+            raise ValueError(f"unknown tree_learner {tl!r}")
+        self.tree_learner_type = tl
+        if tl == "serial" or jax.device_count() <= 1:
+            return
+        from ..parallel.learners import (DATA_AXIS, FEATURE_AXIS, make_mesh,
+                                         pad_rows_to)
+        ndev = jax.device_count()
+        if self.config.num_machines > 1:
+            ndev = min(ndev, self.config.num_machines)
+        if self.objective is not None and getattr(
+                self.objective, "need_group", False):
+            raise NotImplementedError(
+                "distributed training with ranking objectives requires "
+                "query-aligned row sharding (not implemented yet); use "
+                "tree_learner=serial")
+        if tl in ("data", "voting"):
+            self._mesh = make_mesh(ndev, (DATA_AXIS,))
+            self._data_axis = DATA_AXIS
+            self._n_pad = pad_rows_to(self.num_data, ndev)
+        else:  # feature
+            if self.meta.resolved().has_bundles:
+                raise NotImplementedError(
+                    "tree_learner=feature requires enable_bundle=false "
+                    "(EFB merges features into shared columns that cannot "
+                    "be sliced per feature shard)")
+            F = self.train_set.binned.shape[1]
+            self._mesh = make_mesh(ndev, (FEATURE_AXIS,))
+            self._feature_axis = FEATURE_AXIS
+            self._f_pad = (F + ndev - 1) // ndev * ndev
+            if self._f_pad > F:
+                import dataclasses
+                m = self.meta.resolved()
+                pad = self._f_pad - F
+                self._meta_dist = dataclasses.replace(
+                    m,
+                    num_bin=np.concatenate([m.num_bin, np.ones(pad, np.int32)]),
+                    missing_type=np.concatenate([m.missing_type, np.zeros(pad, np.int32)]),
+                    default_bin=np.concatenate([m.default_bin, np.zeros(pad, np.int32)]),
+                    most_freq_bin=np.concatenate([m.most_freq_bin, np.zeros(pad, np.int32)]),
+                    is_categorical=np.concatenate([m.is_categorical, np.zeros(pad, bool)]),
+                    feat_group=np.arange(self._f_pad, dtype=np.int32),
+                    feat_start=np.ones(self._f_pad, np.int32),
+                    num_groups=self._f_pad,
+                )
+            else:
+                self._meta_dist = self.meta.resolved()
+
+    def _pad_rows_np(self, p: np.ndarray) -> np.ndarray:
+        """Pad a per-row host array to the sharded row count."""
+        pad = self._n_pad - self.num_data
+        p = np.asarray(p, np.float32)
+        return np.pad(p, (0, pad)) if pad else p
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         valid_set.construct()
@@ -118,6 +206,20 @@ class GBDT:
 
     def _build_jit_fns(self) -> None:
         K = self.num_tree_per_iteration
+        nmach = 1
+        vote_k = 0
+        if self._mesh is not None and self._data_axis is not None:
+            nmach = int(self._mesh.shape[self._data_axis])
+            if self.tree_learner_type == "voting":
+                vote_k = self.config.top_k
+        # feature_fraction_bynode -> exact per-node sample count
+        # (reference: ColSampler::GetCnt, col_sampler.hpp:28-33)
+        F_used = len(self.train_set.used_features)
+        bynode_cnt = 0
+        if self.config.feature_fraction_bynode < 1.0:
+            bynode_cnt = max(
+                int(round(F_used * self.config.feature_fraction_bynode)),
+                min(2, F_used))
         # re-derive the grower config so reset_parameter() of tree
         # hyper-parameters (lambda_l1, min_data_in_leaf, ...) takes effect
         self.grower_cfg = GrowerConfig(
@@ -128,41 +230,78 @@ class GBDT:
             num_bins=self.num_bins,
             learning_rate=self.config.learning_rate,
             compact=self.config.tpu_compact_hist,
+            voting_top_k=vote_k,
+            num_machines=nmach,
+            bynode_feature_cnt=bynode_cnt,
         )
+        # per-node randomness base key (extra_trees thresholds + by-node
+        # column sampling); advanced by iteration in train_one_iter
+        self._node_key_base = jax.random.PRNGKey(
+            (self.config.extra_trees_seed * 2654435761
+             ^ self.config.feature_fraction_seed) % (2 ** 31))
         cfg = self.grower_cfg
         obj = self.objective
+        n = self.num_data
+        n_pad = self._n_pad
         renew_pct = obj.renew_percentile if obj is not None else None
-        weight = (jnp.asarray(self.train_set.metadata.weight)
-                  if self.train_set.metadata.weight is not None else None)
-        label = (jnp.asarray(self.train_set.metadata.label)
-                 if obj is not None and obj.renew_percentile is not None else None)
+        weight_np = (np.asarray(self.train_set.metadata.weight, np.float32)
+                     if self.train_set.metadata.weight is not None else None)
+        label_np = (np.asarray(self.train_set.metadata.label, np.float32)
+                    if obj is not None and renew_pct is not None else None)
+        # label/weight ride through the (possibly sharded) step as explicit
+        # row arrays; dummies when unused (DCE'd by XLA)
+        label_a = jnp.asarray(self._pad_rows_np(
+            label_np if label_np is not None else np.zeros(n, np.float32)))
+        weight_a = jnp.asarray(self._pad_rows_np(
+            weight_np if weight_np is not None else np.ones(n, np.float32)))
+        use_renew = renew_pct is not None
         mc = self.config.monotone_constraints
         if mc:
             # align per-original-feature constraints with the used (binned)
             # feature columns — trivial features are dropped at binning
             mc_full = np.zeros(self.train_set.num_total_features, np.int32)
             mc_full[:len(mc)] = np.asarray(mc, np.int32)
-            mc = jnp.asarray(mc_full[self.train_set.used_features])
+            mc = mc_full[self.train_set.used_features]
+            if self._feature_axis is not None and self._f_pad > len(mc):
+                mc = np.concatenate(
+                    [mc, np.zeros(self._f_pad - len(mc), np.int32)])
+            mc = jnp.asarray(mc)
         else:
             mc = None
+        meta = self._meta_dist if self._meta_dist is not None else self.meta
 
-        def one_iter(score, row_mask, grad, hess, fmask, lr):
-            """grad/hess: [K, n]; fmask: [K, F] col-sample masks; lr: traced
-            scalar so a learning_rates schedule never recompiles.
+        def iter_body(binned, score, row_mask, grad, hess, fmask, lr, rng,
+                      label_r, weight_r, axis_name, feature_axis_name):
+            """grad/hess: [K, rows]; fmask: [K, F] col-sample masks; lr:
+            traced scalar so a learning_rates schedule never recompiles;
+            rng: per-iteration PRNG key for node-level randomness.
             Returns (new_score, stacked trees, leaf_ids)."""
             trees = []
             leaf_ids = []
             new_score = score
             for k in range(K):
-                tree, leaf_id = grow_tree(self.binned, grad[k], hess[k],
-                                          row_mask, self.meta, cfg,
+                tree, leaf_id = grow_tree(binned, grad[k], hess[k],
+                                          row_mask, meta, cfg,
                                           feature_mask=fmask[k],
-                                          monotone_constraints=mc)
-                if renew_pct is not None:
-                    residual = label - new_score[k]
-                    w = row_mask if weight is None else row_mask * weight
+                                          monotone_constraints=mc,
+                                          axis_name=axis_name,
+                                          feature_axis_name=feature_axis_name,
+                                          rng_key=jax.random.fold_in(rng, k))
+                if use_renew:
+                    residual = label_r - new_score[k]
+                    w = row_mask * weight_r
                     pct = leaf_percentile(leaf_id, residual, w,
                                           cfg.num_leaves, float(renew_pct))
+                    if axis_name is not None:
+                        # reference: distributed RenewTreeOutput averages the
+                        # per-machine renewed outputs over machines that have
+                        # rows in the leaf (serial_tree_learner.cpp:654-663)
+                        has = jax.ops.segment_sum(
+                            (w > 0).astype(jnp.float32), leaf_id,
+                            num_segments=cfg.num_leaves) > 0
+                        cnt = jax.lax.psum(has.astype(jnp.float32), axis_name)
+                        pct = jax.lax.psum(jnp.where(has, pct, 0.0), axis_name)
+                        pct = pct / jnp.maximum(cnt, 1.0)
                     active = jnp.arange(cfg.num_leaves) < tree.num_leaves
                     tree = tree._replace(
                         leaf_value=jnp.where(active, pct, tree.leaf_value))
@@ -176,7 +315,34 @@ class GBDT:
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
             return new_score, stacked, jnp.stack(leaf_ids)
 
-        self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
+        if self._mesh is None:
+            def one_iter(score, row_mask, grad, hess, fmask, lr, rng):
+                return iter_body(self.binned, score, row_mask, grad, hess,
+                                 fmask, lr, rng, label_a, weight_a,
+                                 None, None)
+            self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
+        else:
+            from jax.sharding import PartitionSpec as P
+            ax_d, ax_f = self._data_axis, self._feature_axis
+
+            def core(binned, score, row_mask, grad, hess, fmask, lr, rng,
+                     label_r, weight_r):
+                return iter_body(binned, score, row_mask, grad, hess, fmask,
+                                 lr, rng, label_r, weight_r, ax_d, ax_f)
+
+            row = P(ax_d)          # replicated when ax_d is None
+            krow = P(None, ax_d)
+            sharded = jax.shard_map(
+                core, mesh=self._mesh,
+                in_specs=(P(ax_d, ax_f), krow, row, krow, krow, P(), P(),
+                          P(), row, row),
+                out_specs=(krow, P(), krow),
+                check_vma=False)
+
+            def one_iter(score, row_mask, grad, hess, fmask, lr, rng):
+                return sharded(self.binned, score, row_mask, grad, hess,
+                               fmask, lr, rng, label_a, weight_a)
+            self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
         if not hasattr(self, "_feature_rng"):  # survive jit-fn rebuilds
             self._feature_rng = np.random.RandomState(
                 self.config.feature_fraction_seed)
@@ -185,10 +351,14 @@ class GBDT:
         def gradients_fn(score):
             if obj is None:
                 raise RuntimeError("no objective: gradients must be provided")
-            s = score if K > 1 else score[0]
+            s = score if n_pad == n else score[:, :n]
+            s = s if K > 1 else s[0]
             g, h = obj.get_gradients(s)
-            g = g.reshape(K, -1)
-            h = h.reshape(K, -1)
+            g = g.reshape(K, n)
+            h = h.reshape(K, n)
+            if n_pad > n:
+                g = jnp.pad(g, ((0, 0), (0, n_pad - n)))
+                h = jnp.pad(h, ((0, 0), (0, n_pad - n)))
             return g, h
 
         self._gradients_fn = jax.jit(gradients_fn)
@@ -213,7 +383,7 @@ class GBDT:
         need = (c.bagging_freq > 0 and c.bagging_fraction < 1.0)
         need_posneg = (c.pos_bagging_fraction < 1.0 or c.neg_bagging_fraction < 1.0)
         if not (need or need_posneg):
-            return jnp.ones(n, jnp.float32)
+            return self._row_valid
         if it % max(c.bagging_freq, 1) != 0 and self._cur_mask is not None:
             return self._cur_mask
         if need_posneg:
@@ -226,7 +396,8 @@ class GBDT:
             idx = self._rng.choice(n, size=cnt, replace=False)
             keep = np.zeros(n, bool)
             keep[idx] = True
-        self._cur_mask = jnp.asarray(keep.astype(np.float32))
+        self._cur_mask = jnp.asarray(
+            self._pad_rows_np(keep.astype(np.float32)))
         return self._cur_mask
 
     _cur_mask = None
@@ -236,13 +407,16 @@ class GBDT:
         src/treelearner/col_sampler.hpp:19)."""
         K = self.num_tree_per_iteration
         F = len(self.train_set.used_features)   # features, not EFB columns
+        Fp = max(self._f_pad, F)                # padded for feature sharding
         frac = self.config.feature_fraction
         if frac >= 1.0:
             if self._ones_fmask is None:
-                self._ones_fmask = jnp.ones((K, F), jnp.float32)
+                ones = np.zeros((K, Fp), np.float32)
+                ones[:, :F] = 1.0
+                self._ones_fmask = jnp.asarray(ones)
             return self._ones_fmask
         cnt = max(1, int(round(F * frac)))
-        masks = np.zeros((K, F), np.float32)
+        masks = np.zeros((K, Fp), np.float32)
         for k in range(K):
             masks[k, self._feature_rng.choice(F, size=cnt, replace=False)] = 1.0
         return jnp.asarray(masks)
@@ -256,6 +430,9 @@ class GBDT:
             return
         if not self.config.boost_from_average:
             return
+        # mark done so a second call in the same iteration (e.g. from a
+        # boosting subclass) cannot double-add the init score
+        self._init_score_added = True
         K = self.num_tree_per_iteration
         for k in range(K):
             s = self.objective.boost_from_score(k)
@@ -276,14 +453,21 @@ class GBDT:
         if grad is None:
             grad, hess = self._boost(self.train_score)
         else:
-            grad = jnp.asarray(np.asarray(grad, np.float32).reshape(K, n))
-            hess = jnp.asarray(np.asarray(hess, np.float32).reshape(K, n))
+            grad = np.asarray(grad, np.float32).reshape(K, n)
+            hess = np.asarray(hess, np.float32).reshape(K, n)
+            if self._n_pad > n:
+                grad = np.pad(grad, ((0, 0), (0, self._n_pad - n)))
+                hess = np.pad(hess, ((0, 0), (0, self._n_pad - n)))
+            grad, hess = jnp.asarray(grad), jnp.asarray(hess)
         mask = self._bagging_mask(self.iter)
 
         self.train_score, stacked, leaf_ids = self._iter_fn(
             self.train_score, mask, grad, hess, self._feature_masks(),
-            jnp.float32(self.shrinkage_rate))
+            jnp.float32(self.shrinkage_rate), self._node_key())
         return self._finish_iter(stacked)
+
+    def _node_key(self):
+        return jax.random.fold_in(self._node_key_base, self.iter)
 
     def _finish_iter(self, stacked) -> bool:
         """Post-step bookkeeping shared by GBDT/GOSS/DART/RF: host copies of
@@ -312,6 +496,49 @@ class GBDT:
         self.iter += 1
         return False
 
+    def refit_leaf_values(self, leaf_preds: np.ndarray,
+                          decay_rate: float) -> None:
+        """Refit every tree's leaf values against THIS dataset's gradients,
+        keeping tree structures fixed.
+
+        reference: GBDT::RefitTree (gbdt.cpp:267-290) routes each row by
+        ``leaf_preds`` (pred_leaf output on the new data), recomputes leaf
+        sums per tree, and blends
+        ``decay * old + (1 - decay) * new_output * shrinkage``
+        (SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:198-229).
+        """
+        K = self.num_tree_per_iteration
+        n = self.num_data
+        leaf_preds = np.asarray(leaf_preds)
+        if leaf_preds.ndim == 1:
+            leaf_preds = leaf_preds[:, None]
+        if leaf_preds.shape != (n, len(self.models)):
+            raise ValueError(
+                f"leaf_preds shape {leaf_preds.shape} != "
+                f"({n}, {len(self.models)})")
+        c = self.config
+        for it in range(len(self.models) // K):
+            grad, hess = self._boost(self.train_score)
+            g = np.asarray(grad)[:, :n]
+            h = np.asarray(hess)[:, :n]
+            for k in range(K):
+                mi = it * K + k
+                m = self.models[mi]
+                lp = leaf_preds[:, mi].astype(np.int64)
+                if lp.max(initial=0) >= m.num_leaves:
+                    raise ValueError("leaf prediction out of range")
+                sg = np.bincount(lp, weights=g[k], minlength=m.num_leaves)
+                sh = np.bincount(lp, weights=h[k], minlength=m.num_leaves) \
+                    + K_EPSILON
+                reg = np.sign(sg) * np.maximum(np.abs(sg) - c.lambda_l1, 0.0)
+                out = -reg / (sh + c.lambda_l2)
+                if c.max_delta_step > 0:
+                    out = np.clip(out, -c.max_delta_step, c.max_delta_step)
+                m.leaf_value = (decay_rate * m.leaf_value
+                                + (1.0 - decay_rate) * out * m.shrinkage)
+                self.train_score = self.train_score.at[k].add(
+                    jnp.asarray(self._pad_rows_np(m.leaf_value[lp])))
+
     def rollback_one_iter(self) -> None:
         """reference: GBDT::RollbackOneIter (gbdt.cpp:422)."""
         if self.iter <= 0:
@@ -322,9 +549,9 @@ class GBDT:
         # subtract the dropped trees' contributions
         for k, ht in enumerate(dropped):
             self.train_score = self.train_score.at[k].add(
-                -jnp.asarray(ht.predict_binned_np(
+                -jnp.asarray(self._pad_rows_np(ht.predict_binned_np(
                     self.train_set.binned, self.train_set.feat_group,
-                    self.train_set.feat_start)))
+                    self.train_set.feat_start))))
         for i, vs in enumerate(self.valid_scores):
             for k, ht in enumerate(dropped):
                 self.valid_scores[i] = self.valid_scores[i].at[k].add(
@@ -348,6 +575,8 @@ class GBDT:
 
     def _eval(self, dataname, score, metrics, objective):
         score_np = np.asarray(score)
+        if score_np.shape[-1] > self.num_data and dataname == "training":
+            score_np = score_np[:, :self.num_data]   # drop sharding pad rows
         s = score_np if self.num_tree_per_iteration > 1 else score_np[0]
         out = []
         for m in metrics:

@@ -31,22 +31,27 @@ class GOSS(GBDT):
         top_rate = config.top_rate
         other_rate = config.other_rate
         n = self.num_data
+        n_pad = self._n_pad
+        row_valid = self._row_valid
 
         def goss_mask(grad, hess, key):
-            # grad/hess: [K, n]
+            # grad/hess: [K, n_pad]; sharding-pad rows (row_valid == 0) are
+            # pushed below any real score so they can never enter the top set
             score = jnp.sum(jnp.abs(grad * hess), axis=0)
+            score = score * row_valid - (1.0 - row_valid)
             top_k = max(1, int(top_rate * n))
             thresh = jax.lax.top_k(score, top_k)[0][-1]
             is_top = score >= thresh
             rest_p = other_rate / max(1e-12, 1.0 - top_rate)
-            keep_rest = jax.random.uniform(key, (n,)) < rest_p
+            keep_rest = jax.random.uniform(key, (n_pad,)) < rest_p
             amp = (1.0 - top_rate) / max(other_rate, 1e-12)
-            return jnp.where(is_top, 1.0, jnp.where(keep_rest, amp, 0.0))
+            return jnp.where(is_top, 1.0,
+                             jnp.where(keep_rest, amp, 0.0)) * row_valid
 
         self._goss_mask_fn = jax.jit(goss_mask)
 
     def _bagging_mask(self, it):
-        return jnp.ones(self.num_data, jnp.float32)
+        return self._row_valid
 
     def train_one_iter(self, grad=None, hess=None):
         # warm-up: no sampling for the first 1/learning_rate iterations
@@ -62,5 +67,5 @@ class GOSS(GBDT):
     def _train_with(self, grad, hess, mask):
         self.train_score, stacked, leaf_ids = self._iter_fn(
             self.train_score, mask, grad, hess, self._feature_masks(),
-            jnp.float32(self.shrinkage_rate))
+            jnp.float32(self.shrinkage_rate), self._node_key())
         return self._finish_iter(stacked)

@@ -203,7 +203,11 @@ class Dataset:
             # FastFeatureBundling, dataset.cpp:97-313)
             for j, f in enumerate(self.used_features):
                 col = _get_col(raw, sp, f, sample_idx)
-                sample_nonzero[j] = ~(np.isnan(col) | (np.abs(col) <= 1e-35))
+                # NaN counts as non-default: a NaN row occupies the
+                # feature's NaN bin in the merged column, so it can
+                # conflict with other bundle members (reference counts
+                # sampled NaN values as non-zero entries)
+                sample_nonzero[j] = np.isnan(col) | (np.abs(col) > 1e-35)
             self._build_groups(sample_nonzero, total_sample_cnt)
 
         # second pass: bin every row into the per-GROUP merged columns

@@ -128,10 +128,16 @@ def _apply_init_model(booster: Booster, predictor: Booster, train_set: Dataset):
     n = train_set.num_data
     isc = np.asarray(raw, np.float32).reshape(-1, K).T if K > 1 else \
         np.asarray(raw, np.float32).reshape(1, n)
+    n_pad = booster.boosting._n_pad
+    if n_pad > n:
+        isc = np.pad(isc, ((0, 0), (0, n_pad - n)))
     booster.boosting.train_score = booster.boosting.train_score + jnp.asarray(isc)
     booster.boosting._init_score_added = True
     booster.boosting.models = list(predictor.models)
     booster.boosting.iter = len(predictor.models) // K
+    # continued-training bookkeeping (reference: num_init_iteration_,
+    # gbdt.cpp LoadModelFromString): DART must only drop this-run trees
+    booster.boosting.num_init_iteration = len(predictor.models) // K
 
 
 def _recover_raw(train_set: Dataset):

@@ -37,7 +37,7 @@ from .dataset import FeatureMeta
 from .ops.histogram import (build_histogram, capacity_schedule,
                             compacted_histogram)
 from .ops.split import (MAX_CAT_WORDS, SplitHyperparams, SplitResult,
-                        best_split_for_leaf, leaf_output)
+                        best_split_for_leaf, feature_best_splits, leaf_output)
 
 
 class TreeArrays(NamedTuple):
@@ -138,6 +138,14 @@ class GrowerConfig(NamedTuple):
     learning_rate: float = 0.1
     compact: bool = True           # bucketed leaf-row compaction (see
                                    # ops/histogram.py capacity_schedule)
+    voting_top_k: int = 0          # >0 under a data axis: voting-parallel
+                                   # (PV-Tree) — only the top-k elected
+                                   # features' histograms are psum'd
+    num_machines: int = 1          # data-axis size (static; scales the
+                                   # voting pass's local constraints)
+    bynode_feature_cnt: int = 0    # >0: feature_fraction_bynode — sample
+                                   # this many features per NODE (reference
+                                   # ColSampler::GetByNode, col_sampler.hpp:87)
 
 
 def _psum(x, axis_name):
@@ -180,6 +188,9 @@ def grow_tree(
     axis_name: Optional[str] = None,            # mesh axis sharding ROWS
     feature_axis_name: Optional[str] = None,    # mesh axis sharding FEATURES
     monotone_constraints: Optional[jax.Array] = None,  # [F] i32 in {-1,0,1}
+    rng_key: Optional[jax.Array] = None,        # PRNG for extra_trees /
+                                                # by-node column sampling
+                                                # (replicated across shards)
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32).
 
@@ -208,6 +219,10 @@ def grow_tree(
             "feature-axis sharding requires enable_bundle=false (EFB merges "
             "features into shared columns, which cannot be row-sliced per "
             "feature shard)")
+    # full (unsliced) constraints for split-time bound propagation, which
+    # looks up by GLOBAL feature index even when features are sharded
+    mc_full = (jnp.asarray(monotone_constraints)
+               if monotone_constraints is not None else None)
     if feature_axis_name is not None:
         # features sharded: each device's binned holds G columns of the full
         # feature axis (identity groups); slice the full meta arrays
@@ -219,6 +234,11 @@ def grow_tree(
         missing_type = shard_slice(meta.missing_type)
         default_bin = shard_slice(meta.default_bin)
         is_cat = shard_slice(meta.is_categorical)
+        if feature_mask is not None:
+            feature_mask = lax.dynamic_slice_in_dim(feature_mask, fidx * F, F)
+        if monotone_constraints is not None:
+            monotone_constraints = lax.dynamic_slice_in_dim(
+                jnp.asarray(monotone_constraints), fidx * F, F)
         f_offset = fidx * F
         feat_group = jnp.arange(F, dtype=jnp.int32)
         feat_start = jnp.ones(F, jnp.int32)
@@ -259,13 +279,107 @@ def grow_tree(
         def expand_hist(ghist, sg, sh, cnt):
             return ghist   # identity groups: group hist IS the feature hist
 
-    def leaf_best(ghist, sg, sh, cnt, depth):
+    voting = (cfg.voting_top_k > 0 and axis_name is not None)
+    if voting and feature_axis_name is not None:
+        raise NotImplementedError("voting-parallel is a data-axis mode; "
+                                  "combine with feature sharding is not "
+                                  "supported")
+
+    # per-node randomness: extra_trees thresholds + by-node column sampling.
+    # The key is REPLICATED across shards (reference syncs random seeds
+    # across machines, application.cpp:169-174); by-node masks are sampled
+    # over the GLOBAL feature axis then sliced per shard.
+    F_total = len(meta.num_bin)
+    use_rng = hp.extra_trees or cfg.bynode_feature_cnt > 0
+    if use_rng and rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+
+    def node_rand(key):
+        """(by-node feature mask or None, extra-trees uniforms or None)."""
+        fm_bn, eru = None, None
+        if cfg.bynode_feature_cnt > 0:
+            u = jax.random.uniform(jax.random.fold_in(key, 0), (F_total,))
+            kth = -lax.top_k(-u, cfg.bynode_feature_cnt)[0][-1]
+            bn = u <= kth
+            if feature_axis_name is not None:
+                bn = lax.dynamic_slice_in_dim(bn, f_offset, F)
+            fm_bn = bn.astype(jnp.float32)
+        if hp.extra_trees:
+            eru = jax.random.uniform(jax.random.fold_in(key, 1), (F_total, 2))
+            if feature_axis_name is not None:
+                eru = lax.dynamic_slice_in_dim(eru, f_offset, F, axis=0)
+        return fm_bn, eru
+
+    def leaf_best_voting(ghist_local, sg, sh, cnt, bounds, fm, eru):
+        """Voting-parallel (PV-Tree) best split: local per-feature gains ->
+        top-k vote -> psum ONLY the elected features' histograms.
+
+        reference: voting_parallel_tree_learner.cpp — local candidates with
+        1/num_machines-scaled constraints (:57-59), GlobalVoting weighted by
+        local leaf count (:153-182), CopyLocalHistogram + ReduceScatter of
+        elected features only (:186-245).  Here the reduce-scatter+ownership
+        dance collapses to one psum of a [top_k, B, 3] gather.
+        """
+        ndev = max(cfg.num_machines, 1)
+        k = min(cfg.voting_top_k, F)
+        hp_local = hp._replace(
+            min_data_in_leaf=max(1, hp.min_data_in_leaf // ndev),
+            min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / ndev)
+        loc = ghist_local[0].sum(axis=0)      # local (sg, sh, cnt): every
+        # row lands in exactly one bin of column 0, so its totals are the
+        # local leaf totals
+        hist_loc = expand_hist(ghist_local, loc[0], loc[1], loc[2])
+        pf = feature_best_splits(
+            hist_loc, loc[0], loc[1], loc[2], num_bin, missing_type,
+            default_bin, is_cat, hp_local, feature_mask=fm,
+            monotone_constraints=monotone_constraints,
+            leaf_output_bounds=bounds, has_categorical=has_cat,
+            extra_rand_u=eru)
+        # weighted gain (GlobalVoting :166): local gain scaled by the local
+        # share of the leaf's rows
+        mean_cnt = jnp.maximum(cnt / ndev, 1.0)
+        rc_loc = loc[2] - pf.left_count
+        wgain = jnp.where(jnp.isfinite(pf.gain),
+                          pf.gain * (pf.left_count + rc_loc) / mean_cnt,
+                          -jnp.inf)
+        top_g, top_i = lax.top_k(wgain, k)
+        all_i = lax.all_gather(top_i, axis_name).reshape(-1)
+        all_g = lax.all_gather(top_g, axis_name).reshape(-1)
+        votes = jnp.full(F, -jnp.inf, jnp.float32).at[all_i].max(
+            jnp.where(jnp.isfinite(all_g), all_g, -jnp.inf))
+        _, elected = lax.top_k(votes, k)
+        sub = lax.psum(hist_loc[elected], axis_name)   # [k, B, 3]: the only
+        # O(bins) collective — k*B*3 words vs data-parallel's F*B*3
+        r = best_split_for_leaf(
+            sub, sg, sh, cnt, num_bin[elected], missing_type[elected],
+            default_bin[elected], is_cat[elected], hp,
+            feature_mask=(fm[elected] if fm is not None else None),
+            monotone_constraints=(monotone_constraints[elected]
+                                  if monotone_constraints is not None else None),
+            leaf_output_bounds=bounds, has_categorical=has_cat,
+            extra_rand_u=(eru[elected] if eru is not None else None))
+        return r._replace(feature=elected[r.feature])
+
+    def leaf_best(ghist, sg, sh, cnt, depth, bounds=None, key=None):
+        fm_bn, eru = node_rand(key) if (use_rng and key is not None) \
+            else (None, None)
+        fm = feature_mask
+        if fm_bn is not None:
+            fm = fm_bn if fm is None else fm * fm_bn
+        if voting:
+            r = leaf_best_voting(ghist, sg, sh, cnt, bounds, fm, eru)
+            if cfg.max_depth > 0:
+                r = r._replace(gain=jnp.where(depth >= cfg.max_depth,
+                                              -jnp.inf, r.gain))
+            return r
         hist = expand_hist(ghist, sg, sh, cnt)
         r = best_split_for_leaf(
             hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
-            hp, feature_mask=feature_mask,
+            hp, feature_mask=fm,
             monotone_constraints=monotone_constraints,
-            has_categorical=has_cat)
+            leaf_output_bounds=bounds,
+            has_categorical=has_cat,
+            extra_rand_u=eru)
         # depth limit (reference: serial_tree_learner.cpp:261-301 pruning)
         if cfg.max_depth > 0:
             r = r._replace(gain=jnp.where(depth >= cfg.max_depth, -jnp.inf, r.gain))
@@ -279,7 +393,11 @@ def grow_tree(
         return r
 
     # ---- root ----
-    root_hist = _psum(hist_fn(binned, grad, hess, row_mask), axis_name)
+    # voting mode: the histogram cache holds LOCAL (per-shard) histograms;
+    # only elected features are ever psum'd (inside leaf_best_voting).
+    # Scalars stay global either way.
+    hist_sync = (lambda h: h) if voting else (lambda h: _psum(h, axis_name))
+    root_hist = hist_sync(hist_fn(binned, grad, hess, row_mask))
     root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
     root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
     root_cnt = _psum(jnp.sum(row_mask), axis_name)
@@ -292,8 +410,17 @@ def grow_tree(
     leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
     # which internal node points at this leaf, and on which side (0=L,1=R)
     leaf_parent_side = jnp.zeros(L, jnp.int32)
+    # per-leaf monotone output bounds (reference: LeafConstraints,
+    # monotone_constraints.hpp:32; propagated to descendants on each split)
+    use_mc = monotone_constraints is not None
+    leaf_min = jnp.full(L, -jnp.inf, jnp.float32)
+    leaf_max = jnp.full(L, jnp.inf, jnp.float32)
+    root_bounds = (leaf_min[0], leaf_max[0]) if use_mc else None
+    root_key = jax.random.fold_in(rng_key, 0) if use_rng else None
     best = best.store(jnp.array(0), leaf_best(root_hist, root_sg, root_sh,
-                                              root_cnt, jnp.array(0)))
+                                              root_cnt, jnp.array(0),
+                                              bounds=root_bounds,
+                                              key=root_key))
     leaf_id = jnp.zeros(n, jnp.int32)
 
     class Carry(NamedTuple):
@@ -306,6 +433,8 @@ def grow_tree(
         leaf_parent_side: jax.Array
         leaf_id: jax.Array
         split_idx: jax.Array  # number of splits applied so far
+        leaf_min: jax.Array   # [L] monotone lower bounds
+        leaf_max: jax.Array   # [L] monotone upper bounds
 
     def cond(c: Carry):
         active = jnp.arange(L) < c.tree.num_leaves
@@ -396,34 +525,64 @@ def grow_tree(
         parent_hist = c.hist[leaf]
         small_member = leaf_id == small_leaf
         if cfg.compact and len(caps) > 1:
-            small_hist = _psum(
+            small_hist = hist_sync(
                 compacted_histogram(binned, grad, hess, row_mask, small_member,
-                                    B, caps, method=cfg.hist_method),
-                axis_name)
+                                    B, caps, method=cfg.hist_method))
         else:
-            small_hist = _psum(
-                hist_fn(binned, grad, hess, row_mask * small_member), axis_name)
+            small_hist = hist_sync(
+                hist_fn(binned, grad, hess, row_mask * small_member))
         large_hist = parent_hist - small_hist
         hist_l = jnp.where(left_smaller, small_hist, large_hist)
         hist_r = jnp.where(left_smaller, large_hist, small_hist)
         hist = c.hist.at[leaf].set(hist_l).at[new_leaf].set(hist_r)
 
+        # -- monotone bound propagation (reference: UpdateConstraints,
+        # monotone_constraints.hpp:44 — children inherit the parent's
+        # bounds, and a numerical split on a constrained feature pins
+        # the midpoint of the clamped child outputs between them)
+        leaf_min, leaf_max = c.leaf_min, c.leaf_max
+        if use_mc:
+            p_min, p_max = leaf_min[leaf], leaf_max[leaf]
+            l_out = jnp.clip(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
+                                         hp.max_delta_step), p_min, p_max)
+            r_out = jnp.clip(leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
+                                         hp.max_delta_step), p_min, p_max)
+            mid = (l_out + r_out) * 0.5
+            mc_f = mc_full[feat]      # feat is a GLOBAL feature index
+            upd = (~ncat) & (mc_f != 0)
+            l_min = jnp.where(upd & (mc_f < 0), jnp.maximum(p_min, mid), p_min)
+            l_max = jnp.where(upd & (mc_f > 0), jnp.minimum(p_max, mid), p_max)
+            r_min = jnp.where(upd & (mc_f > 0), jnp.maximum(p_min, mid), p_min)
+            r_max = jnp.where(upd & (mc_f < 0), jnp.minimum(p_max, mid), p_max)
+            leaf_min = leaf_min.at[leaf].set(l_min).at[new_leaf].set(r_min)
+            leaf_max = leaf_max.at[leaf].set(l_max).at[new_leaf].set(r_max)
+            bounds_l = (l_min, l_max)
+            bounds_r = (r_min, r_max)
+        else:
+            bounds_l = bounds_r = None
+
         # -- best splits for the two children
-        rl = leaf_best(hist_l, lg, lh, lc, new_depth)
-        rr = leaf_best(hist_r, rg, rh, rc, new_depth)
+        kl = jax.random.fold_in(rng_key, 1 + 2 * s) if use_rng else None
+        kr = jax.random.fold_in(rng_key, 2 + 2 * s) if use_rng else None
+        rl = leaf_best(hist_l, lg, lh, lc, new_depth, bounds=bounds_l, key=kl)
+        rr = leaf_best(hist_r, rg, rh, rc, new_depth, bounds=bounds_r, key=kr)
         best = best.store(leaf, rl).store(new_leaf, rr)
 
         return Carry(tree, best, hist, leaf_sg, leaf_sh, leaf_cnt,
-                     leaf_parent_side, leaf_id, s + 1)
+                     leaf_parent_side, leaf_id, s + 1, leaf_min, leaf_max)
 
     init = Carry(tree, best, hist_cache, leaf_sg, leaf_sh, leaf_cnt,
-                 leaf_parent_side, leaf_id, jnp.array(0, jnp.int32))
+                 leaf_parent_side, leaf_id, jnp.array(0, jnp.int32),
+                 leaf_min, leaf_max)
     out = lax.while_loop(cond, body, init)
 
-    # finalize leaf values
+    # finalize leaf values (clamped to monotone bounds, reference:
+    # CalculateSplittedLeafOutput USE_MC, feature_histogram.hpp:697-711)
     tree = out.tree
     lv = leaf_output(out.leaf_sg, out.leaf_sh, hp.lambda_l1, hp.lambda_l2,
                      hp.max_delta_step)
+    if use_mc:
+        lv = jnp.clip(lv, out.leaf_min, out.leaf_max)
     active = jnp.arange(L) < tree.num_leaves
     tree = tree._replace(
         leaf_value=jnp.where(active, lv, 0.0),

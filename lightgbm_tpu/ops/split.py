@@ -97,7 +97,28 @@ def leaf_output(g: jax.Array, h: jax.Array, l1: float, l2: float,
     return out
 
 
-def best_split_for_leaf(
+def leaf_gain_given_output(g: jax.Array, h: jax.Array, l1: float, l2: float,
+                           out: jax.Array) -> jax.Array:
+    """Gain of a leaf forced to emit ``out`` (e.g. clamped by monotone
+    bounds).  reference: GetLeafGainGivenOutput (feature_histogram.hpp:760)."""
+    sg = threshold_l1(g, l1)
+    return -(2.0 * sg * out + (h + l2) * out * out)
+
+
+class PerFeatureBest(NamedTuple):
+    """Per-feature best split candidates (all arrays [F])."""
+
+    gain: jax.Array
+    threshold: jax.Array
+    default_left: jax.Array
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array
+    is_categorical: jax.Array
+    cat_bitset: jax.Array     # [F, MAX_CAT_WORDS]
+
+
+def feature_best_splits(
     hist: jax.Array,            # [F, B, 3] (grad, hess, count)
     sum_grad: jax.Array,        # scalar: leaf totals
     sum_hess: jax.Array,
@@ -111,10 +132,26 @@ def best_split_for_leaf(
     monotone_constraints: Optional[jax.Array] = None,  # [F] i32 in {-1,0,1}
     leaf_output_bounds: Optional[tuple] = None,        # (min, max) scalars
     has_categorical: bool = False,             # static: any categorical feature
-) -> SplitResult:
-    """Best split over all features of one leaf. Fully vectorized [F, B]."""
+    extra_rand_u: Optional[jax.Array] = None,  # [F, 2] uniforms: extra-trees
+    gain_penalty: Optional[jax.Array] = None,  # [F] CEGB gain penalty
+) -> PerFeatureBest:
+    """Best split PER FEATURE of one leaf. Fully vectorized [F, B].
+
+    The split into per-feature candidates + global argmax (see
+    ``best_split_for_leaf``) mirrors the reference's two stages and is the
+    seam the voting-parallel learner needs: local per-feature gains drive
+    the vote (voting_parallel_tree_learner.cpp:264-305) before any
+    histogram is exchanged.
+
+    extra_trees (reference: USE_RAND dispatch, feature_histogram.hpp:96-127):
+    when ``hp.extra_trees`` and ``extra_rand_u`` is given, each feature
+    evaluates exactly ONE random threshold (numerical: a random bin in
+    [0, num_bin-2]; categorical: a random one-hot category / sorted-scan
+    position) instead of the full scan.
+    """
     F, B, _ = hist.shape
     bins = jnp.arange(B, dtype=jnp.int32)
+    use_rand = hp.extra_trees and extra_rand_u is not None
 
     num_data = num_data.astype(jnp.float32)
     parent_gain = leaf_gain(sum_grad, sum_hess + 2 * K_EPSILON, hp.lambda_l1, hp.lambda_l2)
@@ -147,25 +184,37 @@ def best_split_for_leaf(
             (lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf)
             & (lh >= hp.min_sum_hessian_in_leaf) & (rh >= hp.min_sum_hessian_in_leaf)
         )
-        gain = leaf_gain(lg, lh, hp.lambda_l1, hp.lambda_l2) + \
-            leaf_gain(rg, rh, hp.lambda_l1, hp.lambda_l2)
-        if monotone_constraints is not None:
+        if monotone_constraints is None:
+            gain = leaf_gain(lg, lh, hp.lambda_l1, hp.lambda_l2) + \
+                leaf_gain(rg, rh, hp.lambda_l1, hp.lambda_l2)
+        else:
+            # monotone mode (reference: GetSplitGains USE_MC,
+            # feature_histogram.hpp:714-747): child outputs are clamped
+            # to the leaf's propagated bounds, the gain is computed FROM
+            # the clamped outputs, and the split is rejected when the
+            # clamped outputs violate the feature's constraint direction.
             lo = leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
             ro = leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
-            mc = monotone_constraints[:, None]
-            bad = ((mc > 0) & (lo > ro)) | ((mc < 0) & (lo < ro))
-            gain = jnp.where(bad, 0.0, gain)
             if leaf_output_bounds is not None:
                 lob, upb = leaf_output_bounds
-                viol = (jnp.clip(lo, lob, upb) != lo) | (jnp.clip(ro, lob, upb) != ro)
-                # reference clamps outputs, keeps gain; we keep gain too
-                del viol
+                lo = jnp.clip(lo, lob, upb)
+                ro = jnp.clip(ro, lob, upb)
+            mc = monotone_constraints[:, None]
+            bad = ((mc > 0) & (lo > ro)) | ((mc < 0) & (lo < ro))
+            gain = leaf_gain_given_output(lg, lh, hp.lambda_l1, hp.lambda_l2, lo) + \
+                leaf_gain_given_output(rg, rh, hp.lambda_l1, hp.lambda_l2, ro)
+            gain = jnp.where(bad, K_MIN_SCORE, gain)
         gain = jnp.where(ok & (gain > min_gain_shift), gain, K_MIN_SCORE)
         return gain, (lg, lh - K_EPSILON, lc)
 
     # valid thresholds: t in [0, num_bin-2], t not the missing bin when Zero
     t_valid = (bins[None, :] < (num_bin - 1)[:, None]) & valid_bin
     t_valid &= ~((missing_type[:, None] == MissingType.ZERO) & is_missing_bin)
+    if use_rand:
+        rand_t = jnp.floor(
+            extra_rand_u[:, 0] * jnp.maximum(num_bin - 1, 1).astype(jnp.float32)
+        ).astype(jnp.int32)
+        t_valid &= bins[None, :] == rand_t[:, None]
     has_missing_dir = (missing_type != MissingType.NONE) & (num_bin > 2)
 
     gain_r, left_r = eval_dir(jnp.zeros((F, 1), dtype=bool))   # missing -> right
@@ -199,9 +248,16 @@ def best_split_for_leaf(
     num_dl = use_left
 
     # ---- categorical features ---------------------------------------------
-    cat = _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin,
-                            valid_bin, hp) if has_categorical else None
+    cat = _best_categorical(
+        hist, sum_grad, sum_hess, num_data, num_bin, valid_bin, hp,
+        rand_u=(extra_rand_u[:, 1] if use_rand else None),
+    ) if has_categorical else None
 
+    # each feature's gain is shifted by ITS OWN parent gain (categorical
+    # uses l2+cat_l2, reference feature_histogram.hpp:268-276) so the
+    # cross-feature argmax compares the same quantity the reference does
+    num_gain = jnp.where(jnp.isfinite(num_gain), num_gain - min_gain_shift,
+                         K_MIN_SCORE)
     if cat is not None:
         c_gain, c_thr, c_lg, c_lh, c_lc, c_bitset = cat
         feat_gain = jnp.where(is_categorical, c_gain, num_gain)
@@ -216,31 +272,81 @@ def best_split_for_leaf(
         feat_lg, feat_lh, feat_lc, feat_dl = num_lg, num_lh, num_lc, num_dl
         bitsets = jnp.zeros((F, MAX_CAT_WORDS), dtype=jnp.uint32)
 
+    if gain_penalty is not None:
+        # CEGB (reference: CostEfficientGradientBoosting::DetlaGain,
+        # cost_effective_gradient_boosting.hpp:50 — subtracted from the
+        # shifted split gain before the cross-feature argmax)
+        feat_gain = jnp.where(jnp.isfinite(feat_gain),
+                              feat_gain - gain_penalty, K_MIN_SCORE)
     if feature_mask is not None:
         feat_gain = jnp.where(feature_mask.astype(bool), feat_gain, K_MIN_SCORE)
 
-    # global best feature; ties -> smaller feature index (reference:
-    # SplitInfo::operator> tie-break, split_info.hpp:126-155)
-    best_f = jnp.argmax(feat_gain).astype(jnp.int32)
-    bg = feat_gain[best_f]
-    blg, blh, blc = feat_lg[best_f], feat_lh[best_f], feat_lc[best_f]
+    return PerFeatureBest(
+        gain=feat_gain,
+        threshold=feat_thr,
+        default_left=feat_dl,
+        left_sum_grad=feat_lg,
+        left_sum_hess=feat_lh,
+        left_count=feat_lc,
+        is_categorical=is_categorical,
+        cat_bitset=bitsets,
+    )
+
+
+def best_split_for_leaf(
+    hist: jax.Array,
+    sum_grad: jax.Array,
+    sum_hess: jax.Array,
+    num_data: jax.Array,
+    num_bin: jax.Array,
+    missing_type: jax.Array,
+    default_bin: jax.Array,
+    is_categorical: jax.Array,
+    hp: SplitHyperparams,
+    feature_mask: Optional[jax.Array] = None,
+    monotone_constraints: Optional[jax.Array] = None,
+    leaf_output_bounds: Optional[tuple] = None,
+    has_categorical: bool = False,
+    extra_rand_u: Optional[jax.Array] = None,
+    gain_penalty: Optional[jax.Array] = None,
+) -> SplitResult:
+    """Best split over all features of one leaf (see feature_best_splits)."""
+    pf = feature_best_splits(
+        hist, sum_grad, sum_hess, num_data, num_bin, missing_type,
+        default_bin, is_categorical, hp, feature_mask=feature_mask,
+        monotone_constraints=monotone_constraints,
+        leaf_output_bounds=leaf_output_bounds,
+        has_categorical=has_categorical, extra_rand_u=extra_rand_u,
+        gain_penalty=gain_penalty)
+    return pick_best_feature(pf, sum_grad, sum_hess, num_data)
+
+
+def pick_best_feature(pf: PerFeatureBest, sum_grad, sum_hess,
+                      num_data) -> SplitResult:
+    """argmax over features; ties -> smaller feature index (reference:
+    SplitInfo::operator> tie-break, split_info.hpp:126-155)."""
+    best_f = jnp.argmax(pf.gain).astype(jnp.int32)
+    bg = pf.gain[best_f]
+    blg, blh, blc = (pf.left_sum_grad[best_f], pf.left_sum_hess[best_f],
+                     pf.left_count[best_f])
     return SplitResult(
-        gain=jnp.where(jnp.isfinite(bg), bg - min_gain_shift, K_MIN_SCORE),
+        gain=bg,
         feature=best_f,
-        threshold=feat_thr[best_f],
-        default_left=feat_dl[best_f],
+        threshold=pf.threshold[best_f],
+        default_left=pf.default_left[best_f],
         left_sum_grad=blg,
         left_sum_hess=blh,
         left_count=blc,
         right_sum_grad=sum_grad - blg,
         right_sum_hess=sum_hess - blh,
         right_count=num_data - blc,
-        is_categorical=is_categorical[best_f],
-        cat_bitset=bitsets[best_f],
+        is_categorical=pf.is_categorical[best_f],
+        cat_bitset=pf.cat_bitset[best_f],
     )
 
 
-def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin, hp):
+def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin,
+                      hp, rand_u=None):
     """Categorical split search, vectorized over features.
 
     reference: FindBestThresholdCategoricalInner (feature_histogram.hpp:259-460).
@@ -265,6 +371,11 @@ def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin, hp
           & valid_bin)
     onehot_gain = leaf_gain(lg, lh, hp.lambda_l1, l2) + leaf_gain(rg, rh, hp.lambda_l1, l2)
     onehot_gain = jnp.where(ok & (onehot_gain > min_gain_shift), onehot_gain, K_MIN_SCORE)
+    if rand_u is not None:
+        rand_cat = jnp.floor(rand_u * num_bin.astype(jnp.float32)).astype(jnp.int32)
+        onehot_gain = jnp.where(
+            jnp.arange(B, dtype=jnp.int32)[None, :] == rand_cat[:, None],
+            onehot_gain, K_MIN_SCORE)
     oh_k = jnp.argmax(onehot_gain, axis=1)                        # [F]
     oh_gain = jnp.take_along_axis(onehot_gain, oh_k[:, None], 1)[:, 0]
 
@@ -303,6 +414,10 @@ def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin, hp
                & size_ok)
         gn = leaf_gain(clg, clh, hp.lambda_l1, l2) + leaf_gain(crg, crh, hp.lambda_l1, l2)
         gn = jnp.where(okd & (gn > min_gain_shift), gn, K_MIN_SCORE)
+        if rand_u is not None:
+            rand_pos = jnp.floor(
+                rand_u * n_usable[:, 0].astype(jnp.float32)).astype(jnp.int32)
+            gn = jnp.where(k_idx == rand_pos[:, None], gn, K_MIN_SCORE)
         kk = jnp.argmax(gn, axis=1)
         return jnp.take_along_axis(gn, kk[:, None], 1)[:, 0], kk, (clg, clh - K_EPSILON, clc)
 
@@ -320,6 +435,8 @@ def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin, hp
 
     is_onehot = num_bin <= hp.max_cat_to_onehot
     cat_gain = jnp.where(is_onehot, oh_gain, mm_gain)
+    cat_gain = jnp.where(jnp.isfinite(cat_gain), cat_gain - min_gain_shift,
+                         K_MIN_SCORE)
     cat_lg = jnp.where(is_onehot, jnp.take_along_axis(lg, oh_k[:, None], 1)[:, 0], mm_lg)
     cat_lh = jnp.where(is_onehot,
                        jnp.take_along_axis(lh, oh_k[:, None], 1)[:, 0] - K_EPSILON, mm_lh)

@@ -1,0 +1,117 @@
+"""Host-platform environment helpers.
+
+This image injects a TPU PJRT plugin into every Python process via
+``PYTHONPATH`` + ``sitecustomize`` (the ``axon`` plugin).  JAX initializes
+every *registered* plugin on first backend access — even when
+``JAX_PLATFORMS=cpu`` — so any process that only needs the virtual CPU
+mesh (tests, multichip dry-runs, CI) must strip the plugin from the
+environment *before* the interpreter starts.  These helpers build such an
+environment for subprocess/re-exec use.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+_AXON_MARKER = ".axon_site"
+_AXON_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "PALLAS_AXON_TPU_GEN",
+    "AXON_LOOPBACK_RELAY",
+    "AXON_POOL_SVC_OVERRIDE",
+    "TPU_WORKER_HOSTNAMES",
+)
+
+
+def tpu_plugin_active(environ=None) -> bool:
+    """True if the TPU plugin would be registered in a child interpreter."""
+    env = os.environ if environ is None else environ
+    if env.get("PALLAS_AXON_POOL_IPS"):
+        return True
+    return any(
+        _AXON_MARKER in p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+
+
+def clean_cpu_env(n_devices: int = 8, base=None) -> dict:
+    """Environment for a subprocess that must run on N virtual CPU devices.
+
+    Strips the TPU plugin injection, forces ``JAX_PLATFORMS=cpu`` and the
+    host-platform device count, and enables the persistent compilation
+    cache so repeated test runs skip recompiles.
+    """
+    env = dict(os.environ if base is None else base)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and _AXON_MARKER not in p
+    )
+    for var in _AXON_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.setdefault("JAX_ENABLE_X64", "0")
+    cache = os.path.join(_repo_root(), ".jax_cache")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    return env
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def force_cpu_inprocess(n_devices: int = 8) -> None:
+    """Pin this process's JAX to N virtual CPU devices, de-registering any
+    TPU plugin factory before backend initialization.
+
+    Works even after ``import jax`` (the plugin registers a *factory*;
+    the block happens at factory init inside ``backends()``), but must be
+    called before the first backend access.  No-op with a warning if
+    backends are already initialized.
+    """
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    cache = os.path.join(_repo_root(), ".jax_cache")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    import jax
+    from jax._src import xla_bridge as _xb
+    if _xb.backends_are_initialized():
+        import warnings
+        warnings.warn("force_cpu_inprocess called after JAX backend init; "
+                      "platform not changed")
+        return
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+
+
+def reexec_clean_cpu(argv=None, n_devices: int = 8, guard_var: str = "_LGBM_TPU_CPU_REEXEC") -> None:
+    """Replace the current process with one running under a clean CPU env.
+
+    No-op (returns) when already re-exec'd or when no TPU plugin is
+    active.  ``argv`` defaults to ``sys.argv`` (re-invoking the current
+    script verbatim under the interpreter); callers invoked via ``-c``
+    must pass an explicit argv.
+    """
+    if os.environ.get(guard_var):
+        return
+    if not tpu_plugin_active():
+        return
+    env = clean_cpu_env(n_devices)
+    env[guard_var] = "1"
+    os.execve(sys.executable, [sys.executable] + list(argv or sys.argv), env)

@@ -1,15 +1,23 @@
-"""Test environment: force a virtual 8-device CPU mesh before JAX imports.
+"""Test environment: force a virtual 8-device CPU mesh before JAX inits.
 
 Mirrors SURVEY.md section 4's prescription: multi-host-simulated collective
 tests with one process and 8 XLA CPU devices.  CPU is forced even when the
 session has a real TPU attached so tests are deterministic and parallel-safe;
 bench.py is the TPU entry point.
+
+This image injects a TPU PJRT plugin into every interpreter via
+sitecustomize, and JAX initializes every *registered* plugin on first
+backend access — even under ``JAX_PLATFORMS=cpu`` — which blocks on the
+TPU tunnel.  The plugin only registers a backend *factory*, so it can be
+de-registered in-process any time before the first backend access; that is
+what ``force_cpu_inprocess`` does (plus the host-device-count flag and the
+persistent XLA compilation cache so repeated runs skip recompiles).
 """
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu.utils.platform import force_cpu_inprocess  # noqa: E402
+
+force_cpu_inprocess(8)
