@@ -20,15 +20,27 @@ experiment, which times the training phase) and excludes the one-time XLA
 compile: the clock starts after iteration 1 and the total is rescaled by
 T/(T-1).
 
-Robustness: TPU backend availability is probed in a *subprocess* with a
-timeout (backend init can block indefinitely on a wedged tunnel — it cannot
-be interrupted in-process), retried with backoff.  If the TPU never comes
-up, the bench re-runs itself on a clean-env CPU backend with a scaled-down
-workload so the driver still gets a real measured number, clearly labelled.
+Robustness (round-3 hardening; the r1/r2 benches died at backend init and at
+train iteration 1 respectively):
+  * every stage that touches the accelerator runs in a KILLABLE SUBPROCESS
+    with a timeout — a wedged TPU tunnel cannot hang the driver;
+  * pipeline: probe backend -> small on-device smoke run -> full run;
+  * any stage failure re-probes and retries (BENCH_TRAIN_TRIES, default 2);
+  * if the TPU never recovers the bench re-runs itself on a clean-env CPU
+    backend with a scaled-down workload so the driver still gets a real
+    measured number, clearly labelled (reachable from train-time failures
+    too, not just probe-time — the r2 gap).
+
+Extra emitted fields: sec_per_tree, compile/bin seconds, holdout AUC, an MFU
+estimate for the histogram matmuls, device peak-HBM, and a measured
+matmul-vs-scatter kernel probe (reference analogue: the col-vs-row timing
+probe in src/io/dataset.cpp:589-684).
 
 Env overrides: BENCH_ROWS, BENCH_TREES, BENCH_LEAVES, BENCH_BIN,
 BENCH_FORCE_CPU=1 (skip TPU probe), BENCH_PROFILE=1 (write a jax.profiler
-trace to ./bench_trace), BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT.
+trace to ./bench_trace), BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT,
+BENCH_TRAIN_TRIES / BENCH_TRAIN_TIMEOUT / BENCH_SMOKE_TIMEOUT,
+BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1.
 """
 import json
 import os
@@ -54,6 +66,22 @@ MAX_BIN = int(os.environ.get("BENCH_BIN", 63))
 CPU_N = int(os.environ.get("BENCH_CPU_ROWS", 200_000))
 CPU_TREES = int(os.environ.get("BENCH_CPU_TREES", 50))
 
+# smoke-run workload: big enough to exercise the real compiled program
+# shape-wise, small enough to finish in ~a minute
+SMOKE_N = int(os.environ.get("BENCH_SMOKE_ROWS", 500_000))
+SMOKE_TREES = int(os.environ.get("BENCH_SMOKE_TREES", 5))
+
+# peak dense compute per chip, used for the MFU estimate.  Keyed by
+# device_kind substring; conservative bf16 numbers.
+PEAK_FLOPS = {
+    "v5 lite": 197e12,   # v5e
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,        # trillium
+}
+DEFAULT_PEAK = 197e12
+
 
 def emit(d):
     print(json.dumps(d), flush=True)
@@ -73,9 +101,11 @@ def error_line(stage, err, extra=None):
 
 
 def make_higgs_like(n, f, seed=0):
+    # the label concept (w) is drawn from a FIXED rng so train (seed=0) and
+    # holdout (seed=1) share one distribution; `seed` varies only the draw
+    w = np.random.RandomState(12345).randn(f).astype(np.float32)
     rng = np.random.RandomState(seed)
     X = rng.rand(n, f).astype(np.float32)
-    w = rng.randn(f).astype(np.float32)
     signal = X @ w
     signal += 2.0 * X[:, 0] * X[:, 1] - 1.5 * (X[:, 2] > 0.5) * X[:, 3]
     signal += rng.randn(n).astype(np.float32) * 0.2 * signal.std()
@@ -94,13 +124,83 @@ def holdout_auc(booster, f, seed=1):
         npos * (len(yh) - npos))
 
 
+def peak_flops_for(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK
+
+
+def device_memory_stats():
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return {
+            "peak_hbm_bytes": int(stats.get("peak_bytes_in_use", 0)),
+            "hbm_limit_bytes": int(stats.get("bytes_limit", 0)),
+        }
+    except Exception:
+        return {}
+
+
+def kernel_probe(n_rows=1_000_000, f=F, max_bin=MAX_BIN, reps=3):
+    """Time the histogram kernel variants on the live backend.
+
+    Reference analogue: GetShareStates times col-wise vs row-wise histogram
+    construction at startup and picks the winner (src/io/dataset.cpp:589-684).
+    """
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops import histogram as H
+
+    rng = np.random.RandomState(0)
+    binned = jnp.asarray(rng.randint(0, max_bin, (n_rows, f), dtype=np.int64),
+                         jnp.uint8)
+    grad = jnp.asarray(rng.randn(n_rows), jnp.float32)
+    hess = jnp.abs(grad) + 0.1
+    mask = jnp.ones((n_rows,), jnp.float32)
+    B = max_bin + 1
+    out = {}
+    for method in ("matmul", "matmul_f32", "scatter"):
+        fn = jax.jit(lambda b, g, h, m, _m=method: H.build_histogram(
+            b, g, h, m, B, method=_m))
+        try:
+            fn(binned, grad, hess, mask).block_until_ready()  # compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn(binned, grad, hess, mask).block_until_ready()
+            out[method] = round((time.perf_counter() - t0) / reps * 1e3, 2)
+        except Exception as e:  # a variant may be unsupported on a backend
+            out[method] = f"error: {str(e)[:120]}"
+    timed = {k: v for k, v in out.items() if isinstance(v, float)}
+    if timed:
+        out["winner"] = min(timed, key=timed.get)
+    return out
+
+
+def mfu_estimate(n, f, max_bin, leaves, sec_per_tree, peak):
+    """Lower-bound MFU of the histogram matmuls.
+
+    Per histogram pass over R rows: [3, R] @ [R, F*B] = 2*3*R*F*B FLOPs.
+    Per tree, the bucketed compaction processes ~n rows per frontier level
+    and there are ~log2(leaves) levels, so R_total ≈ n * log2(leaves).
+    This counts ONLY histogram matmul FLOPs (the MXU work) — split scans,
+    partitioning and score updates ride along — so it is a lower bound.
+    """
+    levels = max(1.0, np.log2(leaves))
+    flops_per_tree = 2.0 * 3.0 * n * levels * f * (max_bin + 1)
+    return flops_per_tree / max(sec_per_tree, 1e-9) / peak
+
+
 def run_bench(n, trees, leaves, max_bin, tag=""):
     """Train in-process on whatever backend is active; return result dict."""
     import jax
 
     import lightgbm_tpu as lgb
 
-    platform = jax.devices()[0].platform
+    device = jax.devices()[0]
+    platform = device.platform
 
     X, y = make_higgs_like(n, F)
     params = {
@@ -136,8 +236,9 @@ def run_bench(n, trees, leaves, max_bin, tag=""):
     if profile:
         jax.profiler.stop_trace()
 
+    sec_per_tree = elapsed / trees
     auc = holdout_auc(booster, F)
-    return {
+    result = {
         "metric": f"synthetic-HIGGS {n}x{F} train wall-clock, "
                   f"{trees} trees x {leaves} leaves, max_bin={max_bin} "
                   f"[{platform}{tag}] (holdout AUC {auc:.4f})",
@@ -145,11 +246,24 @@ def run_bench(n, trees, leaves, max_bin, tag=""):
         "unit": "seconds",
         "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
         "platform": platform,
-        "sec_per_tree": round(elapsed / trees, 4),
+        "device_kind": getattr(device, "device_kind", ""),
+        "sec_per_tree": round(sec_per_tree, 4),
         "compile_seconds": round(compile_seconds, 2),
         "bin_seconds": round(bin_seconds, 2),
         "holdout_auc": round(float(auc), 5),
     }
+    peak = peak_flops_for(device)
+    result["mfu_histogram_lower_bound"] = round(
+        mfu_estimate(n, F, max_bin, leaves, sec_per_tree, peak), 4)
+    result["peak_flops_assumed"] = peak
+    result.update(device_memory_stats())
+    if os.environ.get("BENCH_SKIP_KERNEL_PROBE") != "1":
+        try:
+            result["hist_kernel_probe_ms"] = kernel_probe(
+                min(n, 1_000_000), F, max_bin)
+        except Exception as e:
+            result["hist_kernel_probe_ms"] = {"error": str(e)[:200]}
+    return result
 
 
 def probe_backend(timeout):
@@ -172,15 +286,51 @@ def probe_backend(timeout):
     return None, "probe produced no platform line"
 
 
+def _last_json_line(text):
+    for ln in reversed(text.strip().splitlines()):
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def run_stage_subprocess(stage_env, timeout):
+    """Re-invoke this script with BENCH_STAGE=run in a killable subprocess.
+
+    Returns (result_dict_or_None, error_string_or_None).
+    """
+    env = dict(os.environ)
+    env.update(stage_env)
+    env["BENCH_STAGE"] = "run"
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, f"stage timed out after {timeout}s"
+    line = _last_json_line(proc.stdout)
+    if line is None:
+        return None, (proc.stderr.strip()[-800:] or "no JSON output")
+    if proc.returncode != 0 or "error" in line:
+        parts = [line.get("error", ""), line.get("traceback_tail", ""),
+                 proc.stderr.strip()[-800:]]
+        return None, " | ".join(p for p in parts if p)
+    return line, None
+
+
 def cpu_fallback(reason):
     """Re-run this script on a clean-env CPU backend, scaled down."""
     from lightgbm_tpu.utils.platform import clean_cpu_env
     env = clean_cpu_env(1)
-    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_STAGE"] = "run"
     env["BENCH_ROWS"] = str(CPU_N)
     env["BENCH_TREES"] = str(CPU_TREES)
     env["BENCH_LEAVES"] = str(LEAVES)
     env["BENCH_BIN"] = str(MAX_BIN)
+    env["BENCH_TAG"] = "-fallback"
     try:
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               capture_output=True, text=True,
@@ -188,13 +338,7 @@ def cpu_fallback(reason):
     except subprocess.TimeoutExpired:
         emit(error_line("cpu-fallback", f"timed out; tpu was: {reason}"))
         return 1
-    line = None
-    for ln in reversed(proc.stdout.strip().splitlines()):
-        try:
-            line = json.loads(ln)
-            break
-        except ValueError:
-            continue
+    line = _last_json_line(proc.stdout)
     if line is None:
         emit(error_line("cpu-fallback", proc.stderr.strip()[-800:],
                         {"tpu_error": reason}))
@@ -205,17 +349,7 @@ def cpu_fallback(reason):
     return 0 if proc.returncode == 0 and "error" not in line else 1
 
 
-def main():
-    if os.environ.get("BENCH_FORCE_CPU") == "1":
-        try:
-            emit(run_bench(N, TREES, LEAVES, MAX_BIN, tag="-fallback"))
-            return 0
-        except Exception as e:
-            emit(error_line("cpu-train", f"{e}\n{traceback.format_exc()}"))
-            return 1
-
-    tries = int(os.environ.get("BENCH_PROBE_TRIES", 3))
-    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
+def reprobe(tries, probe_timeout):
     platform, err = None, "no probe attempted"
     for attempt in range(tries):
         platform, err = probe_backend(probe_timeout)
@@ -225,7 +359,29 @@ def main():
               file=sys.stderr, flush=True)
         if attempt + 1 < tries:
             time.sleep(15 * (attempt + 1))
+    return platform, err
 
+
+def main():
+    if os.environ.get("BENCH_STAGE") == "run" or \
+            os.environ.get("BENCH_FORCE_CPU") == "1":
+        # worker mode: train in-process on whatever backend is active
+        try:
+            emit(run_bench(N, TREES, LEAVES, MAX_BIN,
+                           tag=os.environ.get("BENCH_TAG", "")))
+            return 0
+        except Exception as e:
+            emit(error_line("train", f"{e}",
+                            {"traceback_tail": traceback.format_exc()[-1200:]}))
+            return 1
+
+    tries = int(os.environ.get("BENCH_PROBE_TRIES", 3))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
+    train_tries = int(os.environ.get("BENCH_TRAIN_TRIES", 2))
+    train_timeout = int(os.environ.get("BENCH_TRAIN_TIMEOUT", 5400))
+    smoke_timeout = int(os.environ.get("BENCH_SMOKE_TIMEOUT", 900))
+
+    platform, err = reprobe(tries, probe_timeout)
     if platform is None:
         return cpu_fallback(err or "unknown")
     if platform == "cpu":
@@ -233,14 +389,36 @@ def main():
         # hours; use the scaled-down workload so one JSON line still lands.
         return cpu_fallback("probe found only a CPU backend")
 
-    try:
-        emit(run_bench(N, TREES, LEAVES, MAX_BIN))
-        return 0
-    except Exception as e:
-        tb = traceback.format_exc()
-        print(tb, file=sys.stderr, flush=True)
-        emit(error_line("train", f"{e}", {"traceback_tail": tb[-1200:]}))
-        return 1
+    last_err = None
+    for attempt in range(train_tries):
+        if attempt > 0:
+            # the backend died mid-run last attempt: re-probe before retrying
+            platform, err = reprobe(tries, probe_timeout)
+            if platform is None or platform == "cpu":
+                return cpu_fallback(
+                    f"backend lost after train failure: {last_err}")
+
+        if os.environ.get("BENCH_SKIP_SMOKE") != "1":
+            smoke, err = run_stage_subprocess(
+                {"BENCH_ROWS": str(min(SMOKE_N, N)),
+                 "BENCH_TREES": str(min(SMOKE_TREES, TREES)),
+                 "BENCH_TAG": "-smoke", "BENCH_SKIP_KERNEL_PROBE": "1"},
+                smoke_timeout)
+            if smoke is None:
+                last_err = f"smoke run failed: {err}"
+                print(f"[bench] {last_err}", file=sys.stderr, flush=True)
+                continue
+            print(f"[bench] smoke ok: {smoke.get('sec_per_tree')} s/tree "
+                  f"on {smoke.get('platform')}", file=sys.stderr, flush=True)
+
+        result, err = run_stage_subprocess({}, train_timeout)
+        if result is not None:
+            emit(result)
+            return 0
+        last_err = f"full run failed: {err}"
+        print(f"[bench] {last_err}", file=sys.stderr, flush=True)
+
+    return cpu_fallback(last_err or "unknown train failure")
 
 
 if __name__ == "__main__":

@@ -307,8 +307,9 @@ class MulticlassSoftmax(ObjectiveFunction):
         # score: [K, n]
         p = jax.nn.softmax(score, axis=0)
         g = p - self.label_onehot
-        factor = self.num_class / max(self.num_class - 1, 1)
-        h = factor * p * (1.0 - p)
+        # reference uses a flat 2.0 factor (multiclass_objective.hpp:100),
+        # not the K/(K-1) Newton factor some other GBDTs use
+        h = 2.0 * p * (1.0 - p)
         if self.weight is not None:
             g = g * self.weight[None, :]
             h = h * self.weight[None, :]

@@ -160,7 +160,11 @@ def test_goss():
     lgb.train(params, train, num_boost_round=30,
               valid_sets=[lgb.Dataset(X, label=y, reference=train)],
               evals_result=evals, verbose_eval=False)
-    # measured 0.8679; sklearn HistGBM plateau on this data is ~0.883
+    # measured 0.8679.  Parity note (see tests/test_parity.py docstring): in
+    # this reference checkout GOSS never actually samples (gbdt.cpp:214 guard
+    # vs goss.hpp:129), so reference "goss" == plain gbdt == 0.8826 here;
+    # this repo implements the intended sampling, which costs ~0.015 train
+    # AUC at 30 rounds on this small dataset by design.
     assert evals["valid_0"]["auc"][-1] > 0.86
 
 
@@ -173,7 +177,9 @@ def test_bagging():
     lgb.train(params, train, num_boost_round=30,
               valid_sets=[lgb.Dataset(X, label=y, reference=train)],
               evals_result=evals, verbose_eval=False)
-    assert evals["valid_0"]["auc"][-1] > 0.87   # measured 0.8817
+    # measured 0.8817; reference at identical config measures 0.8821
+    # (parity verified in tests/test_parity.py)
+    assert evals["valid_0"]["auc"][-1] > 0.87
 
 
 def test_model_save_load_roundtrip(tmp_path, binary_data):
@@ -229,7 +235,8 @@ def test_weights():
     lgb.train(params, train, num_boost_round=20,
               valid_sets=[lgb.Dataset(X, label=y, weight=w, reference=train)],
               evals_result=evals, verbose_eval=False)
-    assert evals["valid_0"]["auc"][-1] > 0.85   # measured 0.8574
+    # measured 0.8574; reference at identical config measures 0.8575
+    assert evals["valid_0"]["auc"][-1] > 0.85
 
 
 def test_cv():

@@ -1,0 +1,164 @@
+"""Numerical parity vs the locally built reference implementation.
+
+SURVEY.md section 4 prescribes a parity harness the reference itself lacks:
+train the same data through this package and through stock LightGBM
+(built from /root/reference by tools/build_reference.sh, staged at
+/tmp/refpkg) and compare metric trajectories and model-text cross-loading.
+
+Skipped wholesale when the reference lib is absent (CI/bench images build it
+once; ~2 min).  The reference package is pure ctypes so importing it next to
+the JAX stack is safe.
+
+Measured facts these tests pin down (round 3, binary.train 7000x28):
+
+==========  =========================  =========================
+config      reference AUC              this repo AUC
+==========  =========================  =========================
+30r plain   0.8825759152573261         0.8809875801255787
+30r bag .7  0.882125915650661          0.8816582569498983
+20r weight  0.8575449931338933         0.8574...
+iter-1 AUC  0.768800830329785          0.7688008303297851
+==========  =========================  =========================
+
+i.e. the round-1/2 "accuracy plateau" was the dataset at 30 rounds, not a
+split-quality deficiency: the reference plateaus identically (and reaches
+0.975 only at 100 rounds).  Bonus root cause: in this reference checkout
+``boosting=goss`` never samples at all -- GOSS::Bagging delegates to
+GBDT::Bagging (src/boosting/goss.hpp:129) whose guard requires
+``bag_data_cnt_ < num_data_`` (src/boosting/gbdt.cpp:214), but with GOSS's
+mandatory bagging_freq=0 ResetBaggingConfig leaves bag_data_cnt_ == num_data_
+forever, so reference GOSS == reference GBDT bit-for-bit.  This repo
+implements the *intended* GOSS (top-rate keep + other-rate sample after the
+1/learning_rate warm-up), which is why its GOSS trajectory legitimately
+differs from plain.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REFPKG = os.environ.get("LGBM_REF_PKG", "/tmp/refpkg")
+EXAMPLES = "/root/reference/examples"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(os.path.join(REFPKG, "lightgbm", "lib_lightgbm.so")),
+    reason="reference lib not built (run tools/build_reference.sh)",
+)
+
+
+@pytest.fixture(scope="module")
+def reflgb():
+    sys.path.insert(0, REFPKG)
+    import lightgbm
+    return lightgbm
+
+
+@pytest.fixture(scope="module")
+def binary_train():
+    d = np.loadtxt(f"{EXAMPLES}/binary_classification/binary.train")
+    return d[:, 1:], d[:, 0]
+
+
+@pytest.fixture(scope="module")
+def binary_test():
+    d = np.loadtxt(f"{EXAMPLES}/binary_classification/binary.test")
+    return d[:, 1:], d[:, 0]
+
+
+def _train_auc_traj(pkg, X, y, params, nbr):
+    ev = {}
+    tr = pkg.Dataset(X, label=y)
+    bst = pkg.train(params, tr, num_boost_round=nbr,
+                    valid_sets=[pkg.Dataset(X, label=y, reference=tr)],
+                    evals_result=ev, verbose_eval=False)
+    return bst, ev["valid_0"]["auc"]
+
+
+BASE = {"objective": "binary", "metric": "auc", "verbosity": -1}
+
+
+def test_auc_trajectory_parity(reflgb, binary_train):
+    import lightgbm_tpu as lgb
+    X, y = binary_train
+    _, ours = _train_auc_traj(lgb, X, y, dict(BASE), 30)
+    _, ref = _train_auc_traj(reflgb, X, y, dict(BASE), 30)
+    # iteration 1 must agree to float precision: same binning, same root
+    # histogram, same first split set (reference value 0.768800830329785)
+    assert abs(ours[0] - ref[0]) < 1e-9
+    # accumulated tie-breaking/fp drift stays small across 30 rounds
+    diffs = np.abs(np.asarray(ours) - np.asarray(ref))
+    assert diffs.max() < 5e-3, f"trajectory diverged: max {diffs.max():.4g}"
+    assert abs(ours[-1] - ref[-1]) < 3e-3
+
+
+def test_model_cross_load_ours_to_ref(reflgb, binary_train, binary_test,
+                                      tmp_path):
+    """A model saved by this package parses in the reference C++ loader
+    (gbdt_model_text.cpp:405) with identical predictions."""
+    import lightgbm_tpu as lgb
+    X, y = binary_train
+    Xt, _ = binary_test
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 15},
+                    lgb.Dataset(X, label=y), num_boost_round=10,
+                    verbose_eval=False)
+    path = str(tmp_path / "ours.txt")
+    bst.save_model(path)
+    ref_pred = reflgb.Booster(model_file=path).predict(Xt)
+    np.testing.assert_allclose(bst.predict(Xt), ref_pred, atol=1e-12)
+
+
+def test_model_cross_load_ref_to_ours(reflgb, binary_train, binary_test,
+                                      tmp_path):
+    import lightgbm_tpu as lgb
+    X, y = binary_train
+    Xt, _ = binary_test
+    ref_bst = reflgb.train(
+        {"objective": "binary", "verbosity": -1, "num_leaves": 15},
+        reflgb.Dataset(X, label=y), num_boost_round=10)
+    path = str(tmp_path / "ref.txt")
+    ref_bst.save_model(path)
+    ours = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(ours.predict(Xt), ref_bst.predict(Xt),
+                               atol=1e-12)
+
+
+def test_multiclass_parity(reflgb):
+    import lightgbm_tpu as lgb
+    d = np.loadtxt(f"{EXAMPLES}/multiclass_classification/multiclass.train")
+    X, y = d[:, 1:], d[:, 0]
+    params = {"objective": "multiclass", "num_class": 5,
+              "metric": "multi_logloss", "verbosity": -1}
+
+    def run(pkg):
+        ev = {}
+        tr = pkg.Dataset(X, label=y)
+        pkg.train(params, tr, num_boost_round=20,
+                  valid_sets=[pkg.Dataset(X, label=y, reference=tr)],
+                  evals_result=ev, verbose_eval=False)
+        return ev["valid_0"]["multi_logloss"]
+
+    ours, ref = run(lgb), run(reflgb)
+    assert abs(ours[0] - ref[0]) < 1e-6
+    assert abs(ours[-1] - ref[-1]) < 2e-2
+
+
+def test_regression_parity(reflgb):
+    import lightgbm_tpu as lgb
+    d = np.loadtxt(f"{EXAMPLES}/regression/regression.train")
+    X, y = d[:, 1:], d[:, 0]
+    params = {"objective": "regression", "metric": "l2", "verbosity": -1}
+
+    def run(pkg):
+        ev = {}
+        tr = pkg.Dataset(X, label=y)
+        pkg.train(params, tr, num_boost_round=20,
+                  valid_sets=[pkg.Dataset(X, label=y, reference=tr)],
+                  evals_result=ev, verbose_eval=False)
+        return ev["valid_0"]["l2"]
+
+    ours, ref = run(lgb), run(reflgb)
+    assert abs(ours[0] - ref[0]) < 1e-7
+    assert abs(ours[-1] - ref[-1]) < 2e-3

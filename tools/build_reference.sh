@@ -1,0 +1,21 @@
+#!/bin/sh
+# Build the reference LightGBM (/root/reference) out-of-source and stage the
+# Python package with the fresh lib at /tmp/refpkg for tests/test_parity.py.
+#
+# The reference CMakeLists pins EXECUTABLE/LIBRARY_OUTPUT_PATH to its own
+# (read-only-by-policy) source dir (CMakeLists.txt:199-200), so the binaries
+# land there during `make` and are immediately moved out.
+set -e
+BUILD=${1:-/tmp/lgb_build}
+PKG=${2:-/tmp/refpkg}
+mkdir -p "$BUILD"
+cd "$BUILD"
+cmake /root/reference -DCMAKE_BUILD_TYPE=Release > cmake.log 2>&1
+make -j"$(nproc)" > make.log 2>&1
+for f in lightgbm lib_lightgbm.so; do
+    [ -f "/root/reference/$f" ] && mv "/root/reference/$f" "$BUILD/$f"
+done
+mkdir -p "$PKG"
+cp -r /root/reference/python-package/lightgbm "$PKG/"
+cp "$BUILD/lib_lightgbm.so" "$PKG/lightgbm/"
+echo "reference staged: $PKG/lightgbm (CLI: $BUILD/lightgbm)"
