@@ -115,7 +115,7 @@ def make_higgs_like(n, f, seed=0):
 
 def holdout_auc(booster, f, seed=1):
     Xh, yh = make_higgs_like(200_000, f, seed=seed)
-    pred = booster.predict(Xh)
+    pred = booster.predict(Xh, device=True)   # forest traversal on-device
     order = np.argsort(pred)
     ranks = np.empty_like(order, dtype=np.float64)
     ranks[order] = np.arange(1, len(pred) + 1)

@@ -191,15 +191,50 @@ class Booster:
         return self.config.num_class if self.boosting is not None \
             else self._loaded["num_class"]
 
+    def _forest(self, start_iter: int, stop_iter: int):
+        """StackedForest over models[start*K : stop*K], cached per range."""
+        from .predict import StackedForest
+        K = self.num_tree_per_iteration
+        # model object identities catch rollback/replacement, not just growth
+        key = (start_iter, stop_iter, tuple(id(m) for m in self.models))
+        cached = getattr(self, "_forest_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        forest = StackedForest(self.models[start_iter * K:stop_iter * K])
+        self._forest_cache = (key, forest)
+        return forest
+
+    def _device_forest(self, forest):
+        """DeviceForest for ``forest``, cached alongside the host cache."""
+        from .predict import DeviceForest
+        cached = getattr(self, "_device_forest_cache", None)
+        if cached is not None and cached[0] is forest:
+            return cached[1]
+        dev = DeviceForest(forest)
+        self._device_forest_cache = (forest, dev)
+        return dev
+
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, start_iteration: int = 0,
                 **kwargs) -> np.ndarray:
-        """reference: basic.py:2281 Booster.predict / _InnerPredictor."""
+        """reference: basic.py:2281 Booster.predict / _InnerPredictor.
+
+        Sparse (scipy) inputs are predicted in bounded row chunks without
+        materializing the full dense matrix.  ``pred_early_stop`` /
+        ``pred_early_stop_freq`` / ``pred_early_stop_margin`` kwargs mirror
+        the reference (src/boosting/prediction_early_stop.cpp).
+        """
         if hasattr(data, "values"):
             data = data.values
-        if hasattr(data, "toarray"):
-            data = data.toarray()
+        if hasattr(data, "tocsr"):  # scipy sparse: chunked densify
+            from .predict import predict_csr_chunked
+            return predict_csr_chunked(
+                lambda chunk: self.predict(
+                    chunk, num_iteration=num_iteration, raw_score=raw_score,
+                    pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                    start_iteration=start_iteration, **kwargs),
+                data)
         X = np.ascontiguousarray(np.asarray(data, np.float64))
         if X.ndim == 1:
             X = X[None, :]
@@ -212,25 +247,40 @@ class Booster:
                              if self.best_iteration > 0 else n_total_iter)
         stop_iter = min(start_iteration + num_iteration, n_total_iter)
 
+        use_device = bool(kwargs.get("device", False))
+
         if pred_leaf:
-            out = np.zeros((X.shape[0], (stop_iter - start_iteration) * K), np.int32)
-            for it in range(start_iteration, stop_iter):
-                for k in range(K):
-                    out[:, (it - start_iteration) * K + k] = \
-                        models[it * K + k].predict_leaf_np(X)
-            return out
+            forest = self._forest(start_iteration, stop_iter)
+            if use_device:
+                return self._device_forest(forest).predict_leaf(X)
+            return forest.predict_leaf(X)
         if pred_contrib:
+            from .utils.shap import tree_shap_batch
             F = self.num_features()
             out = np.zeros((X.shape[0], K, F + 1), np.float64)
             for it in range(start_iteration, stop_iter):
                 for k in range(K):
-                    out[:, k, :] += models[it * K + k].predict_contrib_np(X, F)
+                    tree_shap_batch(models[it * K + k], X, out[:, k, :])
             return out.reshape(X.shape[0], -1) if K > 1 else out[:, 0, :]
 
-        raw = np.zeros((K, X.shape[0]), np.float64)
-        for it in range(start_iteration, stop_iter):
-            for k in range(K):
-                raw[k] += models[it * K + k].predict_np(X)
+        early_stop = None
+        if kwargs.get("pred_early_stop") and not raw_score:
+            from .predict import make_early_stop
+            obj = (self.objective_name or "").split(" ")[0]
+            kind = ("binary" if obj == "binary"
+                    else "multiclass" if obj in ("multiclass", "softmax",
+                                                 "multiclassova", "ova")
+                    else "none")
+            early_stop = make_early_stop(
+                kind,
+                float(kwargs.get("pred_early_stop_margin", 10.0)),
+                int(kwargs.get("pred_early_stop_freq", 10)))
+
+        forest = self._forest(start_iteration, stop_iter)
+        if use_device and early_stop is None:
+            raw = self._device_forest(forest).predict_raw(X, num_class=K)
+        else:
+            raw = forest.predict_raw(X, num_class=K, early_stop=early_stop)
         if self.average_output and stop_iter > start_iteration:
             raw /= (stop_iter - start_iteration)
         if raw_score:

@@ -13,16 +13,18 @@ TrainOneIter (:58).  Behavioral contract reproduced:
   k/(lr+k)) of its old weight, i.e. train and valid scores both end up
   down-shifted by (1-w) of the dropped tree's old contribution.
 
-TPU form: the dropped trees' contributions are evaluated by host traversal
-over the binned matrix (tiny trees, vectorized numpy) and pushed to the
-device scores as deltas — the grow step itself is the shared jitted
-``one_iter``.
+TPU form: the dropped trees' contributions are re-evaluated ON DEVICE from
+the boosting object's stored TreeArrays history (GBDT.tree_history /
+_tree_pred_device) — no host pass over the binned matrix; at HIGGS scale a
+drop costs one jitted traversal instead of an 11M-row numpy walk.  The grow
+step itself is the shared jitted ``one_iter``.
 """
 
 from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,18 +39,16 @@ class DART(GBDT):
         self._drop_rng = np.random.RandomState(config.drop_seed)
         self.tree_weight: List[float] = []   # non-uniform drop weighting
         self.sum_weight = 0.0
+        self._history_mode = "all"   # any this-run tree can be dropped
 
     # -- helpers ----------------------------------------------------------
 
-    def _tree_pred_train(self, model_idx: int) -> np.ndarray:
-        ds = self.train_set
-        return self.models[model_idx].predict_binned_np(
-            ds.binned, ds.feat_group, ds.feat_start)
+    def _tree_pred_train(self, model_idx: int) -> jax.Array:
+        return self._tree_pred_device(model_idx, self.binned, self.train_set)
 
-    def _tree_pred_valid(self, model_idx: int, vi: int) -> np.ndarray:
-        ds = self.valid_sets[vi]
-        return self.models[model_idx].predict_binned_np(
-            ds.binned, ds.feat_group, ds.feat_start)
+    def _tree_pred_valid(self, model_idx: int, vi: int) -> jax.Array:
+        return self._tree_pred_device(model_idx, self.valid_binned[vi],
+                                      self.valid_sets[vi])
 
     def _dropping_trees(self) -> List[int]:
         """Pick THIS-RUN iteration indices to drop (0 = first iteration
@@ -107,17 +107,15 @@ class DART(GBDT):
         drop_preds = {}
         for i in drop:
             for kk in range(K):
-                p = self._pad_rows_np(self._tree_pred_train((off + i) * K + kk))
+                p = self._tree_pred_train((off + i) * K + kk)
                 drop_preds[(i, kk)] = p
-                self.train_score = self.train_score.at[kk].add(
-                    -jnp.asarray(p, jnp.float32))
+                self.train_score = self.train_score.at[kk].add(-p)
 
         stopped = super().train_one_iter(grad, hess)
         if stopped:
             # restore the removed contributions; nothing was trained
             for (i, kk), p in drop_preds.items():
-                self.train_score = self.train_score.at[kk].add(
-                    jnp.asarray(p, jnp.float32))
+                self.train_score = self.train_score.at[kk].add(p)
             return True
 
         # normalize dropped trees to weight w of their old contribution
@@ -126,13 +124,15 @@ class DART(GBDT):
             w = (k / (k + 1.0) if not c.xgboost_dart_mode
                  else k / (k + c.learning_rate))
             for (i, kk), p in drop_preds.items():
+                mi = (off + i) * K + kk
                 self.train_score = self.train_score.at[kk].add(
-                    jnp.asarray(w * p, jnp.float32))
+                    jnp.float32(w) * p)
                 for vi in range(len(self.valid_scores)):
-                    vp = self._tree_pred_valid((off + i) * K + kk, vi)
+                    vp = self._tree_pred_valid(mi, vi)
                     self.valid_scores[vi] = self.valid_scores[vi].at[kk].add(
-                        jnp.asarray(-(1.0 - w) * vp, jnp.float32))
-                self.models[(off + i) * K + kk].scale(w)
+                        jnp.float32(-(1.0 - w)) * vp)
+                self.models[mi].scale(w)
+                self.history_scale[mi] = self.history_scale.get(mi, 1.0) * w
             if not c.uniform_drop:
                 # reference Normalize: sum_weight -= tw/(k+1) (default) or
                 # tw/(k+lr) (xgboost mode), then tw *= w  (dart.hpp:176,195)

@@ -114,6 +114,15 @@ class GBDT:
 
         self._rng = np.random.RandomState(config.bagging_seed)
         self._goss_rng_key = jax.random.PRNGKey(config.bagging_seed)
+
+        # device-resident history of this run's stacked TreeArrays, so DART
+        # drops and rollback re-evaluate trees on device instead of host
+        # passes over the full binned matrix ("last": only the most recent
+        # iteration, enough for rollback; DART switches to "all")
+        self.tree_history: List = []
+        self.history_scale: Dict[int, float] = {}
+        self._history_mode = "last"
+
         self._build_jit_fns()
 
     # ------------------------------------------------------------------ setup
@@ -371,6 +380,8 @@ class GBDT:
             return vscore
 
         self._valid_update = jax.jit(valid_update, donate_argnums=(0,))
+        self._tree_pred_jit = jax.jit(
+            lambda tree, binned: predict_tree_binned(tree, binned, self.meta))
 
     # --------------------------------------------------------------- training
 
@@ -490,6 +501,20 @@ class GBDT:
             return True
         self.models.extend(new_models)
 
+        # keep the device trees for drop/rollback re-evaluation; fold the
+        # iter-0 init bias into the saved leaf values so a saved tree's
+        # device output equals its HostTree counterpart's (add_bias above)
+        st = stacked
+        if self.iter == 0 and any(abs(s) > K_EPSILON for s in self.init_scores):
+            bias = jnp.asarray(self.init_scores, jnp.float32)[:, None]
+            st = st._replace(leaf_value=st.leaf_value + bias)
+        if self._history_mode == "all":
+            self.tree_history.append(st)
+        else:
+            self.tree_history = [st]
+        for k in range(K):
+            self.history_scale[len(self.models) - K + k] = 1.0
+
         for i in range(len(self.valid_scores)):
             self.valid_scores[i] = self._valid_update(
                 self.valid_scores[i], stacked, self.valid_binned[i])
@@ -539,25 +564,48 @@ class GBDT:
                 self.train_score = self.train_score.at[k].add(
                     jnp.asarray(self._pad_rows_np(m.leaf_value[lp])))
 
+    def _tree_pred_device(self, model_idx: int, binned,
+                          dataset: Dataset) -> jax.Array:
+        """A stored tree's current score contribution over ``binned``
+        (device array), via the device history when available; host
+        traversal fallback for init-model trees that were never grown in
+        this run.  Output rows match ``binned``'s row count."""
+        K = self.num_tree_per_iteration
+        it, k = divmod(model_idx, K)
+        own_it = it - self.num_init_iteration
+        own_total = self.iter - self.num_init_iteration
+        hist_idx = (own_it if self._history_mode == "all"
+                    else own_it - (own_total - len(self.tree_history)))
+        if 0 <= hist_idx < len(self.tree_history):
+            tree_k = jax.tree_util.tree_map(
+                lambda x: x[k], self.tree_history[hist_idx])
+            out = self._tree_pred_jit(tree_k, binned)
+            scale = self.history_scale.get(model_idx, 1.0)
+            return out * jnp.float32(scale) if scale != 1.0 else out
+        p = self.models[model_idx].predict_binned_np(
+            dataset.binned, dataset.feat_group, dataset.feat_start)
+        if binned.shape[0] > len(p):
+            p = np.pad(p, (0, binned.shape[0] - len(p)))
+        return jnp.asarray(p, jnp.float32)
+
     def rollback_one_iter(self) -> None:
         """reference: GBDT::RollbackOneIter (gbdt.cpp:422)."""
         if self.iter <= 0:
             return
         K = self.num_tree_per_iteration
-        dropped = self.models[-K:]
-        del self.models[-K:]
-        # subtract the dropped trees' contributions
-        for k, ht in enumerate(dropped):
+        first = len(self.models) - K
+        for k in range(K):
             self.train_score = self.train_score.at[k].add(
-                -jnp.asarray(self._pad_rows_np(ht.predict_binned_np(
-                    self.train_set.binned, self.train_set.feat_group,
-                    self.train_set.feat_start))))
-        for i, vs in enumerate(self.valid_scores):
-            for k, ht in enumerate(dropped):
+                -self._tree_pred_device(first + k, self.binned,
+                                        self.train_set))
+            for i in range(len(self.valid_scores)):
                 self.valid_scores[i] = self.valid_scores[i].at[k].add(
-                    -jnp.asarray(ht.predict_binned_np(
-                        self.valid_sets[i].binned, self.valid_sets[i].feat_group,
-                        self.valid_sets[i].feat_start)))
+                    -self._tree_pred_device(first + k, self.valid_binned[i],
+                                            self.valid_sets[i]))
+            self.history_scale.pop(first + k, None)
+        del self.models[-K:]
+        if self.tree_history:
+            self.tree_history.pop()
         self.iter -= 1
 
     # ------------------------------------------------------------------- eval

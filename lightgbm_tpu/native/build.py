@@ -1,0 +1,64 @@
+"""Build/load the native predictor shared library.
+
+The C++ sources next to this file are compiled once per interpreter
+environment with ``g++ -O3 -fopenmp -shared -fPIC`` into
+``<this dir>/_liblgbt.so`` (rebuilt when any source is newer).  Loading is
+ctypes — no pybind11 in this image (see repo environment notes); the ABI is
+plain C (extern "C" + raw pointers), mirroring how the reference exposes
+lib_lightgbm.so to its Python package.
+
+Everything degrades gracefully: if g++ or OpenMP is unavailable the callers
+fall back to the pure-NumPy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "_liblgbt.so")
+_SOURCES = ["predictor.cpp"]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime for s in _SOURCES)
+
+
+def _build() -> None:
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    tmp = _LIB_PATH + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+           "-std=c++17", "-o", tmp] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_native_lib():
+    """The loaded CDLL, or None if the toolchain is unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if _needs_build():
+                _build()
+            _lib = ctypes.CDLL(_LIB_PATH)
+        except Exception:
+            _lib = None
+        return _lib

@@ -1,0 +1,426 @@
+"""Fast batch prediction over a stacked forest.
+
+reference: src/application/predictor.hpp:29 (OpenMP row-parallel Predictor),
+include/LightGBM/tree.h:190 (inline Tree::Predict traversal), and
+src/boosting/prediction_early_stop.cpp:13-90 (margin-based early stop).
+
+The reference parallelizes rows across threads, each doing a scalar
+root-to-leaf walk per tree.  The vectorized inversion here packs all trees
+into padded [T, nodes] arrays and advances EVERY row one level per step
+("depth stepping"): a gather of per-row node attributes, one vectorized
+decision, one child gather.  Rows that reach a leaf freeze (child pointers
+of leaves are < 0).  Work is O(rows * avg_depth) fused vector ops per tree
+instead of a Python loop per (tree, node) — the round-2 implementation's
+per-node ``np.unique`` passes made 500-tree x 1M-row prediction minutes;
+this is seconds.
+
+Prediction early stop (binary/multiclass margins) follows the reference
+semantics: every ``freq`` trees, rows whose margin exceeds the threshold are
+compacted out of the working set.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+K_ZERO_THRESHOLD = 1e-35
+
+_CHUNK_ROWS = 1 << 16
+
+
+class StackedForest:
+    """Padded [T, nodes] arrays for a list of HostTrees (raw-feature space)."""
+
+    def __init__(self, trees: List):
+        T = len(trees)
+        self.num_trees = T
+        I = max([max(t.num_leaves - 1, 1) for t in trees], default=1)
+        L = max([max(t.num_leaves, 1) for t in trees], default=1)
+        self.split_feature = np.zeros((T, I), np.int32)
+        self.threshold = np.full((T, I), np.inf, np.float64)
+        self.left = np.full((T, I), -1, np.int32)     # ~0 = leaf 0
+        self.right = np.full((T, I), -1, np.int32)
+        self.is_cat = np.zeros((T, I), bool)
+        self.default_left = np.zeros((T, I), bool)
+        self.missing_type = np.zeros((T, I), np.int8)
+        self.leaf_value = np.zeros((T, L), np.float64)
+        self.depth = np.ones(T, np.int32)
+        # categorical bitsets: flat word array + per-node offset/word-count
+        self.cat_offset = np.zeros((T, I), np.int64)
+        self.cat_nwords = np.zeros((T, I), np.int32)
+        words: List[np.ndarray] = []
+        wpos = 0
+        self.has_cat = False
+        for t, tr in enumerate(trees):
+            ns = tr.num_leaves - 1
+            self.leaf_value[t, :tr.num_leaves] = tr.leaf_value[:tr.num_leaves]
+            if ns <= 0:
+                continue  # single-leaf tree: sentinel node routes to leaf 0
+            self.split_feature[t, :ns] = tr.split_feature[:ns]
+            self.threshold[t, :ns] = tr.threshold[:ns]
+            self.left[t, :ns] = tr.left_child[:ns]
+            self.right[t, :ns] = tr.right_child[:ns]
+            dt = tr.decision_type[:ns].astype(np.int32)
+            self.is_cat[t, :ns] = (dt & K_CATEGORICAL_MASK) != 0
+            self.default_left[t, :ns] = (dt & K_DEFAULT_LEFT_MASK) != 0
+            self.missing_type[t, :ns] = (dt >> 2) & 3
+            self.depth[t] = tr.max_depth()
+            for s in np.flatnonzero(self.is_cat[t, :ns]):
+                self.has_cat = True
+                ci = int(tr.threshold[s])
+                lo = int(tr.cat_boundaries[ci])
+                hi = int(tr.cat_boundaries[ci + 1])
+                w = np.asarray(tr.cat_threshold[lo:hi], np.uint32)
+                self.cat_offset[t, s] = wpos
+                self.cat_nwords[t, s] = len(w)
+                words.append(w)
+                wpos += len(w)
+        self.cat_words = (np.concatenate(words) if words
+                          else np.zeros(1, np.uint32))
+        self.max_depth = int(self.depth.max(initial=1))
+
+    # ------------------------------------------------------------- traversal
+    #
+    # All trees of a block advance one level per step with [T', nc] state
+    # arrays — one fused numpy op serves every (tree, row) pair, amortizing
+    # interpreter overhead across the block (the reference amortizes its
+    # scalar walks across OpenMP threads instead, predictor.hpp:152).
+
+    def _decide_block(self, tid2, nd, fval):
+        """Vectorized go-left for a [T', nc] block of (tree, node) states."""
+        thr = self.threshold[tid2, nd]
+        mt = self.missing_type[tid2, nd]
+        nan = np.isnan(fval)
+        fz = np.where(nan & (mt != 2), 0.0, fval)
+        is_missing = ((mt == 1) & (np.abs(fz) <= K_ZERO_THRESHOLD)) | \
+                     ((mt == 2) & nan)
+        with np.errstate(invalid="ignore"):
+            gl = np.where(is_missing, self.default_left[tid2, nd], fz <= thr)
+        if self.has_cat:
+            cat = self.is_cat[tid2, nd]
+            if cat.any():
+                # truncation toward zero matches the reference's
+                # static_cast<int> (so -0.5 -> category 0, not "invalid")
+                iv = np.where(nan, -1.0, fval).astype(np.int64)
+                nw = self.cat_nwords[tid2, nd]
+                valid = (iv >= 0) & (iv < nw.astype(np.int64) * 32)
+                ivc = np.clip(iv, 0, None)
+                widx = self.cat_offset[tid2, nd] + np.minimum(
+                    ivc // 32, np.maximum(nw - 1, 0))
+                inset = (self.cat_words[widx]
+                         >> (ivc % 32).astype(np.uint32)) & 1
+                gl = np.where(cat, valid & (inset == 1), gl)
+        return gl
+
+    def _leaves_chunk(self, Xc: np.ndarray, tree_ids,
+                      block_elems: int = 1 << 23) -> np.ndarray:
+        """Leaf index per (tree, row) for one row chunk. Returns [T', nc].
+
+        Trees are processed depth-sorted in blocks so a block's step count
+        is its own max depth, not the forest's.
+        """
+        nc = Xc.shape[0]
+        tid = np.asarray(list(tree_ids), np.int32)
+        out = np.zeros((len(tid), nc), np.int32)
+        rows = np.arange(nc)[None, :]
+        order = np.argsort(self.depth[tid], kind="stable")
+        t_blk = max(1, block_elems // max(nc, 1))
+        for bs in range(0, len(tid), t_blk):
+            sel = order[bs:bs + t_blk]
+            tb = tid[sel]
+            tid2 = tb[:, None]
+            node = np.zeros((len(tb), nc), np.int32)
+            while True:
+                nd = np.maximum(node, 0)
+                fval = Xc[rows, self.split_feature[tid2, nd]]
+                gl = self._decide_block(tid2, nd, fval)
+                nxt = np.where(gl, self.left[tid2, nd], self.right[tid2, nd])
+                node = np.where(node < 0, node, nxt)
+                if (node < 0).all():
+                    break
+            out[sel] = ~node
+        return out
+
+    # ---------------------------------------------------------- native path
+
+    def _native(self):
+        """ctypes handle to the C++ OpenMP predictor, or None."""
+        if not hasattr(self, "_native_lib"):
+            from .native.build import load_native_lib
+            self._native_lib = load_native_lib()
+        return self._native_lib
+
+    def _native_predict(self, X: np.ndarray, num_class: int,
+                        early_stop=None, want_leaf: bool = False):
+        """Run lgbt_predict; returns (raw [K, n] or None, leaf [n, T] or
+        None), or None if the native lib is unavailable."""
+        lib = self._native()
+        if lib is None:
+            return None
+        import ctypes as ct
+        n, _ = X.shape
+        K = max(num_class, 1)
+        X = np.ascontiguousarray(X, np.float64)
+        out = None if want_leaf else np.zeros((K, n), np.float64)
+        leaf = np.zeros((n, self.num_trees), np.int32) if want_leaf else None
+        kind, freq, margin = 0, 0, 0.0
+        if early_stop is not None:
+            kind, freq, margin = early_stop
+        p = lambda a, t: a.ctypes.data_as(ct.POINTER(t)) if a is not None \
+            else None
+        lib.lgbt_predict(
+            p(X, ct.c_double), ct.c_int64(n), ct.c_int64(X.shape[1]),
+            ct.c_int64(self.num_trees), ct.c_int64(self.split_feature.shape[1]),
+            ct.c_int64(self.leaf_value.shape[1]),
+            p(self.split_feature, ct.c_int32), p(self.threshold, ct.c_double),
+            p(self.left, ct.c_int32), p(self.right, ct.c_int32),
+            p(self._cat_u8, ct.c_uint8), p(self._dl_u8, ct.c_uint8),
+            p(self.missing_type, ct.c_int8), p(self.leaf_value, ct.c_double),
+            p(self.cat_offset, ct.c_int64), p(self.cat_nwords, ct.c_int32),
+            p(self.cat_words, ct.c_uint32),
+            ct.c_int64(K), ct.c_int(kind), ct.c_int(freq), ct.c_double(margin),
+            p(out, ct.c_double), p(leaf, ct.c_int32))
+        return out, leaf
+
+    @property
+    def _cat_u8(self):
+        if not hasattr(self, "_cat_u8_arr"):
+            self._cat_u8_arr = np.ascontiguousarray(self.is_cat, np.uint8)
+        return self._cat_u8_arr
+
+    @property
+    def _dl_u8(self):
+        if not hasattr(self, "_dl_u8_arr"):
+            self._dl_u8_arr = np.ascontiguousarray(self.default_left, np.uint8)
+        return self._dl_u8_arr
+
+    def predict_leaf(self, X: np.ndarray,
+                     chunk_rows: int = _CHUNK_ROWS) -> np.ndarray:
+        """Leaf indices [n, T] (reference pred_leaf output layout)."""
+        native = self._native_predict(
+            np.asarray(X, np.float64), 1, want_leaf=True)
+        if native is not None:
+            return native[1]
+        n = X.shape[0]
+        out = np.zeros((n, self.num_trees), np.int32)
+        for s in range(0, n, chunk_rows):
+            e = min(s + chunk_rows, n)
+            out[s:e] = self._leaves_chunk(X[s:e], range(self.num_trees)).T
+        return out
+
+    def predict_raw(
+        self,
+        X: np.ndarray,
+        num_class: int = 1,
+        early_stop=None,
+        chunk_rows: int = _CHUNK_ROWS,
+    ) -> np.ndarray:
+        """Summed raw scores [K, n].  Trees are laid out iteration-major
+        (iteration i, class k -> tree i*K + k) as in the reference.
+
+        ``early_stop``: optional (freq, margin_fn) pair; every ``freq``
+        iterations rows with margin_fn(raw_scores) True are frozen and
+        compacted out (reference: prediction_early_stop.cpp:13-60).
+        """
+        n = X.shape[0]
+        K = max(num_class, 1)
+        iters = self.num_trees // K
+        X = np.ascontiguousarray(X, np.float64)
+        es_tuple = (early_stop.kind_code, early_stop.freq,
+                    early_stop.margin) if early_stop is not None else None
+        native = self._native_predict(X, K, early_stop=es_tuple)
+        if native is not None:
+            return native[0]
+        out = np.zeros((K, n), np.float64)
+        for s in range(0, n, chunk_rows):
+            e = min(s + chunk_rows, n)
+            Xc = X[s:e]
+            if early_stop is None:
+                leaves = self._leaves_chunk(Xc, range(self.num_trees))
+                tid = np.arange(self.num_trees)
+                lv = self.leaf_value[tid[:, None], leaves]      # [T, nc]
+                out[:, s:e] += lv.reshape(iters, K, e - s).sum(axis=0)
+            else:
+                freq, margin_fn = early_stop.freq, early_stop.margin_fn
+                live = np.arange(e - s)
+                acc = np.zeros((K, e - s), np.float64)
+                Xl = Xc
+                for it in range(iters):
+                    ids = range(it * K, (it + 1) * K)
+                    leaves = self._leaves_chunk(Xl, ids)
+                    for j, t in enumerate(ids):
+                        acc[t % K, live] += self.leaf_value[t, leaves[j]]
+                    if freq > 0 and (it + 1) % freq == 0 and it + 1 < iters:
+                        stop = margin_fn(acc[:, live])
+                        if stop.any():
+                            live = live[~stop]
+                            if live.size == 0:
+                                break
+                            Xl = Xc[live]
+                out[:, s:e] = acc
+        return out
+
+
+class DeviceForest:
+    """Jitted stacked-forest traversal (XLA: multithreaded on CPU, fast on
+    TPU).  Same depth-stepping algorithm as StackedForest but with [T, nc]
+    device state advanced under ``lax.while_loop``.
+
+    Exactness: inputs are compared in float32, with each node threshold
+    rounded DOWN to the nearest float32.  For float32 feature values x,
+    ``x <= t64``  ⟺  ``x <= round_down_f32(t64)``, so routing matches the
+    float64 host path exactly for f32-precision data (float64 inputs with
+    sub-f32 precision may route differently at bin boundaries — use the
+    host path when that matters).
+    """
+
+    def __init__(self, forest: StackedForest, chunk_rows: int = 1 << 16):
+        import jax
+        import jax.numpy as jnp
+        self.forest = forest
+        self.chunk_rows = chunk_rows
+        f = forest
+        # round thresholds toward -inf in f32
+        thr32 = f.threshold.astype(np.float32)
+        over = thr32.astype(np.float64) > f.threshold
+        thr32[over] = np.nextafter(thr32[over], -np.inf, dtype=np.float32)
+        self.threshold = jnp.asarray(thr32)
+        self.split_feature = jnp.asarray(f.split_feature)
+        self.left = jnp.asarray(f.left)
+        self.right = jnp.asarray(f.right)
+        self.is_cat = jnp.asarray(f.is_cat)
+        self.default_left = jnp.asarray(f.default_left)
+        self.missing_type = jnp.asarray(f.missing_type.astype(np.int32))
+        self.leaf_value = jnp.asarray(f.leaf_value.astype(np.float32))
+        self.cat_offset = jnp.asarray(f.cat_offset)
+        self.cat_nwords = jnp.asarray(f.cat_nwords)
+        self.cat_words = jnp.asarray(f.cat_words)
+        self._leaves_jit = jax.jit(self._leaves)
+
+    def _leaves(self, Xc):
+        """[nc, F] f32 -> leaf index [T, nc]."""
+        import jax.numpy as jnp
+        from jax import lax
+        T = self.forest.num_trees
+        nc = Xc.shape[0]
+        rows = jnp.arange(nc)[None, :]
+        tid2 = jnp.arange(T)[:, None]
+
+        def cond(node):
+            return jnp.any(node >= 0)
+
+        def body(node):
+            nd = jnp.maximum(node, 0)
+            fval = Xc[rows, self.split_feature[tid2, nd]]
+            thr = self.threshold[tid2, nd]
+            mt = self.missing_type[tid2, nd]
+            nan = jnp.isnan(fval)
+            fz = jnp.where(nan & (mt != 2), 0.0, fval)
+            is_missing = ((mt == 1) & (jnp.abs(fz) <= K_ZERO_THRESHOLD)) | \
+                         ((mt == 2) & nan)
+            gl = jnp.where(is_missing, self.default_left[tid2, nd], fz <= thr)
+            if self.forest.has_cat:
+                cat = self.is_cat[tid2, nd]
+                # truncate toward zero (reference static_cast<int> semantics)
+                iv = jnp.fix(jnp.where(nan, -1.0, fval)).astype(jnp.int64)
+                nw = self.cat_nwords[tid2, nd]
+                valid = (iv >= 0) & (iv < nw.astype(jnp.int64) * 32)
+                ivc = jnp.clip(iv, 0, None)
+                widx = self.cat_offset[tid2, nd] + jnp.minimum(
+                    ivc // 32, jnp.maximum(nw - 1, 0))
+                inset = (self.cat_words[widx]
+                         >> (ivc % 32).astype(jnp.uint32)) & 1
+                gl = jnp.where(cat, valid & (inset == 1), gl)
+            nxt = jnp.where(gl, self.left[tid2, nd], self.right[tid2, nd])
+            return jnp.where(node < 0, node, nxt)
+
+        node = lax.while_loop(cond, body, jnp.zeros((T, nc), jnp.int32))
+        return ~node
+
+    def predict_raw(self, X: np.ndarray, num_class: int = 1) -> np.ndarray:
+        """Summed raw scores [K, n] (float32 accumulation on device)."""
+        import jax.numpy as jnp
+        n = X.shape[0]
+        K = max(num_class, 1)
+        T = self.forest.num_trees
+        iters = T // K
+        tid2 = jnp.arange(T)[:, None]
+        out = np.zeros((K, n), np.float64)
+        cr = self.chunk_rows
+        for s in range(0, n, cr):
+            e = min(s + cr, n)
+            Xc = np.asarray(X[s:e], np.float32)
+            if e - s < cr:   # pad to the compiled chunk shape
+                Xc = np.pad(Xc, ((0, cr - (e - s)), (0, 0)))
+            leaves = self._leaves_jit(jnp.asarray(Xc))
+            lv = self.leaf_value[tid2, leaves].reshape(iters, K, cr)
+            out[:, s:e] = np.asarray(jnp.sum(lv, axis=0),
+                                     np.float64)[:, :e - s]
+        return out
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+        n = X.shape[0]
+        out = np.zeros((n, self.forest.num_trees), np.int32)
+        cr = self.chunk_rows
+        for s in range(0, n, cr):
+            e = min(s + cr, n)
+            Xc = np.asarray(X[s:e], np.float32)
+            if e - s < cr:
+                Xc = np.pad(Xc, ((0, cr - (e - s)), (0, 0)))
+            out[s:e] = np.asarray(self._leaves_jit(jnp.asarray(Xc))).T[:e - s]
+        return out
+
+
+class EarlyStop:
+    """Prediction early-stop spec (reference:
+    CreatePredictionEarlyStopInstance, prediction_early_stop.cpp:62-90):
+    'binary' stops when |2*score| > margin, 'multiclass' when the top-2
+    score gap > margin, checked every ``freq`` iterations."""
+
+    def __init__(self, kind_code: int, freq: int, margin: float, margin_fn):
+        self.kind_code = kind_code
+        self.freq = freq
+        self.margin = margin
+        self.margin_fn = margin_fn
+
+
+def make_early_stop(kind: str, margin: float, freq: int):
+    if freq <= 0 or kind == "none":
+        return None
+    if kind == "binary":
+        def margin_fn(raw):  # [1, rows]
+            return np.abs(2.0 * raw[0]) > margin
+        return EarlyStop(1, freq, margin, margin_fn)
+    if kind == "multiclass":
+        def margin_fn(raw):  # [K, rows]
+            if raw.shape[0] < 2:
+                return np.zeros(raw.shape[1], bool)
+            part = np.partition(raw, raw.shape[0] - 2, axis=0)
+            return (part[-1] - part[-2]) > margin
+        return EarlyStop(2, freq, margin, margin_fn)
+    raise ValueError(f"unknown early-stop type {kind!r}")
+
+
+def predict_csr_chunked(forest_predict, data, chunk_rows: int = _CHUNK_ROWS):
+    """Predict a scipy CSR/CSC matrix without materializing it densely:
+    each row chunk is densified on its own (bounded memory), predicted, and
+    discarded.  reference predicts CSR natively row-by-row (c_api.h:698);
+    bounded chunk densification is the vectorized equivalent.
+
+    ``forest_predict`` maps a dense [nc, F] float64 chunk to its result
+    (row-major leading axis); results are concatenated on axis 0.
+    """
+    if hasattr(data, "tocsr"):
+        data = data.tocsr()
+    n = data.shape[0]
+    outs = []
+    for s in range(0, n, chunk_rows):
+        e = min(s + chunk_rows, n)
+        chunk = np.asarray(data[s:e].todense(), np.float64)
+        outs.append(forest_predict(chunk))
+    return np.concatenate(outs, axis=0) if outs else np.zeros((0,))

@@ -110,3 +110,128 @@ def tree_shap(tree, x: np.ndarray, phi: np.ndarray) -> None:
 def _goes_left(tree, x, node):
     fval = x[tree.split_feature[node]]
     return bool(np.asarray(tree._decide(np.array([fval]), node))[0])
+
+
+# ---------------------------------------------------------------------------
+# Batched TreeSHAP: one DFS over the tree serves every row at once.
+#
+# Key observation making this possible: the recursion ORDER and the path's
+# (feature, zero_fraction) entries are row-independent — only one_fraction
+# and pweight depend on the row (through the go-left decision at each
+# node).  So the path state becomes (scalar feature, scalar zero_fraction,
+# [n] one_fraction, [n] pweight) and EXTEND/UNWIND become vector ops.  The
+# hot/cold asymmetry of the scalar algorithm (hot child inherits
+# incoming_one, cold gets 0) is expressed as one_fraction * goes_to_child.
+# ---------------------------------------------------------------------------
+
+
+def tree_shap_batch(tree, X: np.ndarray, phi: np.ndarray) -> None:
+    """Accumulate SHAP values of a batch into phi [n, num_features+1]."""
+    n = X.shape[0]
+    if tree.num_leaves <= 1:
+        phi[:, -1] += tree.expected_value()
+        return
+
+    # precompute per-node go-left decision vectors [n]
+    ns = tree.num_leaves - 1
+    goes_left = np.zeros((ns, n), bool)
+    for nd in range(ns):
+        goes_left[nd] = tree._decide(X[:, tree.split_feature[nd]], nd)
+
+    def node_count(node):
+        return float(tree.internal_count[node] if node >= 0
+                     else tree.leaf_count[~node])
+
+    ones = np.ones(n)
+
+    # path element arrays, parallel lists indexed by path position
+    def recurse(node, feats, zeros, one_list, pw_list,
+                parent_zero, parent_one, parent_feature):
+        ud = len(feats)  # unique_depth
+        feats = feats + [parent_feature]
+        zeros = zeros + [parent_zero]
+        one_list = [o for o in one_list] + [parent_one]
+        pw_list = [p.copy() for p in pw_list] + \
+            [ones.copy() if ud == 0 else np.zeros(n)]
+        for i in range(ud - 1, -1, -1):
+            pw_list[i + 1] += parent_one * pw_list[i] * ((i + 1) / (ud + 1))
+            pw_list[i] = parent_zero * pw_list[i] * ((ud - i) / (ud + 1))
+
+        if node < 0:  # leaf: attribute along the unique path
+            val = float(tree.leaf_value[~node])
+            for pi in range(1, ud + 1):
+                w = _unwound_sum_batch(zeros, one_list, pw_list, ud, pi)
+                phi[:, feats[pi]] += w * (one_list[pi] - zeros[pi]) * val
+            return
+
+        feat = int(tree.split_feature[node])
+        gl = goes_left[node]
+        cnt = max(node_count(node), 1e-30)
+        incoming_zero, incoming_one = 1.0, ones
+        pi = 0
+        while pi <= ud:
+            if feats[pi] == feat:
+                break
+            pi += 1
+        if pi != ud + 1:
+            incoming_zero = zeros[pi]
+            incoming_one = one_list[pi]
+            feats, zeros, one_list, pw_list = _unwind_batch(
+                feats, zeros, one_list, pw_list, ud, pi)
+            ud -= 1
+        for child, to_child in ((int(tree.left_child[node]), gl),
+                                (int(tree.right_child[node]), ~gl)):
+            frac = node_count(child) / cnt
+            recurse(child, feats, zeros, one_list, pw_list,
+                    frac * incoming_zero, incoming_one * to_child, feat)
+
+    import sys
+    limit = sys.getrecursionlimit()
+    if limit < 4 * tree.num_leaves + 100:
+        sys.setrecursionlimit(4 * tree.num_leaves + 100)
+    recurse(0, [], [], [], [], 1.0, ones, -1)
+    phi[:, -1] += tree.expected_value()
+
+
+def _unwind_batch(feats, zeros, one_list, pw_list, ud, pi):
+    of = one_list[pi]            # [n]
+    zf = zeros[pi]               # scalar
+    of_nz = of != 0
+    of_safe = np.where(of_nz, of, 1.0)
+    pw_list = [p.copy() for p in pw_list]
+    next_one = pw_list[ud].copy()
+    for i in range(ud - 1, -1, -1):
+        tmp = pw_list[i]
+        a = next_one * ((ud + 1) / (i + 1)) / of_safe
+        b = tmp * (ud + 1) / (zf * (ud - i)) if zf != 0 else tmp * 0.0
+        new_pw = np.where(of_nz, a, b)
+        next_one = np.where(of_nz,
+                            tmp - new_pw * zf * ((ud - i) / (ud + 1)),
+                            next_one)
+        pw_list[i] = new_pw
+    # features/fractions shift left over the removed slot; pweights do NOT
+    # shift — the loop above recomputed pw[0..ud-1] and the last is dropped
+    # (mirrors scalar _unwind: in-place overwrite + path.pop())
+    feats = feats[:pi] + feats[pi + 1:]
+    zeros = zeros[:pi] + zeros[pi + 1:]
+    one_list = one_list[:pi] + one_list[pi + 1:]
+    pw_list = pw_list[:ud]
+    return feats, zeros, one_list, pw_list
+
+
+def _unwound_sum_batch(zeros, one_list, pw_list, ud, pi):
+    of = one_list[pi]
+    zf = zeros[pi]
+    of_nz = of != 0
+    of_safe = np.where(of_nz, of, 1.0)
+    next_one = pw_list[ud]
+    total = np.zeros_like(next_one)
+    for i in range(ud - 1, -1, -1):
+        a = next_one * ((ud + 1) / (i + 1)) / of_safe
+        b = (pw_list[i] / (zf * ((ud - i) / (ud + 1)))
+             if zf != 0 else pw_list[i] * 0.0)
+        total += np.where(of_nz, a, b)
+        next_one = np.where(of_nz,
+                            pw_list[i] - a * zf * ((ud - i) / (ud + 1)),
+                            next_one)
+    return total
