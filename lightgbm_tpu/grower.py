@@ -146,6 +146,11 @@ class GrowerConfig(NamedTuple):
     bynode_feature_cnt: int = 0    # >0: feature_fraction_bynode — sample
                                    # this many features per NODE (reference
                                    # ColSampler::GetByNode, col_sampler.hpp:87)
+    cegb_tradeoff: float = 1.0     # CEGB (reference cost_effective_
+    cegb_penalty_split: float = 0.0  # gradient_boosting.hpp:50 DetlaGain)
+    cegb_coupled: bool = False     # static: coupled-penalty array passed
+    n_forced: int = 0              # static count of forced splits (reference
+                                   # ForceSplits, serial_tree_learner.cpp:411)
 
 
 def _psum(x, axis_name):
@@ -191,6 +196,13 @@ def grow_tree(
     rng_key: Optional[jax.Array] = None,        # PRNG for extra_trees /
                                                 # by-node column sampling
                                                 # (replicated across shards)
+    cegb_coupled_penalty: Optional[jax.Array] = None,  # [F] f32 (real-feature
+                                                # coupled penalties, inner idx)
+    cegb_feat_used: Optional[jax.Array] = None,  # [F] bool: feature already
+                                                # used in any split so far
+    forced_plan: Optional[tuple] = None,        # (leaf, feat, thr, dl) arrays
+                                                # [n_forced] from
+                                                # build_forced_plan()
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32).
 
@@ -285,6 +297,27 @@ def grow_tree(
                                   "combine with feature sharding is not "
                                   "supported")
 
+    # CEGB (reference: cost_effective_gradient_boosting.hpp) — penalties are
+    # subtracted from candidate gains inside the split search; the
+    # used-feature mask is loop state so the coupled penalty disappears the
+    # moment a feature is first paid for (UpdateLeafBestSplits semantics)
+    cegb_enabled = cfg.cegb_penalty_split > 0.0 or cfg.cegb_coupled
+    if cegb_enabled and (voting or feature_axis_name is not None):
+        raise NotImplementedError(
+            "CEGB is implemented for the serial and data-parallel learners")
+    if cegb_feat_used is None:
+        cegb_feat_used = jnp.zeros(F, bool)
+
+    def cegb_penalty(cnt, used):
+        if not cegb_enabled:
+            return None
+        pen = jnp.full((F,), cfg.cegb_tradeoff * cfg.cegb_penalty_split,
+                       jnp.float32) * cnt
+        if cfg.cegb_coupled:
+            pen = pen + jnp.where(used, 0.0,
+                                  cfg.cegb_tradeoff * cegb_coupled_penalty)
+        return pen
+
     # per-node randomness: extra_trees thresholds + by-node column sampling.
     # The key is REPLICATED across shards (reference syncs random seeds
     # across machines, application.cpp:169-174); by-node masks are sampled
@@ -360,7 +393,8 @@ def grow_tree(
             extra_rand_u=(eru[elected] if eru is not None else None))
         return r._replace(feature=elected[r.feature])
 
-    def leaf_best(ghist, sg, sh, cnt, depth, bounds=None, key=None):
+    def leaf_best(ghist, sg, sh, cnt, depth, bounds=None, key=None,
+                  used=None):
         fm_bn, eru = node_rand(key) if (use_rng and key is not None) \
             else (None, None)
         fm = feature_mask
@@ -379,7 +413,8 @@ def grow_tree(
             monotone_constraints=monotone_constraints,
             leaf_output_bounds=bounds,
             has_categorical=has_cat,
-            extra_rand_u=eru)
+            extra_rand_u=eru,
+            gain_penalty=cegb_penalty(cnt, used))
         # depth limit (reference: serial_tree_learner.cpp:261-301 pruning)
         if cfg.max_depth > 0:
             r = r._replace(gain=jnp.where(depth >= cfg.max_depth, -jnp.inf, r.gain))
