@@ -195,8 +195,11 @@ class Booster:
         """StackedForest over models[start*K : stop*K], cached per range."""
         from .predict import StackedForest
         K = self.num_tree_per_iteration
-        # model object identities catch rollback/replacement, not just growth
-        key = (start_iter, stop_iter, tuple(id(m) for m in self.models))
+        # keyed on the boosting's monotonic models_version (bumped on every
+        # extend/rollback/refit/DART-scale), not object ids — CPython id
+        # reuse after rollback+retrain could alias a stale forest
+        version = getattr(self.boosting, "models_version", 0)
+        key = (start_iter, stop_iter, len(self.models), version)
         cached = getattr(self, "_forest_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -264,7 +267,10 @@ class Booster:
             return out.reshape(X.shape[0], -1) if K > 1 else out[:, 0, :]
 
         early_stop = None
-        if kwargs.get("pred_early_stop") and not raw_score:
+        # reference: the Predictor applies margin-based early stopping to
+        # raw-score prediction too (predictor.hpp constructs the early-stop
+        # instance independently of is_raw_score)
+        if kwargs.get("pred_early_stop"):
             from .predict import make_early_stop
             obj = (self.objective_name or "").split(" ")[0]
             kind = ("binary" if obj == "binary"
@@ -543,4 +549,11 @@ class Booster:
         return self
 
     def set_network(self, *args, **kwargs) -> "Booster":
+        from .utils.log import log_warning
+        log_warning(
+            "set_network is a no-op in lightgbm_tpu: socket/MPI machine "
+            "lists are replaced by the JAX device mesh — configure "
+            "tree_learner=data/feature/voting and run under a multi-device "
+            "JAX runtime instead (reference: Booster.set_network, "
+            "basic.py:1867 -> LGBM_NetworkInit)")
         return self

@@ -132,6 +132,7 @@ class DART(GBDT):
                     self.valid_scores[vi] = self.valid_scores[vi].at[kk].add(
                         jnp.float32(-(1.0 - w)) * vp)
                 self.models[mi].scale(w)
+                self.models_version += 1
                 self.history_scale[mi] = self.history_scale.get(mi, 1.0) * w
             if not c.uniform_drop:
                 # reference Normalize: sum_weight -= tw/(k+1) (default) or

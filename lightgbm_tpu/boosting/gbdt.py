@@ -53,6 +53,9 @@ class GBDT:
         self.iter = 0
         self.num_init_iteration = 0        # iterations loaded via init_model
         self.models: List[HostTree] = []   # length = iter * K
+        self.models_version = 0            # bumped on EVERY models mutation
+        # (extend/rollback/refit/DART scale) — cache-invalidation token for
+        # prediction caches keyed on the model list
         self.shrinkage_rate = config.learning_rate
 
         self.meta = self.train_set.feature_meta()
@@ -70,19 +73,30 @@ class GBDT:
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             if self._data_axis is not None:
-                b = np.pad(self.train_set.binned, ((0, n_pad - n), (0, 0)))
+                src = self.train_set.binned
+                if self._row_perm is not None:
+                    # query-aligned layout: gather rows (pads -> bin 0)
+                    b = np.concatenate(
+                        [src, np.zeros((1, src.shape[1]), src.dtype)]
+                    )[self._row_perm]
+                else:
+                    b = np.pad(src, ((0, n_pad - n), (0, 0)))
                 self.binned = jax.device_put(
                     b, NamedSharding(self._mesh, P(self._data_axis, None)))
             else:
-                F_pad = self._f_pad
-                b = np.pad(self.train_set.binned, ((0, 0), (0, F_pad - F)))
+                src = self.train_set.binned
+                if self._col_perm is not None:
+                    # shard-major EFB columns (pads -> all-zero column)
+                    b = np.concatenate(
+                        [src, np.zeros((src.shape[0], 1), src.dtype)],
+                        axis=1)[:, self._col_perm]
+                else:
+                    b = np.pad(src, ((0, 0), (0, self._f_pad - F)))
                 self.binned = jax.device_put(
                     b, NamedSharding(self._mesh, P(None, self._feature_axis)))
         else:
             self.binned = jnp.asarray(self.train_set.binned)
-        rv = np.zeros(n_pad, np.float32)
-        rv[:n] = 1.0
-        self._row_valid = jnp.asarray(rv)
+        self._row_valid = jnp.asarray(self._pad_rows_np(np.ones(n, np.float32)))
         if objective is not None:
             objective.init(self.train_set.metadata, self.num_data)
 
@@ -102,7 +116,7 @@ class GBDT:
             isc = (isc.reshape(-1, n) if isc.size == K * n else
                    np.broadcast_to(isc.reshape(1, n), (K, n)))
             self.train_score = self.train_score + jnp.asarray(
-                np.pad(isc, ((0, 0), (0, n_pad - n))))
+                np.stack([self._pad_rows_np(row) for row in isc]))
             self._init_score_added = True
 
         self.valid_sets: List[Dataset] = []
@@ -127,6 +141,62 @@ class GBDT:
 
     # ------------------------------------------------------------------ setup
 
+    def _build_forced_plan(self):
+        """Parse ``config.forcedsplits_filename`` into plan arrays
+        (leaf, inner_feature, threshold_bin), each [n_forced] i32.
+
+        reference: forced_split_json_ loaded at SerialTreeLearner::Init and
+        applied by the ForceSplits BFS (serial_tree_learner.cpp:411-521).
+        Leaf indices are precomputed here because the grower's split order
+        is deterministic: splits apply in BFS order, the left child keeps
+        the parent's leaf index, and the right child of the i-th split
+        (0-based) gets leaf index i+1.
+        """
+        fname = self.config.forcedsplits_filename
+        if not fname:
+            return None
+        import json
+        from collections import deque
+
+        from ..binning import BinType
+        with open(fname) as f:
+            root = json.load(f)
+        inner = {orig: j for j, orig in
+                 enumerate(self.train_set.used_features)}
+        mappers = self.train_set.bin_mappers
+        leaves: List[int] = []
+        feats: List[int] = []
+        thrs: List[int] = []
+        q = deque()
+        if isinstance(root, dict) and "feature" in root and "threshold" in root:
+            q.append((root, 0))
+        while q and len(leaves) < self.config.num_leaves - 1:
+            node, leaf = q.popleft()
+            forig = int(node["feature"])
+            if forig not in inner:
+                log_warning(
+                    f"forced split on unused/trivial feature {forig}; "
+                    "the rest of the forced-splits plan is dropped")
+                break
+            m = mappers[forig]
+            tb = int(m.value_to_bin(
+                np.array([float(node["threshold"])]))[0])
+            if m.bin_type == BinType.NUMERICAL:
+                tb = min(max(tb, 0), max(m.num_bin - 2, 0))
+            leaves.append(leaf)
+            feats.append(inner[forig])
+            thrs.append(tb)
+            right_leaf = len(leaves)      # i+1 for the i-th split
+            for side, child_leaf in (("left", leaf), ("right", right_leaf)):
+                ch = node.get(side)
+                if isinstance(ch, dict) and "feature" in ch \
+                        and "threshold" in ch:
+                    q.append((ch, child_leaf))
+        if not leaves:
+            return None
+        return (np.asarray(leaves, np.int32), np.asarray(feats, np.int32),
+                np.asarray(thrs, np.int32))
+
     def _setup_distribution(self) -> None:
         """Pick the parallel mode from config.tree_learner and build the
         mesh.  reference: CreateTreeLearner (tree_learner.cpp:13-36); with
@@ -137,6 +207,10 @@ class GBDT:
         self._n_pad = self.num_data
         self._f_pad = self.train_set.binned.shape[1]
         self._meta_dist = None
+        self._row_perm = None      # [n_pad] padded-slot -> original row
+        self._inv_perm = None      # [n] original row -> padded slot
+        self._feat_perm = None     # [F_pad] padded feature slot -> inner
+        self._col_perm = None      # [G_pad] padded column slot -> group
         tl = str(self.config.tree_learner).lower()
         aliases = {"data_parallel": "data", "feature_parallel": "feature",
                    "voting_parallel": "voting", "serial_tree_learner": "serial"}
@@ -151,48 +225,157 @@ class GBDT:
         ndev = jax.device_count()
         if self.config.num_machines > 1:
             ndev = min(ndev, self.config.num_machines)
-        if self.objective is not None and getattr(
-                self.objective, "need_group", False):
-            raise NotImplementedError(
-                "distributed training with ranking objectives requires "
-                "query-aligned row sharding (not implemented yet); use "
-                "tree_learner=serial")
+        need_group = (self.objective is not None and
+                      getattr(self.objective, "need_group", False))
         if tl in ("data", "voting"):
             self._mesh = make_mesh(ndev, (DATA_AXIS,))
             self._data_axis = DATA_AXIS
-            self._n_pad = pad_rows_to(self.num_data, ndev)
+            if need_group:
+                # ranking: whole queries per shard (query-aligned layout)
+                self._build_query_sharding(ndev)
+            else:
+                self._n_pad = pad_rows_to(self.num_data, ndev)
         else:  # feature
-            if self.meta.resolved().has_bundles:
-                raise NotImplementedError(
-                    "tree_learner=feature requires enable_bundle=false "
-                    "(EFB merges features into shared columns that cannot "
-                    "be sliced per feature shard)")
-            F = self.train_set.binned.shape[1]
             self._mesh = make_mesh(ndev, (FEATURE_AXIS,))
             self._feature_axis = FEATURE_AXIS
-            self._f_pad = (F + ndev - 1) // ndev * ndev
-            if self._f_pad > F:
-                import dataclasses
-                m = self.meta.resolved()
-                pad = self._f_pad - F
-                self._meta_dist = dataclasses.replace(
-                    m,
-                    num_bin=np.concatenate([m.num_bin, np.ones(pad, np.int32)]),
-                    missing_type=np.concatenate([m.missing_type, np.zeros(pad, np.int32)]),
-                    default_bin=np.concatenate([m.default_bin, np.zeros(pad, np.int32)]),
-                    most_freq_bin=np.concatenate([m.most_freq_bin, np.zeros(pad, np.int32)]),
-                    is_categorical=np.concatenate([m.is_categorical, np.zeros(pad, bool)]),
-                    feat_group=np.arange(self._f_pad, dtype=np.int32),
-                    feat_start=np.ones(self._f_pad, np.int32),
-                    num_groups=self._f_pad,
-                )
+            m = self.meta.resolved()
+            if m.has_bundles:
+                # shard EFB GROUPS, not raw features (reference partitions
+                # features after bundling, feature_parallel_tree_learner.cpp:
+                # 33-52): whole bundles per shard, groups/features padded to
+                # uniform per-shard counts, meta arranged shard-major
+                self._build_group_sharding(ndev, m)
             else:
-                self._meta_dist = self.meta.resolved()
+                F = self.train_set.binned.shape[1]
+                self._f_pad = (F + ndev - 1) // ndev * ndev
+                if self._f_pad > F:
+                    import dataclasses
+                    pad = self._f_pad - F
+                    self._meta_dist = dataclasses.replace(
+                        m,
+                        num_bin=np.concatenate([m.num_bin, np.ones(pad, np.int32)]),
+                        missing_type=np.concatenate([m.missing_type, np.zeros(pad, np.int32)]),
+                        default_bin=np.concatenate([m.default_bin, np.zeros(pad, np.int32)]),
+                        most_freq_bin=np.concatenate([m.most_freq_bin, np.zeros(pad, np.int32)]),
+                        is_categorical=np.concatenate([m.is_categorical, np.zeros(pad, bool)]),
+                        feat_group=np.arange(self._f_pad, dtype=np.int32),
+                        feat_start=np.ones(self._f_pad, np.int32),
+                        num_groups=self._f_pad,
+                    )
+                else:
+                    self._meta_dist = m
+
+    def _build_query_sharding(self, ndev: int) -> None:
+        """Row layout for distributed ranking: queries are greedily packed
+        onto shards (lightest-first) and each shard is padded to the max
+        shard size, so no query ever straddles a shard boundary and the
+        per-query pairwise lambdas stay shard-local by construction.
+
+        reference analogue: distributed ranking partitions rows at query
+        boundaries at load time (Metadata::CheckOrPartition,
+        src/io/metadata.cpp:141); the per-query loop is
+        rank_objective.hpp:48-65.  Sets ``_n_pad``, ``_row_perm`` (padded
+        slot -> original row, ``n`` = padding sentinel), ``_inv_perm``.
+        """
+        import heapq
+        md = self.train_set.metadata
+        if md.query_boundaries is None:
+            raise RuntimeError("Ranking tasks require query information")
+        qb = np.asarray(md.query_boundaries, np.int64)
+        sizes = np.diff(qb)
+        heap = [(0, d) for d in range(ndev)]
+        heapq.heapify(heap)
+        shard_queries: List[List[int]] = [[] for _ in range(ndev)]
+        for q in range(len(sizes)):
+            tot, d = heapq.heappop(heap)
+            shard_queries[d].append(q)
+            heapq.heappush(heap, (tot + int(sizes[q]), d))
+        n_shard = max(1, max((int(sizes[qs].sum()) for qs in shard_queries
+                              if qs), default=1))
+        self._n_pad = n_shard * ndev
+        n = self.num_data
+        perm = np.full(self._n_pad, n, np.int64)
+        for d, qs in enumerate(shard_queries):
+            pos = d * n_shard
+            for q in qs:
+                lo, hi = int(qb[q]), int(qb[q + 1])
+                perm[pos:pos + hi - lo] = np.arange(lo, hi)
+                pos += hi - lo
+        self._row_perm = perm
+        inv = np.empty(n, np.int64)
+        inv[perm[perm < n]] = np.nonzero(perm < n)[0]
+        self._inv_perm = inv
+
+    def _build_group_sharding(self, ndev: int, m) -> None:
+        """Shard-major EFB layout for tree_learner=feature: pack whole
+        bundles onto shards (greedy, lightest feature count first), pad
+        every shard to G_shard group columns and F_shard features, and
+        rewrite the meta arrays in that order with shard-LOCAL group
+        indices.  Sets ``_meta_dist``, ``_f_pad``, ``_feat_perm`` (padded
+        feature slot -> inner feature, sentinel = F) and ``_col_perm``
+        (padded column slot -> group, sentinel = G)."""
+        import dataclasses
+        import heapq
+        F = len(m.num_bin)
+        G = m.num_groups
+        feats_of: List[List[int]] = [[] for _ in range(G)]
+        for f, g in enumerate(np.asarray(m.feat_group)):
+            feats_of[int(g)].append(f)
+        heap = [(0, d) for d in range(ndev)]
+        heapq.heapify(heap)
+        shard_groups: List[List[int]] = [[] for _ in range(ndev)]
+        for g in sorted(range(G), key=lambda gg: -len(feats_of[gg])):
+            cnt, d = heapq.heappop(heap)
+            shard_groups[d].append(g)
+            heapq.heappush(heap, (cnt + len(feats_of[g]), d))
+        G_shard = max(1, max(len(s) for s in shard_groups))
+        F_shard = max(1, max(sum(len(feats_of[g]) for g in s)
+                             for s in shard_groups))
+        if F_shard == G_shard:
+            # FeatureMeta.has_bundles tests num_groups != num_features;
+            # keep them distinct so the grower stays on the bundle path
+            F_shard += 1
+        G_pad, F_pad = G_shard * ndev, F_shard * ndev
+        col_perm = np.full(G_pad, G, np.int64)
+        feat_perm = np.full(F_pad, F, np.int64)
+        feat_group_local = np.zeros(F_pad, np.int32)
+        for d, gs in enumerate(shard_groups):
+            for j, g in enumerate(gs):
+                col_perm[d * G_shard + j] = g
+            pos = d * F_shard
+            for j, g in enumerate(gs):
+                for f in feats_of[g]:
+                    feat_perm[pos] = f
+                    feat_group_local[pos] = j
+                    pos += 1
+
+        def takef(arr, fill, dtype):
+            ext = np.concatenate(
+                [np.asarray(arr, dtype), np.asarray([fill], dtype)])
+            return ext[feat_perm]
+
+        self._meta_dist = dataclasses.replace(
+            m,
+            num_bin=takef(m.num_bin, 1, np.int32),
+            missing_type=takef(m.missing_type, 0, np.int32),
+            default_bin=takef(m.default_bin, 0, np.int32),
+            most_freq_bin=takef(m.most_freq_bin, 0, np.int32),
+            is_categorical=takef(m.is_categorical, False, bool),
+            feat_group=feat_group_local,
+            feat_start=takef(m.feat_start, 1, np.int32),
+            num_groups=G_pad,
+        )
+        self._f_pad = F_pad
+        self._feat_perm = feat_perm
+        self._col_perm = col_perm
 
     def _pad_rows_np(self, p: np.ndarray) -> np.ndarray:
-        """Pad a per-row host array to the sharded row count."""
-        pad = self._n_pad - self.num_data
+        """Pad (and, for query-aligned layouts, permute) a per-row host
+        array to the sharded row layout."""
         p = np.asarray(p, np.float32)
+        if self._row_perm is not None:
+            return np.concatenate([p, np.zeros(1, np.float32)])[self._row_perm]
+        pad = self._n_pad - self.num_data
         return np.pad(p, (0, pad)) if pad else p
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
@@ -229,6 +412,38 @@ class GBDT:
             bynode_cnt = max(
                 int(round(F_used * self.config.feature_fraction_bynode)),
                 min(2, F_used))
+        # CEGB wiring (reference: CostEfficientGradientBoosting::IsEnable +
+        # Init, cost_effective_gradient_boosting.hpp:25-49): map the
+        # per-ORIGINAL-feature penalty lists onto the used (inner) features
+        cc = self.config
+        coupled = list(cc.cegb_penalty_feature_coupled or [])
+        lazy = list(cc.cegb_penalty_feature_lazy or [])
+        cegb_enabled = bool(cc.cegb_penalty_split > 0.0 or coupled or lazy)
+        ntf = self.train_set.num_total_features
+        self._cegb_coupled_pen = None
+        self._cegb_lazy_pen = None
+        if cegb_enabled:
+            if self._mesh is not None and self.tree_learner_type in (
+                    "feature", "voting"):
+                raise NotImplementedError(
+                    "CEGB is implemented for the serial and data-parallel "
+                    "learners; use tree_learner=serial or data")
+            for name, lst in (("cegb_penalty_feature_coupled", coupled),
+                              ("cegb_penalty_feature_lazy", lazy)):
+                if lst and len(lst) != ntf:
+                    # reference: Log::Fatal at CEGB Init
+                    raise ValueError(
+                        f"{name} should be the same size as feature number "
+                        f"({len(lst)} vs {ntf})")
+            uf = np.asarray(self.train_set.used_features, np.int64)
+            if coupled:
+                self._cegb_coupled_pen = jnp.asarray(
+                    np.asarray(coupled, np.float32)[uf])
+            if lazy:
+                self._cegb_lazy_pen = jnp.asarray(
+                    np.asarray(lazy, np.float32)[uf])
+        self._cegb_enabled = cegb_enabled
+        forced_plan = self._build_forced_plan()
         # re-derive the grower config so reset_parameter() of tree
         # hyper-parameters (lambda_l1, min_data_in_leaf, ...) takes effect
         self.grower_cfg = GrowerConfig(
@@ -242,7 +457,23 @@ class GBDT:
             voting_top_k=vote_k,
             num_machines=nmach,
             bynode_feature_cnt=bynode_cnt,
+            num_feature_shards=(int(self._mesh.shape[self._feature_axis])
+                                if self._feature_axis is not None else 1),
+            cegb_tradeoff=cc.cegb_tradeoff,
+            cegb_penalty_split=cc.cegb_penalty_split,
+            cegb_coupled=bool(coupled),
+            cegb_lazy=bool(lazy),
+            n_forced=0 if forced_plan is None else len(forced_plan[0]),
         )
+        # cross-tree CEGB device state (reference keeps it in the learner)
+        F_inner = len(self.train_set.used_features)
+        used0 = jnp.zeros((F_inner,), bool)
+        rows0 = jnp.zeros((F_inner, self._n_pad) if lazy else (1, 1), bool)
+        if lazy and self._mesh is not None and self._data_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rows0 = jax.device_put(
+                rows0, NamedSharding(self._mesh, P(None, self._data_axis)))
+        self._cegb_state = (used0, rows0)
         # per-node randomness base key (extra_trees thresholds + by-node
         # column sampling); advanced by iteration in train_one_iter
         self._node_key_base = jax.random.PRNGKey(
@@ -271,7 +502,9 @@ class GBDT:
             mc_full = np.zeros(self.train_set.num_total_features, np.int32)
             mc_full[:len(mc)] = np.asarray(mc, np.int32)
             mc = mc_full[self.train_set.used_features]
-            if self._feature_axis is not None and self._f_pad > len(mc):
+            if self._feat_perm is not None:
+                mc = np.concatenate([mc, np.zeros(1, np.int32)])[self._feat_perm]
+            elif self._feature_axis is not None and self._f_pad > len(mc):
                 mc = np.concatenate(
                     [mc, np.zeros(self._f_pad - len(mc), np.int32)])
             mc = jnp.asarray(mc)
@@ -279,23 +512,51 @@ class GBDT:
             mc = None
         meta = self._meta_dist if self._meta_dist is not None else self.meta
 
+        cegb_on = self._cegb_enabled
+        coupled_pen = self._cegb_coupled_pen
+        lazy_pen = self._cegb_lazy_pen
+        # padded-device feature slot -> inner used-feature index (sharded
+        # EFB layout); trees must come back in inner feature numbering
+        feat_perm_j = (jnp.asarray(self._feat_perm, jnp.int32)
+                       if self._feat_perm is not None else None)
+
         def iter_body(binned, score, row_mask, grad, hess, fmask, lr, rng,
-                      label_r, weight_r, axis_name, feature_axis_name):
+                      label_r, weight_r, cegb_used, cegb_rows,
+                      axis_name, feature_axis_name):
             """grad/hess: [K, rows]; fmask: [K, F] col-sample masks; lr:
             traced scalar so a learning_rates schedule never recompiles;
-            rng: per-iteration PRNG key for node-level randomness.
-            Returns (new_score, stacked trees, leaf_ids)."""
+            rng: per-iteration PRNG key for node-level randomness;
+            cegb_used/cegb_rows: cross-tree CEGB state (pass-through dummies
+            when CEGB is off).  Returns (new_score, stacked trees, leaf_ids,
+            cegb_used, cegb_rows)."""
             trees = []
             leaf_ids = []
             new_score = score
             for k in range(K):
-                tree, leaf_id = grow_tree(binned, grad[k], hess[k],
-                                          row_mask, meta, cfg,
-                                          feature_mask=fmask[k],
-                                          monotone_constraints=mc,
-                                          axis_name=axis_name,
-                                          feature_axis_name=feature_axis_name,
-                                          rng_key=jax.random.fold_in(rng, k))
+                if cegb_on:
+                    tree, leaf_id, (cegb_used, cegb_rows) = grow_tree(
+                        binned, grad[k], hess[k], row_mask, meta, cfg,
+                        feature_mask=fmask[k], monotone_constraints=mc,
+                        axis_name=axis_name,
+                        feature_axis_name=feature_axis_name,
+                        rng_key=jax.random.fold_in(rng, k),
+                        cegb_coupled_penalty=coupled_pen,
+                        cegb_lazy_penalty=lazy_pen,
+                        cegb_feat_used=cegb_used,
+                        cegb_used_rows=cegb_rows,
+                        forced_plan=forced_plan)
+                else:
+                    tree, leaf_id = grow_tree(binned, grad[k], hess[k],
+                                              row_mask, meta, cfg,
+                                              feature_mask=fmask[k],
+                                              monotone_constraints=mc,
+                                              axis_name=axis_name,
+                                              feature_axis_name=feature_axis_name,
+                                              rng_key=jax.random.fold_in(rng, k),
+                                              forced_plan=forced_plan)
+                if feat_perm_j is not None:
+                    tree = tree._replace(
+                        split_feature=feat_perm_j[tree.split_feature])
                 if use_renew:
                     residual = label_r - new_score[k]
                     w = row_mask * weight_r
@@ -322,50 +583,69 @@ class GBDT:
                 trees.append(tree)
                 leaf_ids.append(leaf_id)
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
-            return new_score, stacked, jnp.stack(leaf_ids)
+            return new_score, stacked, jnp.stack(leaf_ids), cegb_used, cegb_rows
 
         if self._mesh is None:
-            def one_iter(score, row_mask, grad, hess, fmask, lr, rng):
+            def one_iter(score, row_mask, grad, hess, fmask, lr, rng,
+                         cegb_used, cegb_rows):
                 return iter_body(self.binned, score, row_mask, grad, hess,
                                  fmask, lr, rng, label_a, weight_a,
-                                 None, None)
+                                 cegb_used, cegb_rows, None, None)
             self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
         else:
             from jax.sharding import PartitionSpec as P
             ax_d, ax_f = self._data_axis, self._feature_axis
 
             def core(binned, score, row_mask, grad, hess, fmask, lr, rng,
-                     label_r, weight_r):
+                     label_r, weight_r, cegb_used, cegb_rows):
                 return iter_body(binned, score, row_mask, grad, hess, fmask,
-                                 lr, rng, label_r, weight_r, ax_d, ax_f)
+                                 lr, rng, label_r, weight_r,
+                                 cegb_used, cegb_rows, ax_d, ax_f)
 
             row = P(ax_d)          # replicated when ax_d is None
             krow = P(None, ax_d)
+            # lazy-mode used-rows bitmap is sharded with the rows
+            rows_spec = krow if (cegb_on and cfg.cegb_lazy) else P()
             sharded = jax.shard_map(
                 core, mesh=self._mesh,
                 in_specs=(P(ax_d, ax_f), krow, row, krow, krow, P(), P(),
-                          P(), row, row),
-                out_specs=(krow, P(), krow),
+                          P(), row, row, P(), rows_spec),
+                out_specs=(krow, P(), krow, P(), rows_spec),
                 check_vma=False)
 
-            def one_iter(score, row_mask, grad, hess, fmask, lr, rng):
+            def one_iter(score, row_mask, grad, hess, fmask, lr, rng,
+                         cegb_used, cegb_rows):
                 return sharded(self.binned, score, row_mask, grad, hess,
-                               fmask, lr, rng, label_a, weight_a)
+                               fmask, lr, rng, label_a, weight_a,
+                               cegb_used, cegb_rows)
             self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
         if not hasattr(self, "_feature_rng"):  # survive jit-fn rebuilds
             self._feature_rng = np.random.RandomState(
                 self.config.feature_fraction_seed)
         self._ones_fmask = None
 
+        perm_j = (jnp.asarray(self._row_perm)
+                  if self._row_perm is not None else None)
+        inv_perm_j = (jnp.asarray(self._inv_perm)
+                      if self._inv_perm is not None else None)
+
         def gradients_fn(score):
             if obj is None:
                 raise RuntimeError("no objective: gradients must be provided")
-            s = score if n_pad == n else score[:, :n]
+            if perm_j is not None:
+                # query-aligned layout: objective works in ORIGINAL row order
+                s = score[:, inv_perm_j]
+            else:
+                s = score if n_pad == n else score[:, :n]
             s = s if K > 1 else s[0]
             g, h = obj.get_gradients(s)
             g = g.reshape(K, n)
             h = h.reshape(K, n)
-            if n_pad > n:
+            if perm_j is not None:
+                zcol = jnp.zeros((K, 1), g.dtype)
+                g = jnp.concatenate([g, zcol], axis=1)[:, perm_j]
+                h = jnp.concatenate([h, zcol], axis=1)[:, perm_j]
+            elif n_pad > n:
                 g = jnp.pad(g, ((0, 0), (0, n_pad - n)))
                 h = jnp.pad(h, ((0, 0), (0, n_pad - n)))
             return g, h
@@ -380,6 +660,22 @@ class GBDT:
             return vscore
 
         self._valid_update = jax.jit(valid_update, donate_argnums=(0,))
+        # the TRAIN device matrix may have permuted group columns (sharded
+        # EFB layout); history-tree traversal over it needs a meta whose
+        # feat_group points at the permuted column positions
+        meta_train = self.meta
+        if self._col_perm is not None:
+            import dataclasses
+            mr = self.meta.resolved()
+            inv_col = np.zeros(mr.num_groups, np.int32)
+            valid_cols = self._col_perm < mr.num_groups
+            inv_col[self._col_perm[valid_cols]] = \
+                np.nonzero(valid_cols)[0].astype(np.int32)
+            meta_train = dataclasses.replace(
+                mr, feat_group=inv_col[np.asarray(mr.feat_group)],
+                num_groups=len(self._col_perm))
+        self._tree_pred_train_jit = jax.jit(
+            lambda tree, binned: predict_tree_binned(tree, binned, meta_train))
         self._tree_pred_jit = jax.jit(
             lambda tree, binned: predict_tree_binned(tree, binned, self.meta))
 
@@ -420,17 +716,26 @@ class GBDT:
         F = len(self.train_set.used_features)   # features, not EFB columns
         Fp = max(self._f_pad, F)                # padded for feature sharding
         frac = self.config.feature_fraction
+
+        def place(inner_masks):   # [K, F] inner order -> [K, Fp] device order
+            if self._feat_perm is not None:
+                ext = np.concatenate(
+                    [inner_masks, np.zeros((K, 1), np.float32)], axis=1)
+                return ext[:, self._feat_perm]
+            out = np.zeros((K, Fp), np.float32)
+            out[:, :F] = inner_masks
+            return out
+
         if frac >= 1.0:
             if self._ones_fmask is None:
-                ones = np.zeros((K, Fp), np.float32)
-                ones[:, :F] = 1.0
-                self._ones_fmask = jnp.asarray(ones)
+                self._ones_fmask = jnp.asarray(
+                    place(np.ones((K, F), np.float32)))
             return self._ones_fmask
         cnt = max(1, int(round(F * frac)))
-        masks = np.zeros((K, Fp), np.float32)
+        masks = np.zeros((K, F), np.float32)
         for k in range(K):
             masks[k, self._feature_rng.choice(F, size=cnt, replace=False)] = 1.0
-        return jnp.asarray(masks)
+        return jnp.asarray(place(masks))
 
     def _boost(self, score) -> Tuple[jax.Array, jax.Array]:
         return self._gradients_fn(score)
@@ -467,14 +772,16 @@ class GBDT:
             grad = np.asarray(grad, np.float32).reshape(K, n)
             hess = np.asarray(hess, np.float32).reshape(K, n)
             if self._n_pad > n:
-                grad = np.pad(grad, ((0, 0), (0, self._n_pad - n)))
-                hess = np.pad(hess, ((0, 0), (0, self._n_pad - n)))
+                grad = np.stack([self._pad_rows_np(r) for r in grad])
+                hess = np.stack([self._pad_rows_np(r) for r in hess])
             grad, hess = jnp.asarray(grad), jnp.asarray(hess)
         mask = self._bagging_mask(self.iter)
 
-        self.train_score, stacked, leaf_ids = self._iter_fn(
+        (self.train_score, stacked, leaf_ids,
+         *self._cegb_state) = self._iter_fn(
             self.train_score, mask, grad, hess, self._feature_masks(),
-            jnp.float32(self.shrinkage_rate), self._node_key())
+            jnp.float32(self.shrinkage_rate), self._node_key(),
+            *self._cegb_state)
         return self._finish_iter(stacked)
 
     def _node_key(self):
@@ -500,6 +807,7 @@ class GBDT:
                         "that meet the split requirements")
             return True
         self.models.extend(new_models)
+        self.models_version += 1
 
         # keep the device trees for drop/rollback re-evaluation; fold the
         # iter-0 init bias into the saved leaf values so a saved tree's
@@ -544,8 +852,12 @@ class GBDT:
         c = self.config
         for it in range(len(self.models) // K):
             grad, hess = self._boost(self.train_score)
-            g = np.asarray(grad)[:, :n]
-            h = np.asarray(hess)[:, :n]
+            if self._inv_perm is not None:
+                g = np.asarray(grad)[:, self._inv_perm]
+                h = np.asarray(hess)[:, self._inv_perm]
+            else:
+                g = np.asarray(grad)[:, :n]
+                h = np.asarray(hess)[:, :n]
             for k in range(K):
                 mi = it * K + k
                 m = self.models[mi]
@@ -561,6 +873,7 @@ class GBDT:
                     out = np.clip(out, -c.max_delta_step, c.max_delta_step)
                 m.leaf_value = (decay_rate * m.leaf_value
                                 + (1.0 - decay_rate) * out * m.shrinkage)
+                self.models_version += 1
                 self.train_score = self.train_score.at[k].add(
                     jnp.asarray(self._pad_rows_np(m.leaf_value[lp])))
 
@@ -579,7 +892,9 @@ class GBDT:
         if 0 <= hist_idx < len(self.tree_history):
             tree_k = jax.tree_util.tree_map(
                 lambda x: x[k], self.tree_history[hist_idx])
-            out = self._tree_pred_jit(tree_k, binned)
+            fn = (self._tree_pred_train_jit if binned is self.binned
+                  else self._tree_pred_jit)
+            out = fn(tree_k, binned)
             scale = self.history_scale.get(model_idx, 1.0)
             return out * jnp.float32(scale) if scale != 1.0 else out
         p = self.models[model_idx].predict_binned_np(
@@ -604,6 +919,7 @@ class GBDT:
                                             self.valid_sets[i]))
             self.history_scale.pop(first + k, None)
         del self.models[-K:]
+        self.models_version += 1
         if self.tree_history:
             self.tree_history.pop()
         self.iter -= 1
@@ -623,8 +939,11 @@ class GBDT:
 
     def _eval(self, dataname, score, metrics, objective):
         score_np = np.asarray(score)
-        if score_np.shape[-1] > self.num_data and dataname == "training":
-            score_np = score_np[:, :self.num_data]   # drop sharding pad rows
+        if dataname == "training":
+            if self._inv_perm is not None:
+                score_np = score_np[:, self._inv_perm]  # undo query layout
+            elif score_np.shape[-1] > self.num_data:
+                score_np = score_np[:, :self.num_data]  # drop pad rows
         s = score_np if self.num_tree_per_iteration > 1 else score_np[0]
         out = []
         for m in metrics:

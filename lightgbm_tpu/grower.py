@@ -36,8 +36,9 @@ from jax import lax
 from .dataset import FeatureMeta
 from .ops.histogram import (build_histogram, capacity_schedule,
                             compacted_histogram)
-from .ops.split import (MAX_CAT_WORDS, SplitHyperparams, SplitResult,
-                        best_split_for_leaf, feature_best_splits, leaf_output)
+from .ops.split import (K_EPSILON, MAX_CAT_WORDS, PerFeatureBest,
+                        SplitHyperparams, SplitResult, best_split_for_leaf,
+                        feature_best_splits, leaf_gain, leaf_output)
 
 
 class TreeArrays(NamedTuple):
@@ -127,6 +128,57 @@ class _LeafBest(NamedTuple):
         )
 
 
+class _LeafFeatBest(NamedTuple):
+    """Per-(leaf, feature) cached split candidates (CEGB mode, SoA [L, F]).
+
+    Unlike the reference, which bakes the CEGB penalty into cached
+    SplitInfos and has to patch them when a feature's coupled penalty is
+    first paid (UpdateLeafBestSplits,
+    cost_effective_gradient_boosting.hpp:63-88), the gains cached here are
+    penalty-FREE; the penalty is applied at selection time from the
+    current used-feature state, so every cached candidate always sees the
+    up-to-date coupled penalty — the reference's upgrade pass, made exact.
+    The lazy (per-row on-demand) penalty IS cached per leaf (``lazy_pen``)
+    because it depends on the rows in the leaf when candidates were
+    computed — the same staleness the reference has.
+    """
+
+    gain: jax.Array          # [L, F] shifted gains WITHOUT cegb penalties
+    threshold: jax.Array     # [L, F] i32
+    default_left: jax.Array  # [L, F] bool
+    left_sum_grad: jax.Array   # [L, F] f32
+    left_sum_hess: jax.Array   # [L, F] f32
+    left_count: jax.Array      # [L, F] f32
+    cat_bitset: jax.Array    # [L, F, MAX_CAT_WORDS] u32
+    lazy_pen: jax.Array      # [L, F] f32 cached on-demand penalties
+
+    @staticmethod
+    def empty(L: int, F: int) -> "_LeafFeatBest":
+        return _LeafFeatBest(
+            gain=jnp.full((L, F), -jnp.inf, jnp.float32),
+            threshold=jnp.zeros((L, F), jnp.int32),
+            default_left=jnp.zeros((L, F), bool),
+            left_sum_grad=jnp.zeros((L, F), jnp.float32),
+            left_sum_hess=jnp.zeros((L, F), jnp.float32),
+            left_count=jnp.zeros((L, F), jnp.float32),
+            cat_bitset=jnp.zeros((L, F, MAX_CAT_WORDS), jnp.uint32),
+            lazy_pen=jnp.zeros((L, F), jnp.float32),
+        )
+
+    def store(self, leaf: jax.Array, pf: PerFeatureBest,
+              lazy_row: jax.Array) -> "_LeafFeatBest":
+        return _LeafFeatBest(
+            gain=self.gain.at[leaf].set(pf.gain),
+            threshold=self.threshold.at[leaf].set(pf.threshold),
+            default_left=self.default_left.at[leaf].set(pf.default_left),
+            left_sum_grad=self.left_sum_grad.at[leaf].set(pf.left_sum_grad),
+            left_sum_hess=self.left_sum_hess.at[leaf].set(pf.left_sum_hess),
+            left_count=self.left_count.at[leaf].set(pf.left_count),
+            cat_bitset=self.cat_bitset.at[leaf].set(pf.cat_bitset),
+            lazy_pen=self.lazy_pen.at[leaf].set(lazy_row),
+        )
+
+
 class GrowerConfig(NamedTuple):
     """Static (trace-time) grower configuration."""
 
@@ -146,9 +198,13 @@ class GrowerConfig(NamedTuple):
     bynode_feature_cnt: int = 0    # >0: feature_fraction_bynode — sample
                                    # this many features per NODE (reference
                                    # ColSampler::GetByNode, col_sampler.hpp:87)
+    num_feature_shards: int = 1    # feature-axis size (static); with EFB the
+                                   # caller pre-arranges meta shard-major so
+                                   # each shard owns whole bundles
     cegb_tradeoff: float = 1.0     # CEGB (reference cost_effective_
     cegb_penalty_split: float = 0.0  # gradient_boosting.hpp:50 DetlaGain)
     cegb_coupled: bool = False     # static: coupled-penalty array passed
+    cegb_lazy: bool = False        # static: per-row on-demand penalties
     n_forced: int = 0              # static count of forced splits (reference
                                    # ForceSplits, serial_tree_learner.cpp:411)
 
@@ -196,13 +252,20 @@ def grow_tree(
     rng_key: Optional[jax.Array] = None,        # PRNG for extra_trees /
                                                 # by-node column sampling
                                                 # (replicated across shards)
-    cegb_coupled_penalty: Optional[jax.Array] = None,  # [F] f32 (real-feature
-                                                # coupled penalties, inner idx)
+    cegb_coupled_penalty: Optional[jax.Array] = None,  # [F] f32 coupled
+                                                # penalties (inner feature idx)
+    cegb_lazy_penalty: Optional[jax.Array] = None,     # [F] f32 per-row
+                                                # on-demand penalties
     cegb_feat_used: Optional[jax.Array] = None,  # [F] bool: feature already
-                                                # used in any split so far
-    forced_plan: Optional[tuple] = None,        # (leaf, feat, thr, dl) arrays
-                                                # [n_forced] from
-                                                # build_forced_plan()
+                                                # used in any split (carried
+                                                # across trees by the caller)
+    cegb_used_rows: Optional[jax.Array] = None,  # [F, n] bool: (feature, row)
+                                                # pairs already paid for
+                                                # (lazy mode; carried across
+                                                # trees by the caller)
+    forced_plan: Optional[tuple] = None,        # (leaf, feat, thr) i32 arrays
+                                                # [cfg.n_forced]; see
+                                                # GBDT._build_forced_plan
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32).
 
@@ -226,19 +289,29 @@ def grow_tree(
     Bg = meta.max_group_bin if meta.has_bundles else B
     hp = cfg.hp
 
-    if feature_axis_name is not None and meta.has_bundles:
-        raise NotImplementedError(
-            "feature-axis sharding requires enable_bundle=false (EFB merges "
-            "features into shared columns, which cannot be row-sliced per "
-            "feature shard)")
     # full (unsliced) constraints for split-time bound propagation, which
     # looks up by GLOBAL feature index even when features are sharded
     mc_full = (jnp.asarray(monotone_constraints)
                if monotone_constraints is not None else None)
     if feature_axis_name is not None:
-        # features sharded: each device's binned holds G columns of the full
-        # feature axis (identity groups); slice the full meta arrays
-        F = G
+        # features sharded: each device's binned holds G columns of the
+        # full group axis.  Without EFB those are identity groups; with EFB
+        # the caller pre-arranged groups SHARD-MAJOR so every shard owns
+        # whole bundles (reference partitions features after bundling,
+        # feature_parallel_tree_learner.cpp:33-52) and meta.feat_group
+        # already holds shard-LOCAL group indices.
+        if meta.has_bundles:
+            if cfg.num_feature_shards <= 1:
+                raise NotImplementedError(
+                    "feature-axis sharding over EFB bundles needs the "
+                    "shard-major layout: set cfg.num_feature_shards to the "
+                    "feature-axis size and pre-arrange meta/columns as "
+                    "GBDT._build_group_sharding does (or train through the "
+                    "engine, which does this automatically)")
+            nsh = cfg.num_feature_shards
+            F = len(meta.num_bin) // nsh
+        else:
+            F = G
         fidx = lax.axis_index(feature_axis_name)
         def shard_slice(arr):
             return lax.dynamic_slice_in_dim(jnp.asarray(arr), fidx * F, F)
@@ -252,8 +325,12 @@ def grow_tree(
             monotone_constraints = lax.dynamic_slice_in_dim(
                 jnp.asarray(monotone_constraints), fidx * F, F)
         f_offset = fidx * F
-        feat_group = jnp.arange(F, dtype=jnp.int32)
-        feat_start = jnp.ones(F, jnp.int32)
+        if meta.has_bundles:
+            feat_group = shard_slice(meta.feat_group)   # shard-LOCAL groups
+            feat_start = shard_slice(meta.feat_start)
+        else:
+            feat_group = jnp.arange(F, dtype=jnp.int32)
+            feat_start = jnp.ones(F, jnp.int32)
     else:
         F = len(meta.num_bin)
         num_bin = jnp.asarray(meta.num_bin)
@@ -298,25 +375,45 @@ def grow_tree(
                                   "supported")
 
     # CEGB (reference: cost_effective_gradient_boosting.hpp) — penalties are
-    # subtracted from candidate gains inside the split search; the
-    # used-feature mask is loop state so the coupled penalty disappears the
-    # moment a feature is first paid for (UpdateLeafBestSplits semantics)
-    cegb_enabled = cfg.cegb_penalty_split > 0.0 or cfg.cegb_coupled
+    # subtracted from candidate gains; candidates are cached per
+    # (leaf, feature) penalty-free and penalized at selection time, so the
+    # coupled penalty disappears for EVERY cached candidate the moment a
+    # feature is first used (UpdateLeafBestSplits semantics, made exact)
+    cegb_enabled = (cfg.cegb_penalty_split > 0.0 or cfg.cegb_coupled
+                    or cfg.cegb_lazy)
     if cegb_enabled and (voting or feature_axis_name is not None):
         raise NotImplementedError(
             "CEGB is implemented for the serial and data-parallel learners")
     if cegb_feat_used is None:
         cegb_feat_used = jnp.zeros(F, bool)
+    if cegb_used_rows is None:
+        cegb_used_rows = jnp.zeros((F, n) if cfg.cegb_lazy else (1, 1), bool)
 
-    def cegb_penalty(cnt, used):
-        if not cegb_enabled:
-            return None
-        pen = jnp.full((F,), cfg.cegb_tradeoff * cfg.cegb_penalty_split,
-                       jnp.float32) * cnt
+    def cegb_gains(fb: "_LeafFeatBest", leaf_cnt_arr, used):
+        """[L, F] penalized gains from the candidate cache (the reference's
+        DetlaGain, cost_effective_gradient_boosting.hpp:50, applied
+        dynamically from current state)."""
+        pen = jnp.zeros((), jnp.float32)
+        if cfg.cegb_penalty_split > 0.0:
+            pen = pen + (cfg.cegb_tradeoff * cfg.cegb_penalty_split
+                         * leaf_cnt_arr[:, None])
         if cfg.cegb_coupled:
-            pen = pen + jnp.where(used, 0.0,
-                                  cfg.cegb_tradeoff * cegb_coupled_penalty)
-        return pen
+            pen = pen + jnp.where(
+                used[None, :], 0.0,
+                cfg.cegb_tradeoff * cegb_coupled_penalty[None, :])
+        if cfg.cegb_lazy:
+            pen = pen + fb.lazy_pen
+        return jnp.where(jnp.isfinite(fb.gain), fb.gain - pen, -jnp.inf)
+
+    def cegb_lazy_row(in_leaf, used_rows):
+        """[F] on-demand penalty for one leaf's rows (reference:
+        CalculateOndemandCosts, cost_effective_gradient_boosting.hpp:93-113
+        — the per-feature penalty times the leaf rows that have not yet
+        paid for the feature)."""
+        if not cfg.cegb_lazy:
+            return jnp.zeros((F,), jnp.float32)
+        cnt = (~used_rows).astype(jnp.float32) @ in_leaf.astype(jnp.float32)
+        return cfg.cegb_tradeoff * cegb_lazy_penalty * _psum(cnt, axis_name)
 
     # per-node randomness: extra_trees thresholds + by-node column sampling.
     # The key is REPLICATED across shards (reference syncs random seeds
@@ -393,8 +490,7 @@ def grow_tree(
             extra_rand_u=(eru[elected] if eru is not None else None))
         return r._replace(feature=elected[r.feature])
 
-    def leaf_best(ghist, sg, sh, cnt, depth, bounds=None, key=None,
-                  used=None):
+    def leaf_best(ghist, sg, sh, cnt, depth, bounds=None, key=None):
         fm_bn, eru = node_rand(key) if (use_rng and key is not None) \
             else (None, None)
         fm = feature_mask
@@ -413,8 +509,7 @@ def grow_tree(
             monotone_constraints=monotone_constraints,
             leaf_output_bounds=bounds,
             has_categorical=has_cat,
-            extra_rand_u=eru,
-            gain_penalty=cegb_penalty(cnt, used))
+            extra_rand_u=eru)
         # depth limit (reference: serial_tree_learner.cpp:261-301 pruning)
         if cfg.max_depth > 0:
             r = r._replace(gain=jnp.where(depth >= cfg.max_depth, -jnp.inf, r.gain))
@@ -427,6 +522,25 @@ def grow_tree(
             r = jax.tree_util.tree_map(lambda x: x[winner], gathered)
         return r
 
+    def leaf_feats(ghist, sg, sh, cnt, depth, bounds=None, key=None):
+        """Per-feature best candidates for one leaf, penalty-free (fills a
+        row of the CEGB _LeafFeatBest cache)."""
+        fm_bn, eru = node_rand(key) if (use_rng and key is not None) \
+            else (None, None)
+        fm = feature_mask
+        if fm_bn is not None:
+            fm = fm_bn if fm is None else fm * fm_bn
+        hist = expand_hist(ghist, sg, sh, cnt)
+        pf = feature_best_splits(
+            hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
+            hp, feature_mask=fm, monotone_constraints=monotone_constraints,
+            leaf_output_bounds=bounds, has_categorical=has_cat,
+            extra_rand_u=eru)
+        if cfg.max_depth > 0:
+            pf = pf._replace(gain=jnp.where(depth >= cfg.max_depth,
+                                            -jnp.inf, pf.gain))
+        return pf
+
     # ---- root ----
     # voting mode: the histogram cache holds LOCAL (per-shard) histograms;
     # only elected features are ever psum'd (inside leaf_best_voting).
@@ -438,7 +552,6 @@ def grow_tree(
     root_cnt = _psum(jnp.sum(row_mask), axis_name)
 
     tree = TreeArrays.empty(L)
-    best = _LeafBest.empty(L)
     hist_cache = jnp.zeros((L, G, Bg, 3), jnp.float32).at[0].set(root_hist)
     leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
     leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
@@ -452,15 +565,23 @@ def grow_tree(
     leaf_max = jnp.full(L, jnp.inf, jnp.float32)
     root_bounds = (leaf_min[0], leaf_max[0]) if use_mc else None
     root_key = jax.random.fold_in(rng_key, 0) if use_rng else None
-    best = best.store(jnp.array(0), leaf_best(root_hist, root_sg, root_sh,
-                                              root_cnt, jnp.array(0),
-                                              bounds=root_bounds,
-                                              key=root_key))
+    if cegb_enabled:
+        best = _LeafFeatBest.empty(L, F).store(
+            jnp.array(0),
+            leaf_feats(root_hist, root_sg, root_sh, root_cnt, jnp.array(0),
+                       bounds=root_bounds, key=root_key),
+            cegb_lazy_row(row_mask > 0, cegb_used_rows))
+    else:
+        best = _LeafBest.empty(L).store(
+            jnp.array(0), leaf_best(root_hist, root_sg, root_sh,
+                                    root_cnt, jnp.array(0),
+                                    bounds=root_bounds, key=root_key))
     leaf_id = jnp.zeros(n, jnp.int32)
+    is_cat_b = is_cat.astype(bool)
 
     class Carry(NamedTuple):
         tree: TreeArrays
-        best: _LeafBest
+        best: object          # _LeafBest, or _LeafFeatBest in CEGB mode
         hist: jax.Array
         leaf_sg: jax.Array
         leaf_sh: jax.Array
@@ -470,25 +591,132 @@ def grow_tree(
         split_idx: jax.Array  # number of splits applied so far
         leaf_min: jax.Array   # [L] monotone lower bounds
         leaf_max: jax.Array   # [L] monotone upper bounds
+        cegb_used: jax.Array  # [F] bool: features used in any split
+        cegb_rows: jax.Array  # [F, n] bool lazy-paid rows ([1,1] dummy)
+        forced_aborted: jax.Array  # scalar bool: forced plan abandoned
+
+    def current_selection(c: Carry):
+        """Best-first choice: (leaf, SplitResult) of the max-gain leaf."""
+        active = jnp.arange(L) < c.tree.num_leaves
+        if cegb_enabled:
+            g = cegb_gains(c.best, c.leaf_cnt, c.cegb_used)
+            g = jnp.where(active[:, None], g, -jnp.inf)
+            leaf = jnp.argmax(jnp.max(g, axis=1)).astype(jnp.int32)
+            gl = g[leaf]
+            f = jnp.argmax(gl).astype(jnp.int32)   # ties -> smaller feature
+            lg = c.best.left_sum_grad[leaf, f]
+            lh = c.best.left_sum_hess[leaf, f]
+            lc = c.best.left_count[leaf, f]
+            r = SplitResult(
+                gain=gl[f], feature=f,
+                threshold=c.best.threshold[leaf, f],
+                default_left=c.best.default_left[leaf, f],
+                left_sum_grad=lg, left_sum_hess=lh, left_count=lc,
+                right_sum_grad=c.leaf_sg[leaf] - lg,
+                right_sum_hess=c.leaf_sh[leaf] - lh,
+                right_count=c.leaf_cnt[leaf] - lc,
+                is_categorical=is_cat_b[f],
+                cat_bitset=c.best.cat_bitset[leaf, f])
+        else:
+            b = c.best
+            gains = jnp.where(active, b.gain, -jnp.inf)
+            leaf = jnp.argmax(gains).astype(jnp.int32)
+            r = SplitResult(
+                gain=b.gain[leaf], feature=b.feature[leaf],
+                threshold=b.threshold[leaf],
+                default_left=b.default_left[leaf],
+                left_sum_grad=b.left_sum_grad[leaf],
+                left_sum_hess=b.left_sum_hess[leaf],
+                left_count=b.left_count[leaf],
+                right_sum_grad=b.right_sum_grad[leaf],
+                right_sum_hess=b.right_sum_hess[leaf],
+                right_count=b.right_count[leaf],
+                is_categorical=b.is_categorical[leaf],
+                cat_bitset=b.cat_bitset[leaf])
+        return leaf, r
+
+    if cfg.n_forced > 0:
+        if voting or feature_axis_name is not None:
+            raise NotImplementedError(
+                "forced splits are implemented for the serial and "
+                "data-parallel learners")
+        fp_leaf = jnp.asarray(forced_plan[0], jnp.int32)
+        fp_feat = jnp.asarray(forced_plan[1], jnp.int32)
+        fp_thr = jnp.asarray(forced_plan[2], jnp.int32)
+
+        def forced_split_result(c: Carry):
+            """Stats for the current forced step's planned split.
+
+            reference: GatherInfoForThreshold (feature_histogram.hpp:486).
+            Deliberate deviation: left/right masses here follow this
+            grower's own partition rule (bin <= threshold goes left,
+            missing follows default_left=True), where the reference's
+            gather assigns bin == threshold to the RIGHT — one bin off vs
+            its own DataPartition::Split.
+            """
+            from .binning import MissingType
+            s = c.split_idx
+            leaf = fp_leaf[s]
+            feat = fp_feat[s]
+            thr = fp_thr[s]
+            sg, sh, cnt = c.leaf_sg[leaf], c.leaf_sh[leaf], c.leaf_cnt[leaf]
+            hist_f = expand_hist(c.hist[leaf], sg, sh, cnt)[feat]   # [B, 3]
+            b = jnp.arange(B, dtype=jnp.int32)
+            nb = num_bin[feat]
+            mt = missing_type[feat]
+            db = default_bin[feat]
+            cat = is_cat_b[feat]
+            valid = b < nb
+            miss_bin = jnp.where(mt == MissingType.NAN, nb - 1,
+                                 jnp.where(mt == MissingType.ZERO, db, -1))
+            sel_num = valid & ((b <= thr) | (b == miss_bin))
+            sel_cat = valid & (b == thr)   # one-hot categorical forced split
+            sel = jnp.where(cat, sel_cat, sel_num)
+            lsum = jnp.sum(jnp.where(sel[:, None], hist_f, 0.0), axis=0)
+            lg, lh, lc = lsum[0], lsum[1], lsum[2]
+            rg, rh, rc = sg - lg, sh - lh, cnt - lc
+            parent_gain = leaf_gain(sg, sh + 2 * K_EPSILON,
+                                    hp.lambda_l1, hp.lambda_l2)
+            gain = (leaf_gain(lg, lh + K_EPSILON, hp.lambda_l1, hp.lambda_l2)
+                    + leaf_gain(rg, rh + K_EPSILON, hp.lambda_l1, hp.lambda_l2)
+                    - parent_gain - hp.min_gain_to_split)
+            gain = jnp.where(jnp.isnan(gain), -jnp.inf, gain)
+            word = (thr // 32).astype(jnp.int32)
+            bit = (thr % 32).astype(jnp.uint32)
+            bitset = jnp.where(
+                cat,
+                jnp.zeros((MAX_CAT_WORDS,), jnp.uint32).at[word].set(
+                    jnp.uint32(1) << bit),
+                jnp.zeros((MAX_CAT_WORDS,), jnp.uint32))
+            r = SplitResult(
+                gain=gain, feature=feat, threshold=thr,
+                default_left=~cat, left_sum_grad=lg, left_sum_hess=lh,
+                left_count=lc, right_sum_grad=rg, right_sum_hess=rh,
+                right_count=rc, is_categorical=cat, cat_bitset=bitset)
+            return leaf, r
 
     def cond(c: Carry):
         active = jnp.arange(L) < c.tree.num_leaves
-        best_gain = jnp.max(jnp.where(active, c.best.gain, -jnp.inf))
-        return (c.split_idx < L - 1) & (best_gain > 0.0)
+        if cegb_enabled:
+            g = cegb_gains(c.best, c.leaf_cnt, c.cegb_used)
+            best_gain = jnp.max(jnp.where(active[:, None], g, -jnp.inf))
+        else:
+            best_gain = jnp.max(jnp.where(active, c.best.gain, -jnp.inf))
+        more = best_gain > 0.0
+        if cfg.n_forced > 0:
+            more = more | ((c.split_idx < cfg.n_forced) & ~c.forced_aborted)
+        return (c.split_idx < L - 1) & more
 
-    def body(c: Carry) -> Carry:
+    def apply_split(c: Carry, leaf, r: SplitResult) -> Carry:
         tree, best = c.tree, c.best
-        active = jnp.arange(L) < tree.num_leaves
-        gains = jnp.where(active, best.gain, -jnp.inf)
-        leaf = jnp.argmax(gains).astype(jnp.int32)   # best-first (leaf-wise)
         s = c.split_idx                               # new internal node index
         new_leaf = tree.num_leaves                    # right child leaf index
 
-        feat = best.feature[leaf]
-        thr = best.threshold[leaf]
-        dl = best.default_left[leaf]
-        ncat = best.is_categorical[leaf]
-        nbits = best.cat_bitset[leaf]
+        feat = r.feature
+        thr = r.threshold
+        dl = r.default_left
+        ncat = r.is_categorical
+        nbits = r.cat_bitset
 
         # -- record node (fix the parent's dangling child pointer first)
         parent_node = tree.leaf_parent[leaf]
@@ -501,8 +729,8 @@ def grow_tree(
         right_child = jnp.where(
             has_parent & (side == 1),
             tree.right_child.at[pn].set(s), tree.right_child)
-        lg, lh, lc = best.left_sum_grad[leaf], best.left_sum_hess[leaf], best.left_count[leaf]
-        rg, rh, rc = best.right_sum_grad[leaf], best.right_sum_hess[leaf], best.right_count[leaf]
+        lg, lh, lc = r.left_sum_grad, r.left_sum_hess, r.left_count
+        rg, rh, rc = r.right_sum_grad, r.right_sum_hess, r.right_count
         parent_out = leaf_output(c.leaf_sg[leaf], c.leaf_sh[leaf],
                                  hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
         new_depth = tree.leaf_depth[leaf] + 1
@@ -514,7 +742,7 @@ def grow_tree(
             cat_bitset=tree.cat_bitset.at[s].set(nbits),
             left_child=left_child.at[s].set(~leaf),
             right_child=right_child.at[s].set(~new_leaf),
-            split_gain=tree.split_gain.at[s].set(best.gain[leaf]),
+            split_gain=tree.split_gain.at[s].set(r.gain),
             internal_value=tree.internal_value.at[s].set(parent_out),
             internal_weight=tree.internal_weight.at[s].set(c.leaf_sh[leaf]),
             internal_count=tree.internal_count.at[s].set(c.leaf_cnt[leaf]),
@@ -530,7 +758,10 @@ def grow_tree(
             local_f = feat - f_offset
             owned = (local_f >= 0) & (local_f < F)
             lf = jnp.clip(local_f, 0, F - 1)
-            gl_local = row_goes_left(binned[:, lf], thr, dl, ncat, nbits,
+            col_l = jnp.take(binned, feat_group[lf], axis=1).astype(jnp.int32)
+            dec_l = col_l - feat_start[lf] + 1
+            binf_l = jnp.where((dec_l >= 1) & (dec_l < num_bin[lf]), dec_l, 0)
+            gl_local = row_goes_left(binf_l, thr, dl, ncat, nbits,
                                      missing_type[lf], default_bin[lf],
                                      num_bin[lf])
             goes_left = lax.psum(
@@ -548,6 +779,17 @@ def grow_tree(
                                       num_bin[feat])
         in_leaf = c.leaf_id == leaf
         leaf_id = jnp.where(in_leaf & ~goes_left, new_leaf, c.leaf_id)
+
+        # -- CEGB state (reference: UpdateLeafBestSplits at the top of
+        # SplitInner, serial_tree_learner.cpp:529-532 — the split feature
+        # becomes globally used; in lazy mode the PARENT leaf's rows have
+        # now paid for it)
+        cegb_used, cegb_rows = c.cegb_used, c.cegb_rows
+        if cegb_enabled:
+            cegb_used = cegb_used.at[feat].set(True)
+        if cfg.cegb_lazy:
+            in_parent = in_leaf & (row_mask > 0)
+            cegb_rows = cegb_rows.at[feat].set(cegb_rows[feat] | in_parent)
 
         # -- leaf sums
         leaf_sg = c.leaf_sg.at[leaf].set(lg).at[new_leaf].set(rg)
@@ -599,16 +841,53 @@ def grow_tree(
         # -- best splits for the two children
         kl = jax.random.fold_in(rng_key, 1 + 2 * s) if use_rng else None
         kr = jax.random.fold_in(rng_key, 2 + 2 * s) if use_rng else None
-        rl = leaf_best(hist_l, lg, lh, lc, new_depth, bounds=bounds_l, key=kl)
-        rr = leaf_best(hist_r, rg, rh, rc, new_depth, bounds=bounds_r, key=kr)
-        best = best.store(leaf, rl).store(new_leaf, rr)
+        if cegb_enabled:
+            pfl = leaf_feats(hist_l, lg, lh, lc, new_depth,
+                             bounds=bounds_l, key=kl)
+            pfr = leaf_feats(hist_r, rg, rh, rc, new_depth,
+                             bounds=bounds_r, key=kr)
+            in_l = (leaf_id == leaf) & (row_mask > 0)
+            in_r = (leaf_id == new_leaf) & (row_mask > 0)
+            best = best.store(leaf, pfl, cegb_lazy_row(in_l, cegb_rows)) \
+                       .store(new_leaf, pfr, cegb_lazy_row(in_r, cegb_rows))
+        else:
+            rl = leaf_best(hist_l, lg, lh, lc, new_depth,
+                           bounds=bounds_l, key=kl)
+            rr = leaf_best(hist_r, rg, rh, rc, new_depth,
+                           bounds=bounds_r, key=kr)
+            best = best.store(leaf, rl).store(new_leaf, rr)
 
         return Carry(tree, best, hist, leaf_sg, leaf_sh, leaf_cnt,
-                     leaf_parent_side, leaf_id, s + 1, leaf_min, leaf_max)
+                     leaf_parent_side, leaf_id, s + 1, leaf_min, leaf_max,
+                     cegb_used, cegb_rows, c.forced_aborted)
+
+    def body(c: Carry) -> Carry:
+        leaf, r = current_selection(c)
+        if cfg.n_forced == 0:
+            return apply_split(c, leaf, r)
+        # forced phase (reference: ForceSplits BFS,
+        # serial_tree_learner.cpp:411-521): while the plan lasts, the
+        # planned split replaces the best-first choice; a failed forced
+        # split (non-positive gain) abandons the REST of the plan and
+        # training continues best-first (abort_last_forced_split :507-519)
+        f_leaf, f_r = forced_split_result(c)
+        in_forced = (c.split_idx < cfg.n_forced) & ~c.forced_aborted
+        ok = f_r.gain > 0.0
+        apply_forced = in_forced & ok
+        aborted = c.forced_aborted | (in_forced & ~ok)
+        leaf = jnp.where(apply_forced, f_leaf, leaf)
+        r = jax.tree_util.tree_map(
+            lambda a, b_: jnp.where(apply_forced, a, b_), f_r, r)
+        do_split = apply_forced | (r.gain > 0.0)
+        out = lax.cond(do_split,
+                       lambda cc: apply_split(cc, leaf, r),
+                       lambda cc: cc, c)
+        return out._replace(forced_aborted=aborted)
 
     init = Carry(tree, best, hist_cache, leaf_sg, leaf_sh, leaf_cnt,
                  leaf_parent_side, leaf_id, jnp.array(0, jnp.int32),
-                 leaf_min, leaf_max)
+                 leaf_min, leaf_max, cegb_feat_used, cegb_used_rows,
+                 jnp.array(False))
     out = lax.while_loop(cond, body, init)
 
     # finalize leaf values (clamped to monotone bounds, reference:
@@ -624,6 +903,10 @@ def grow_tree(
         leaf_weight=jnp.where(active, out.leaf_sh, 0.0),
         leaf_count=jnp.where(active, out.leaf_cnt, 0.0),
     )
+    if cegb_enabled:
+        # hand the cross-tree CEGB state back to the caller (the reference
+        # keeps it in the tree learner across Train calls)
+        return tree, out.leaf_id, (out.cegb_used, out.cegb_rows)
     return tree, out.leaf_id
 
 

@@ -14,12 +14,40 @@ fall back to the pure-NumPy paths.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform as _platform
 import subprocess
 import threading
 
+
+def _host_tag() -> str:
+    """Short host/arch fingerprint for the cached .so filename.
+
+    The library is compiled with -march=native, so a binary baked into a
+    container image or shared filesystem can SIGILL on a host with a
+    different CPU; keying the filename on the CPU identity forces a
+    rebuild there instead.
+    """
+    bits = [_platform.machine(), _platform.system()]
+    model = flags = None
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if model is None and line.startswith("model name"):
+                    model = line.strip()
+                elif flags is None and line.startswith("flags"):
+                    flags = line.strip()   # ISA flags catch hypervisor masks
+                if model is not None and flags is not None:
+                    break
+    except OSError:
+        pass
+    bits.extend(b for b in (model, flags) if b)
+    return hashlib.sha1("|".join(bits).encode()).hexdigest()[:12]
+
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_DIR, "_liblgbt.so")
+_LIB_PATH = os.path.join(_DIR, f"_liblgbt_{_host_tag()}.so")
 _SOURCES = ["predictor.cpp"]
 
 _lock = threading.Lock()

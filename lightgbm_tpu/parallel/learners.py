@@ -54,6 +54,13 @@ def make_sharded_grower(
     (pad rows with row_mask = 0; pad features with trivial bins).
     Returns fn(binned, grad, hess, row_mask) -> (TreeArrays, leaf_id).
     """
+    if feature_axis and meta.resolved().has_bundles \
+            and cfg.num_feature_shards <= 1:
+        raise NotImplementedError(
+            "feature-axis sharding over EFB bundles requires the shard-major "
+            "group layout (GBDT._build_group_sharding); train through the "
+            "engine (lgb.train with tree_learner=feature) or disable "
+            "bundling for this standalone grower")
     row_spec = P(data_axis) if data_axis else P()
     fspec = P(None, feature_axis) if feature_axis else P(None)
     binned_spec = P(data_axis, feature_axis) if feature_axis else P(data_axis)
@@ -65,10 +72,12 @@ def make_sharded_grower(
         check_vma=False,
     )
     def sharded(binned, grad, hess, row_mask):
-        tree, leaf_id = grow_tree(
+        out = grow_tree(
             binned, grad, hess, row_mask, meta, cfg,
             axis_name=data_axis, feature_axis_name=feature_axis)
-        return tree, leaf_id
+        # CEGB-enabled configs return (tree, leaf_id, cegb_state); this
+        # standalone grower drops the cross-tree state (single-tree API)
+        return out[0], out[1]
 
     return jax.jit(sharded)
 
@@ -113,9 +122,14 @@ def create_parallel_grower(tree_learner: str, mesh: Mesh, meta: FeatureMeta,
         return make_sharded_grower(mesh, meta, cfg, data_axis=None,
                                    feature_axis=FEATURE_AXIS)
     if tree_learner in ("voting", "voting_parallel"):
-        # voting-parallel reduces histogram traffic; on ICI plain psum is
-        # faster than vote+gather for single-pod meshes, so map to data
-        # parallel (semantically a superset: exact rather than approximate).
+        # real PV-Tree voting (reference voting_parallel_tree_learner.cpp),
+        # consistent with the GBDT engine path: the grower runs its top-k
+        # vote + elected-features-only psum when voting_top_k > 0.  Default
+        # top_k mirrors the reference config default (config.h top_k = 20).
+        if cfg.voting_top_k <= 0:
+            cfg = cfg._replace(voting_top_k=20)
+        if cfg.num_machines <= 1:
+            cfg = cfg._replace(num_machines=int(mesh.shape[DATA_AXIS]))
         return make_sharded_grower(mesh, meta, cfg, data_axis=DATA_AXIS,
                                    feature_axis=None)
     if tree_learner in ("data_feature", "2d"):

@@ -1,0 +1,214 @@
+"""CEGB and forced-splits tests.
+
+reference semantics:
+- CEGB: src/treelearner/cost_effective_gradient_boosting.hpp (DetlaGain :50,
+  UpdateLeafBestSplits :63, CalculateOndemandCosts :93) with hooks at
+  serial_tree_learner.cpp:65-68,529-532,680-684.
+- Forced splits: SerialTreeLearner::ForceSplits BFS
+  (serial_tree_learner.cpp:411-521), forcedsplits_filename config.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=600, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (2.0 * X[:, 0] + 1.0 * X[:, 1] + 0.5 * X[:, 2]
+         + 0.05 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 16, "verbosity": -1,
+        "min_data_in_leaf": 5, "learning_rate": 0.1}
+
+
+def _total_leaves(booster):
+    return sum(m.num_leaves for m in booster.boosting.models)
+
+
+def _used_features(booster):
+    out = set()
+    for m in booster.boosting.models:
+        for s in range(m.num_leaves - 1):
+            out.add(int(m.split_feature[s]))
+    return out
+
+
+class TestCEGB:
+    def test_split_penalty_prunes(self):
+        """cegb_penalty_split * num_data_in_leaf is subtracted from every
+        candidate gain (DetlaGain), so a positive penalty must strictly
+        reduce tree size and a huge one must stop growth entirely."""
+        X, y = _data()
+        b0 = lgb.train(dict(BASE), lgb.Dataset(X, label=y), num_boost_round=2)
+        b1 = lgb.train(dict(BASE, cegb_penalty_split=0.05),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+        b2 = lgb.train(dict(BASE, cegb_penalty_split=100.0),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+        assert 0 < _total_leaves(b1) < _total_leaves(b0)
+        assert _total_leaves(b2) == 0      # nothing beats the penalty
+
+    def test_split_penalty_changes_chosen_splits(self):
+        X, y = _data()
+        b0 = lgb.train(dict(BASE), lgb.Dataset(X, label=y), num_boost_round=1)
+        b1 = lgb.train(dict(BASE, cegb_penalty_split=0.05),
+                       lgb.Dataset(X, label=y), num_boost_round=1)
+        t0 = b0.boosting.models[0]
+        t1 = b1.boosting.models[0]
+        assert (t0.num_leaves != t1.num_leaves
+                or t0.split_feature[:t0.num_leaves - 1].tolist()
+                != t1.split_feature[:t1.num_leaves - 1].tolist())
+
+    def test_coupled_penalty_concentrates_features(self):
+        """The coupled penalty applies only to features not yet used in any
+        split; once paid it vanishes for the rest of training, so a large
+        coupled penalty concentrates splits on few features."""
+        X, y = _data()
+        b0 = lgb.train(dict(BASE), lgb.Dataset(X, label=y), num_boost_round=3)
+        b1 = lgb.train(dict(BASE, cegb_penalty_feature_coupled=[5.0] * 5),
+                       lgb.Dataset(X, label=y), num_boost_round=3)
+        assert len(_used_features(b1)) < len(_used_features(b0))
+        assert _total_leaves(b1) > 0       # penalty paid once, growth continues
+
+    def test_coupled_state_persists_across_trees(self):
+        """is_feature_used_in_split_ persists across Train calls in the
+        reference learner: a feature paid for in tree 1 is free in tree 2.
+        With a penalty high enough to admit exactly one feature, later
+        trees must keep using that same feature rather than stalling."""
+        X, y = _data()
+        b = lgb.train(dict(BASE, cegb_penalty_feature_coupled=[5.0] * 5),
+                      lgb.Dataset(X, label=y), num_boost_round=4)
+        assert len(b.boosting.models) == 4
+        per_tree_feats = [
+            {int(f) for f in m.split_feature[:m.num_leaves - 1]}
+            for m in b.boosting.models if m.num_leaves > 1]
+        # every later tree reuses already-paid features only
+        paid = per_tree_feats[0]
+        for feats in per_tree_feats[1:]:
+            assert feats <= paid
+            paid |= feats
+
+    def test_lazy_penalty_prunes(self):
+        X, y = _data()
+        b0 = lgb.train(dict(BASE), lgb.Dataset(X, label=y), num_boost_round=2)
+        b1 = lgb.train(dict(BASE, cegb_penalty_feature_lazy=[0.05] * 5),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+        b2 = lgb.train(dict(BASE, cegb_penalty_feature_lazy=[10.0] * 5),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+        assert _total_leaves(b1) <= _total_leaves(b0)
+        assert _total_leaves(b2) == 0
+
+    def test_penalty_list_length_validated(self):
+        X, y = _data()
+        with pytest.raises(ValueError, match="same size as feature number"):
+            lgb.train(dict(BASE, cegb_penalty_feature_coupled=[1.0, 2.0]),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+
+    def test_tradeoff_scales_penalty(self):
+        """cegb_tradeoff multiplies every penalty: tradeoff=0 with a split
+        penalty must reproduce the unpenalized model exactly."""
+        X, y = _data()
+        b0 = lgb.train(dict(BASE), lgb.Dataset(X, label=y), num_boost_round=2)
+        b1 = lgb.train(dict(BASE, cegb_penalty_split=0.05,
+                            cegb_tradeoff=0.0),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+        np.testing.assert_allclose(b0.predict(X), b1.predict(X), rtol=1e-6)
+
+
+class TestForcedSplits:
+    def _forced_file(self, tmp_path, spec):
+        fn = os.path.join(str(tmp_path), "forced.json")
+        with open(fn, "w") as f:
+            json.dump(spec, f)
+        return fn
+
+    def test_root_forced(self, tmp_path):
+        X, y = _data()
+        fn = self._forced_file(tmp_path, {"feature": 3, "threshold": 0.5})
+        b = lgb.train(dict(BASE, forcedsplits_filename=fn),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        t = b.boosting.models[0]
+        assert int(t.split_feature[0]) == 3
+        # threshold maps to the bin boundary containing 0.5
+        assert abs(t.threshold[0] - 0.5) < 0.1
+
+    def test_bfs_order_and_leaf_routing(self, tmp_path):
+        """Left child keeps the parent's leaf index, right child of the
+        i-th split gets leaf i+1 — the BFS plan must land its children on
+        the correct leaves (reference ForceSplits queue order)."""
+        X, y = _data()
+        fn = self._forced_file(tmp_path, {
+            "feature": 3, "threshold": 0.5,
+            "left": {"feature": 4, "threshold": 0.25},
+            "right": {"feature": 4, "threshold": 0.75},
+        })
+        b = lgb.train(dict(BASE, forcedsplits_filename=fn),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        t = b.boosting.models[0]
+        assert int(t.split_feature[0]) == 3
+        assert int(t.split_feature[1]) == 4 and int(t.split_feature[2]) == 4
+        thr = sorted([t.threshold[1], t.threshold[2]])
+        assert abs(thr[0] - 0.25) < 0.1 and abs(thr[1] - 0.75) < 0.1
+        # structure: node 1 must be the left child of node 0, node 2 the right
+        assert t.left_child[0] == 1 and t.right_child[0] == 2
+
+    def test_partition_consistency(self, tmp_path):
+        """Rows route consistently with the forced thresholds: predictions
+        on the two sides of the forced root split must differ by leaf."""
+        X, y = _data()
+        fn = self._forced_file(tmp_path, {"feature": 0, "threshold": 0.5})
+        b = lgb.train(dict(BASE, forcedsplits_filename=fn),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        t = b.boosting.models[0]
+        leaves = b.predict(X, pred_leaf=True).astype(int).ravel()
+        thr = float(t.threshold[0])
+        # every row <= thr goes into the left subtree of node 0
+        left_leaves = {int(l) for l in leaves[X[:, 0] <= thr]}
+        right_leaves = {int(l) for l in leaves[X[:, 0] > thr]}
+        assert left_leaves.isdisjoint(right_leaves)
+
+    def test_training_continues_best_first(self, tmp_path):
+        """After the plan is exhausted, growth continues gain-driven up to
+        num_leaves (the forced tree must not be limited to the plan)."""
+        X, y = _data()
+        fn = self._forced_file(tmp_path, {"feature": 3, "threshold": 0.5})
+        b = lgb.train(dict(BASE, forcedsplits_filename=fn),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        b0 = lgb.train(dict(BASE), lgb.Dataset(X, label=y), num_boost_round=1)
+        t = b.boosting.models[0]
+        assert t.num_leaves > 2
+        assert t.num_leaves == b0.boosting.models[0].num_leaves
+
+    def test_bad_forced_split_aborts_plan(self, tmp_path):
+        """A forced split with no positive gain (all rows on one side)
+        abandons the rest of the plan; training continues best-first
+        (reference: abort_last_forced_split)."""
+        X, y = _data()
+        fn = self._forced_file(tmp_path, {
+            "feature": 3, "threshold": 100.0,      # all rows left
+            "left": {"feature": 4, "threshold": 0.5},
+        })
+        b = lgb.train(dict(BASE, forcedsplits_filename=fn),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        t = b.boosting.models[0]
+        # the degenerate forced split must NOT be applied
+        assert not (int(t.split_feature[0]) == 3 and t.threshold[0] > 1.0)
+        assert t.num_leaves > 1            # best-first growth proceeded
+
+    def test_forced_plus_accuracy(self, tmp_path):
+        """Forcing a reasonable split must not destroy model quality."""
+        X, y = _data(n=2000)
+        fn = self._forced_file(tmp_path, {"feature": 0, "threshold": 0.5})
+        b0 = lgb.train(dict(BASE), lgb.Dataset(X, label=y),
+                       num_boost_round=20)
+        b1 = lgb.train(dict(BASE, forcedsplits_filename=fn),
+                       lgb.Dataset(X, label=y), num_boost_round=20)
+        mse0 = float(np.mean((b0.predict(X) - y) ** 2))
+        mse1 = float(np.mean((b1.predict(X) - y) ** 2))
+        assert mse1 < mse0 * 1.5

@@ -176,23 +176,38 @@ def test_engine_feature_parallel_end_to_end():
                                rtol=1e-4, atol=1e-5)
 
 
-def test_engine_feature_parallel_rejects_efb():
-    # sparse one-hot-ish columns DO bundle under EFB; feature sharding
-    # cannot slice merged columns and must refuse loudly
+def test_engine_feature_parallel_with_efb_matches_serial():
+    """Feature sharding composes with EFB by partitioning whole BUNDLES
+    (reference partitions features after bundling,
+    feature_parallel_tree_learner.cpp:33-52): sparse one-hot-ish columns
+    bundle into shared group columns, groups are packed shard-major, and
+    the result must match serial training exactly."""
     rng = np.random.RandomState(0)
     n = 500
     groups = rng.randint(0, 8, size=n)
     X = np.zeros((n, 8), np.float32)
     X[np.arange(n), groups] = rng.rand(n) + 0.5
-    y = (groups % 2).astype(np.float32)
+    X = np.concatenate([X, rng.rand(n, 4).astype(np.float32)], axis=1)
+    y = ((groups % 2) ^ (X[:, 8] > 0.5)).astype(np.float32)
     import lightgbm_tpu as lgb
     ds = lgb.Dataset(X, label=y)
     ds.construct()
     assert ds.feature_meta().resolved().has_bundles, "test premise: EFB fires"
-    with pytest.raises(NotImplementedError):
-        lgb.train({"objective": "binary", "verbosity": -1,
-                   "min_data_in_leaf": 5, "tree_learner": "feature"},
-                  lgb.Dataset(X, label=y), num_boost_round=2)
+    base = {"objective": "binary", "verbosity": -1, "min_data_in_leaf": 5,
+            "num_leaves": 15}
+    bst_s = lgb.train(dict(base, tree_learner="serial"),
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bst_f = lgb.train(dict(base, tree_learner="feature"),
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bst_f.boosting._mesh is not None
+    assert bst_f.boosting._feat_perm is not None, "EFB shard layout in use"
+    for ms, mf in zip(bst_s.boosting.models, bst_f.boosting.models):
+        np.testing.assert_array_equal(ms.split_feature, mf.split_feature)
+        np.testing.assert_array_equal(ms.threshold_in_bin, mf.threshold_in_bin)
+        np.testing.assert_allclose(ms.leaf_value, mf.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(bst_s.predict(X), bst_f.predict(X),
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_engine_data_parallel_bagging_goss_l1():
@@ -315,3 +330,68 @@ def test_engine_feature_parallel_monotone_matches_serial():
         np.testing.assert_array_equal(ms.split_feature, mf.split_feature)
         np.testing.assert_allclose(ms.leaf_value, mf.leaf_value,
                                    rtol=1e-4, atol=1e-6)
+
+
+def _ranking_xy(n_queries=60, seed=7):
+    """Synthetic LTR data: queries of varying size with graded labels."""
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(5, 40, n_queries)
+    Xs, ys, group = [], [], []
+    for s in sizes:
+        Xq = rng.rand(s, 6)
+        rel = (2.0 * Xq[:, 0] + Xq[:, 1] + 0.3 * rng.randn(s))
+        yq = np.clip(np.digitize(rel, [0.8, 1.5, 2.2]), 0, 3)
+        Xs.append(Xq)
+        ys.append(yq)
+        group.append(s)
+    return (np.concatenate(Xs), np.concatenate(ys).astype(np.float64),
+            np.asarray(group, np.int64))
+
+
+@pytest.mark.parametrize("objective", ["lambdarank", "rank_xendcg"])
+def test_engine_data_parallel_ranking_matches_serial(objective):
+    """Distributed ranking via query-aligned row sharding: whole queries
+    per shard, per-query lambdas shard-local by construction (reference:
+    Metadata::CheckOrPartition partitions at query boundaries,
+    src/io/metadata.cpp:141)."""
+    import lightgbm_tpu as lgb
+    X, y, group = _ranking_xy()
+    base = {"objective": objective, "metric": "ndcg", "ndcg_eval_at": [5],
+            "verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 10,
+            "objective_seed": 11}
+    ev_s, ev_d = {}, {}
+
+    def run(tl, ev):
+        params = dict(base, tree_learner=tl)
+        train = lgb.Dataset(X, label=y, group=group)
+        valid = lgb.Dataset(X, label=y, group=group, reference=train)
+        return lgb.train(params, train, num_boost_round=8,
+                         valid_sets=[valid], evals_result=ev,
+                         verbose_eval=False)
+
+    bst_s = run("serial", ev_s)
+    bst_d = run("data", ev_d)
+    assert bst_d.boosting._mesh is not None, "tree_learner=data must shard"
+    assert bst_d.boosting._row_perm is not None, "query-aligned layout"
+    # no query may straddle a shard boundary
+    perm = bst_d.boosting._row_perm
+    n = len(y)
+    n_shard = len(perm) // 8
+    qb = np.concatenate([[0], np.cumsum(group)])
+    starts = {int(s): i for i, s in enumerate(qb[:-1])}
+    for d in range(8):
+        chunk = perm[d * n_shard:(d + 1) * n_shard]
+        rows = chunk[chunk < n]
+        # rows of one shard = union of complete queries
+        covered = 0
+        while covered < len(rows):
+            q = starts[int(rows[covered])]
+            covered += int(qb[q + 1] - qb[q])
+        assert covered == len(rows)
+    for ms, md in zip(bst_s.boosting.models, bst_d.boosting.models):
+        np.testing.assert_array_equal(ms.split_feature, md.split_feature)
+        np.testing.assert_array_equal(ms.threshold_in_bin, md.threshold_in_bin)
+    np.testing.assert_allclose(bst_s.predict(X), bst_d.predict(X),
+                               rtol=1e-4, atol=1e-5)
+    assert abs(ev_s["valid_0"]["ndcg@5"][-1]
+               - ev_d["valid_0"]["ndcg@5"][-1]) < 1e-3
