@@ -26,13 +26,24 @@ from .binning import BinMapper, BinType, MissingType
 _BINARY_MAGIC = b"lgbm_tpu.dataset.v1\n"
 
 
-def _to_2d_float(data) -> np.ndarray:
+def _as_2d(data) -> np.ndarray:
+    """2-D view of the input WITHOUT materializing a float64 copy.
+
+    Streaming construction (reference: the two-pass DatasetLoader never
+    holds a dense double matrix either — SampleTextDataFromFile +
+    ExtractFeaturesFromFile push row by row, dataset_loader.cpp:775,1101):
+    binning walks one column at a time, so an 11M x 28 float32 input costs
+    one float64 COLUMN of scratch (88 MB) instead of a 2.5 GB full copy.
+    Non-float dtypes (ints, object) still need one up-front cast.
+    """
     if hasattr(data, "values"):  # pandas
         data = data.values
     arr = np.asarray(data)
     if arr.ndim != 2:
         raise ValueError(f"data must be 2-D, got shape {arr.shape}")
-    return np.ascontiguousarray(arr, dtype=np.float64)
+    if arr.dtype not in (np.float32, np.float64):
+        arr = arr.astype(np.float64)
+    return arr
 
 
 def _sample_indices(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
@@ -135,7 +146,7 @@ class Dataset:
             sp = data.tocsc()
             self.num_data, self.num_total_features = sp.shape
         else:
-            raw = _to_2d_float(data)
+            raw = _as_2d(data)
             sp = None
             self.num_data, self.num_total_features = raw.shape
 
@@ -589,9 +600,11 @@ def _is_sparse(data) -> bool:
 
 def _get_col(raw, sp, f: int, rows: Optional[np.ndarray]) -> np.ndarray:
     if raw is not None:
-        col = raw[:, f]
-    else:
-        col = np.asarray(sp[:, f].todense()).reshape(-1).astype(np.float64)
+        if rows is not None:
+            # gather first, THEN widen: the float64 scratch is O(sample)
+            return np.asarray(raw[rows, f], dtype=np.float64)
+        return np.asarray(raw[:, f], dtype=np.float64)
+    col = np.asarray(sp[:, f].todense()).reshape(-1).astype(np.float64)
     return col if rows is None else col[rows]
 
 
