@@ -175,7 +175,7 @@ def kernel_probe(n_rows=1_000_000, f=F, max_bin=MAX_BIN, reps=3):
     mask = jnp.ones((n_rows,), jnp.float32)
     B = max_bin + 1
     out = {}
-    for method in ("matmul", "matmul_f32", "scatter"):
+    for method in ("matmul", "matmul_f32", "scatter", "pallas"):
         fn = jax.jit(lambda b, g, h, m, _m=method: H.build_histogram(
             b, g, h, m, B, method=_m))
         try:

@@ -228,6 +228,7 @@ class Booster:
         ``pred_early_stop_freq`` / ``pred_early_stop_margin`` kwargs mirror
         the reference (src/boosting/prediction_early_stop.cpp).
         """
+        from .utils.timer import global_timer
         if hasattr(data, "values"):
             data = data.values
         if hasattr(data, "tocsr"):  # scipy sparse: chunked densify
@@ -238,6 +239,14 @@ class Booster:
                     pred_leaf=pred_leaf, pred_contrib=pred_contrib,
                     start_iteration=start_iteration, **kwargs),
                 data)
+        with global_timer.section("Booster::Predict"):
+            return self._predict_inner(
+                data, num_iteration, raw_score, pred_leaf, pred_contrib,
+                start_iteration, **kwargs)
+
+    def _predict_inner(self, data, num_iteration=None, raw_score=False,
+                       pred_leaf=False, pred_contrib=False,
+                       start_iteration=0, **kwargs) -> np.ndarray:
         X = np.ascontiguousarray(np.asarray(data, np.float64))
         if X.ndim == 1:
             X = X[None, :]

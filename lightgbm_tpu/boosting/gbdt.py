@@ -444,13 +444,22 @@ class GBDT:
                     np.asarray(lazy, np.float32)[uf])
         self._cegb_enabled = cegb_enabled
         forced_plan = self._build_forced_plan()
+        # resolve hist_method="auto" by MEASURING the kernel variants on
+        # the live accelerator at the training shape (reference: the
+        # GetShareStates col-vs-row timed probe, dataset.cpp:589-684);
+        # CPU resolves to scatter without probing
+        hist_method = self.config.tpu_hist_method
+        if hist_method == "auto" and jax.default_backend() in ("tpu", "axon"):
+            from ..ops.histogram import measured_best_method
+            hist_method = measured_best_method(
+                self.num_data, self.train_set.binned.shape[1], self.num_bins)
         # re-derive the grower config so reset_parameter() of tree
         # hyper-parameters (lambda_l1, min_data_in_leaf, ...) takes effect
         self.grower_cfg = GrowerConfig(
             num_leaves=self.config.num_leaves,
             max_depth=self.config.max_depth,
             hp=self.config.split_hyperparams(),
-            hist_method=self.config.tpu_hist_method,
+            hist_method=hist_method,
             num_bins=self.num_bins,
             learning_rate=self.config.learning_rate,
             compact=self.config.tpu_compact_hist,
@@ -763,11 +772,18 @@ class GBDT:
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True if training should STOP
         (no more splittable leaves).  reference: GBDT::TrainOneIter."""
+        from ..utils.timer import global_timer
+        with global_timer.section("GBDT::TrainOneIter"):
+            return self._train_one_iter_inner(grad, hess)
+
+    def _train_one_iter_inner(self, grad, hess) -> bool:
+        from ..utils.timer import global_timer
         K = self.num_tree_per_iteration
         n = self.num_data
         self.boost_from_average()
         if grad is None:
-            grad, hess = self._boost(self.train_score)
+            with global_timer.section("GBDT::Boosting(gradients)"):
+                grad, hess = self._boost(self.train_score)
         else:
             grad = np.asarray(grad, np.float32).reshape(K, n)
             hess = np.asarray(hess, np.float32).reshape(K, n)
@@ -775,13 +791,15 @@ class GBDT:
                 grad = np.stack([self._pad_rows_np(r) for r in grad])
                 hess = np.stack([self._pad_rows_np(r) for r in hess])
             grad, hess = jnp.asarray(grad), jnp.asarray(hess)
-        mask = self._bagging_mask(self.iter)
+        with global_timer.section("GBDT::Bagging"):
+            mask = self._bagging_mask(self.iter)
 
-        (self.train_score, stacked, leaf_ids,
-         *self._cegb_state) = self._iter_fn(
-            self.train_score, mask, grad, hess, self._feature_masks(),
-            jnp.float32(self.shrinkage_rate), self._node_key(),
-            *self._cegb_state)
+        with global_timer.section("TreeLearner::Train(dispatch)"):
+            (self.train_score, stacked, leaf_ids,
+             *self._cegb_state) = self._iter_fn(
+                self.train_score, mask, grad, hess, self._feature_masks(),
+                jnp.float32(self.shrinkage_rate), self._node_key(),
+                *self._cegb_state)
         return self._finish_iter(stacked)
 
     def _node_key(self):
@@ -791,6 +809,11 @@ class GBDT:
         """Post-step bookkeeping shared by GBDT/GOSS/DART/RF: host copies of
         the (tiny) tree arrays, first-iteration bias folding, valid-score
         updates.  Returns True when training should stop."""
+        from ..utils.timer import global_timer
+        with global_timer.section("GBDT::FinishIter(host trees)"):
+            return self._finish_iter_inner(stacked)
+
+    def _finish_iter_inner(self, stacked) -> bool:
         K = self.num_tree_per_iteration
         new_models = []
         should_continue = False
@@ -938,6 +961,11 @@ class GBDT:
         return out
 
     def _eval(self, dataname, score, metrics, objective):
+        from ..utils.timer import global_timer
+        with global_timer.section("GBDT::EvalMetrics"):
+            return self._eval_inner(dataname, score, metrics, objective)
+
+    def _eval_inner(self, dataname, score, metrics, objective):
         score_np = np.asarray(score)
         if dataname == "training":
             if self._inv_perm is not None:

@@ -135,6 +135,15 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self.constructed:
             return self
+        if getattr(self, "_streaming", False):
+            raise RuntimeError(
+                f"streaming dataset load incomplete: "
+                f"{int(self._pushed.sum())}/{self.num_data} rows pushed")
+        from .utils.timer import global_timer
+        with global_timer.section("Dataset::Construct"):
+            return self._construct_inner()
+
+    def _construct_inner(self) -> "Dataset":
         if self.raw_data is None:
             raise RuntimeError("cannot construct Dataset: raw data was freed")
         data = self.raw_data
@@ -151,15 +160,8 @@ class Dataset:
             self.num_data, self.num_total_features = raw.shape
 
         p = self.params
-        max_bin = int(p.get("max_bin", 255))
-        min_data_in_bin = int(p.get("min_data_in_bin", 3))
-        min_data_in_leaf = int(p.get("min_data_in_leaf", 20))
         sample_cnt = int(p.get("bin_construct_sample_cnt", 200000))
         seed = int(p.get("data_random_seed", 1))
-        use_missing = bool(p.get("use_missing", True))
-        zero_as_missing = bool(p.get("zero_as_missing", False))
-        pre_filter = bool(p.get("feature_pre_filter", True))
-        forced_bounds = _load_forced_bins(p, self.num_total_features)
 
         if self._feature_name_param == "auto" or self._feature_name_param is None:
             if hasattr(self.raw_data, "columns"):
@@ -188,53 +190,13 @@ class Dataset:
             self.max_group_bin = ref.max_group_bin
         else:
             sample_idx = _sample_indices(self.num_data, sample_cnt, seed)
-            total_sample_cnt = len(sample_idx)
-            sample_nonzero = {}           # used-feature pos -> bool [S]
-            self.bin_mappers = []
-            for f in range(self.num_total_features):
-                col = _get_col(raw, sp, f, sample_idx)
-                # keep NaN and non-zero samples; zeros are implicit
-                keep = np.isnan(col) | (np.abs(col) > 1e-35)
-                vals = col[keep]
-                m = BinMapper()
-                btype = BinType.CATEGORICAL if f in categorical else BinType.NUMERICAL
-                m.find_bin(
-                    vals, total_sample_cnt, max_bin,
-                    min_data_in_bin=min_data_in_bin,
-                    min_split_data=min_data_in_leaf,
-                    pre_filter=pre_filter,
-                    bin_type=btype,
-                    use_missing=use_missing,
-                    zero_as_missing=zero_as_missing,
-                    forced_upper_bounds=forced_bounds.get(f, ()),
-                )
-                self.bin_mappers.append(m)
-            self.used_features = [f for f, m in enumerate(self.bin_mappers) if not m.is_trivial]
-            # EFB grouping from the sample (reference: FindGroups /
-            # FastFeatureBundling, dataset.cpp:97-313)
-            for j, f in enumerate(self.used_features):
-                col = _get_col(raw, sp, f, sample_idx)
-                # NaN counts as non-default: a NaN row occupies the
-                # feature's NaN bin in the merged column, so it can
-                # conflict with other bundle members (reference counts
-                # sampled NaN values as non-zero entries)
-                sample_nonzero[j] = np.isnan(col) | (np.abs(col) > 1e-35)
-            self._build_groups(sample_nonzero, total_sample_cnt)
+            self._fit_bin_mappers(raw, sp, sample_idx, categorical)
 
         # second pass: bin every row into the per-GROUP merged columns
-        F = len(self.used_features)
         G = self.num_groups
         dtype = np.uint8 if self.max_group_bin <= 256 else np.uint16
         self.binned = np.zeros((self.num_data, G), dtype=dtype)
-        for j, f in enumerate(self.used_features):
-            col = _get_col(raw, sp, f, None)
-            bins = self.bin_mappers[f].value_to_bin(col)
-            g, start = int(self.feat_group[j]), int(self.feat_start[j])
-            if start == 1 and self._group_size[g] == 1:
-                self.binned[:, g] = bins.astype(dtype)
-            else:
-                nz = bins != 0       # bundled features are zero-default
-                self.binned[nz, g] = (start + bins[nz] - 1).astype(dtype)
+        self._bin_block(raw, sp, self.binned)
 
         self.metadata.check(self.num_data)
         if self.metadata.label is None:
@@ -242,6 +204,138 @@ class Dataset:
         self.constructed = True
         if self.free_raw_data:
             self.raw_data = None
+        return self
+
+    def _fit_bin_mappers(self, raw, sp, sample_idx, categorical) -> None:
+        """FindBin per feature over a row sample + EFB grouping.
+
+        reference: DatasetLoader::ConstructBinMappersFromTextData
+        (dataset_loader.cpp:823) + Dataset::Construct EFB
+        (dataset.cpp:97-313)."""
+        p = self.params
+        max_bin = int(p.get("max_bin", 255))
+        min_data_in_bin = int(p.get("min_data_in_bin", 3))
+        min_data_in_leaf = int(p.get("min_data_in_leaf", 20))
+        use_missing = bool(p.get("use_missing", True))
+        zero_as_missing = bool(p.get("zero_as_missing", False))
+        pre_filter = bool(p.get("feature_pre_filter", True))
+        forced_bounds = _load_forced_bins(p, self.num_total_features)
+        total_sample_cnt = len(sample_idx)
+        sample_nonzero = {}               # used-feature pos -> bool [S]
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            col = _get_col(raw, sp, f, sample_idx)
+            # keep NaN and non-zero samples; zeros are implicit
+            keep = np.isnan(col) | (np.abs(col) > 1e-35)
+            vals = col[keep]
+            m = BinMapper()
+            btype = (BinType.CATEGORICAL if f in categorical
+                     else BinType.NUMERICAL)
+            m.find_bin(
+                vals, total_sample_cnt, max_bin,
+                min_data_in_bin=min_data_in_bin,
+                min_split_data=min_data_in_leaf,
+                pre_filter=pre_filter,
+                bin_type=btype,
+                use_missing=use_missing,
+                zero_as_missing=zero_as_missing,
+                forced_upper_bounds=forced_bounds.get(f, ()),
+            )
+            self.bin_mappers.append(m)
+        self.used_features = [f for f, m in enumerate(self.bin_mappers)
+                              if not m.is_trivial]
+        # EFB grouping from the sample (reference: FindGroups /
+        # FastFeatureBundling, dataset.cpp:97-313)
+        for j, f in enumerate(self.used_features):
+            col = _get_col(raw, sp, f, sample_idx)
+            # NaN counts as non-default: a NaN row occupies the
+            # feature's NaN bin in the merged column, so it can
+            # conflict with other bundle members (reference counts
+            # sampled NaN values as non-zero entries)
+            sample_nonzero[j] = np.isnan(col) | (np.abs(col) > 1e-35)
+        self._build_groups(sample_nonzero, total_sample_cnt)
+
+    def _bin_block(self, raw, sp, out: np.ndarray) -> None:
+        """Bin a block of raw rows into ``out`` (a [rows, G] uint view)."""
+        dtype = out.dtype
+        for j, f in enumerate(self.used_features):
+            col = _get_col(raw, sp, f, None)
+            bins = self.bin_mappers[f].value_to_bin(col)
+            g, start = int(self.feat_group[j]), int(self.feat_start[j])
+            if start == 1 and self._group_size[g] == 1:
+                out[:, g] = bins.astype(dtype)
+            else:
+                nz = bins != 0       # bundled features are zero-default
+                out[nz, g] = (start + bins[nz] - 1).astype(dtype)
+
+    # -- streaming construction (reference: LGBM_DatasetCreateFromSampledColumn
+    #    + LGBM_DatasetPushRows / PushRowsByCSR, c_api.h:98-144) -------------
+
+    @classmethod
+    def from_sample(cls, sample, num_total_rows: int, params=None,
+                    feature_name="auto", categorical_feature="auto"):
+        """Create a streaming Dataset: bin boundaries + EFB layout from a
+        row sample, the binned matrix preallocated for ``num_total_rows``;
+        fill it with ``push_rows`` (rows never all resident as floats).
+
+        reference: LGBM_DatasetCreateFromSampledColumn (c_api.cpp) decides
+        bins from sampled columns, then LGBM_DatasetPushRows streams row
+        blocks in; the load auto-finishes when every row has been pushed.
+        """
+        ds = cls(sample, params=params, feature_name=feature_name,
+                 categorical_feature=categorical_feature)
+        sample = _as_2d(sample)
+        ds.num_data = int(num_total_rows)
+        ds.num_total_features = sample.shape[1]
+        if ds._feature_name_param == "auto" or ds._feature_name_param is None:
+            ds.feature_names = [f"Column_{i}"
+                                for i in range(ds.num_total_features)]
+        else:
+            ds.feature_names = list(ds._feature_name_param)
+        categorical = ds._resolve_categorical()
+        ds._fit_bin_mappers(sample, None, np.arange(sample.shape[0]),
+                            categorical)
+        G = ds.num_groups
+        dtype = np.uint8 if ds.max_group_bin <= 256 else np.uint16
+        ds.binned = np.zeros((ds.num_data, G), dtype=dtype)
+        ds.raw_data = None
+        ds._pushed = np.zeros(ds.num_data, bool)   # per-row coverage
+        ds._streaming = True
+        ds._append_cursor = 0
+        return ds
+
+    def push_rows(self, chunk, start_row: Optional[int] = None) -> "Dataset":
+        """Bin a block of raw rows into [start_row, start_row+len) of the
+        preallocated matrix (reference: LGBM_DatasetPushRows, c_api.h:98).
+        ``start_row=None`` appends after the previous push.  The dataset
+        marks itself constructed when every row has been pushed."""
+        if not getattr(self, "_streaming", False):
+            raise RuntimeError(
+                "push_rows requires a Dataset created by from_sample")
+        if self.constructed:
+            raise RuntimeError("dataset load already finished")
+        if _is_sparse(chunk):
+            sp, raw = chunk.tocsc(), None
+            rows = sp.shape[0]
+        else:
+            raw = _as_2d(chunk)
+            sp = None
+            rows = raw.shape[0]
+        if start_row is None:
+            start_row = self._append_cursor
+        if start_row + rows > self.num_data:
+            raise ValueError(
+                f"push past the end: {start_row}+{rows} > {self.num_data}")
+        self._bin_block(raw, sp, self.binned[start_row:start_row + rows])
+        # per-ROW coverage (not a count): overlapping pushes — e.g. a retry
+        # of a failed chunk — must not mark unpushed rows as loaded
+        self._pushed[start_row:start_row + rows] = True
+        self._append_cursor = max(self._append_cursor, start_row + rows)
+        if self._pushed.all():                   # auto-finish like the C API
+            self.metadata.check(self.num_data)
+            if self.metadata.label is None:
+                self.metadata.label = np.zeros(self.num_data, np.float32)
+            self.constructed = True
         return self
 
     def _build_groups(self, sample_nonzero: dict, total_sample_cnt: int) -> None:

@@ -97,6 +97,89 @@ def histogram_matmul_f32(
     return acc.reshape(3, F, B).transpose(1, 2, 0)
 
 
+def histogram_pallas(
+    binned: jax.Array,   # [n, F] uint8/uint16
+    vals: jax.Array,     # [n, 3] f32 rows already masked: (g, h, 1)*mask
+    num_bins: int,
+    block_rows: int = 512,
+    feat_tile: int = 8,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Histogram via a Pallas VPU kernel accumulating in VMEM.
+
+    Why not the MXU: the one-hot matmul formulation has M=3 output rows
+    (grad/hess/count), so the 128x128 systolic array runs at <3% utilization
+    AND materializes a [rows, F*B] one-hot intermediate in HBM.  This kernel
+    instead streams `binned` once (transposed, [F, n]) and does the
+    compare-select-accumulate on the VPU with the [F, B, 3] accumulator
+    resident in VMEM across row blocks — HBM traffic is exactly one read of
+    the binned matrix + the vals vector per pass, the memory-optimal floor.
+
+    reference analogue: dense_bin.hpp:97 ConstructHistogramInner (CPU
+    scatter) / ocl/histogram256.cl:317 (GPU atomic scatter); this is the
+    TPU-shaped third answer.  Grid = (feature tiles, row blocks); the row
+    axis iterates fastest so each feature tile's accumulator initializes
+    once (@pl.when i==0) and revisits its output block across row blocks.
+    """
+    from jax.experimental import pallas as pl
+
+    n, F = binned.shape
+    B = num_bins
+    C = block_rows
+    Ft = min(feat_tile, F)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    n_pad = _pad_rows(n, C)
+    F_pad = _pad_rows(F, Ft)
+    bt = binned.T                                       # [F, n], uint8/16 —
+    # widened to i32 PER BLOCK inside the kernel so the HBM copy stays at
+    # the narrow dtype (a .astype here would materialize a 4x intermediate)
+    if n_pad != n or F_pad != F:
+        # padded features get bin 0 with weight 0 (vals rows padded to 0)
+        bt = jnp.pad(bt, ((0, F_pad - F), (0, n_pad - n)))
+    vt = vals.astype(jnp.float32).T                     # [3, n]
+    if n_pad != n:
+        vt = jnp.pad(vt, ((0, 0), (0, n_pad - n)))
+
+    nb = n_pad // C
+    nf = F_pad // Ft
+
+    def kernel(b_ref, v_ref, out_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        blk = b_ref[...].astype(jnp.int32)              # [Ft, C]
+        g = v_ref[0, :]                                 # [C]
+        h = v_ref[1, :]
+        w = v_ref[2, :]
+        iota = lax.broadcasted_iota(jnp.int32, (B, C), 0)
+        for f in range(Ft):                             # static unroll
+            oh = blk[f, :][None, :] == iota             # [B, C]
+            out_ref[f, 0, :] += jnp.sum(
+                jnp.where(oh, g[None, :], 0.0), axis=1)
+            out_ref[f, 1, :] += jnp.sum(
+                jnp.where(oh, h[None, :], 0.0), axis=1)
+            out_ref[f, 2, :] += jnp.sum(
+                jnp.where(oh, w[None, :], 0.0), axis=1)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nf, nb),
+        in_specs=[
+            pl.BlockSpec((Ft, C), lambda j, i: (j, i)),
+            pl.BlockSpec((3, C), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((Ft, 3, B), lambda j, i: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F_pad, 3, B), jnp.float32),
+        interpret=interpret,
+    )(bt, vt)
+    return out[:F].transpose(0, 2, 1)                   # [F, B, 3]
+
+
 def histogram_scatter(
     binned: jax.Array, vals: jax.Array, num_bins: int,
 ) -> jax.Array:
@@ -136,7 +219,64 @@ def build_histogram(
         return histogram_matmul_f32(binned, vals, num_bins, block_rows)
     if method == "scatter":
         return histogram_scatter(binned, vals, num_bins)
+    if method == "pallas":
+        return histogram_pallas(binned, vals, num_bins)
     raise ValueError(f"unknown histogram method {method!r}")
+
+
+_probe_cache: dict = {}
+
+
+def measured_best_method(n: int, num_features: int, num_bins: int,
+                         candidates=("matmul", "scatter", "pallas"),
+                         reps: int = 2) -> str:
+    """Pick the histogram kernel by TIMING it on the live backend.
+
+    reference: Dataset::GetShareStates times col-wise vs row-wise histogram
+    construction at startup and keeps the winner (src/io/dataset.cpp:589-684)
+    — the same idea applied to this module's kernel variants.  The probe
+    runs once per (backend, F, B, n-bucket) per process (~seconds) on
+    synthetic data of the training shape; CPU skips straight to "scatter"
+    (measured fastest there every round, BENCH_r0*.json).
+    """
+    import time
+
+    backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        return "scatter"
+    n_probe = int(min(n, 1_000_000))
+    key = (backend, num_features, num_bins, n_probe)
+    if key in _probe_cache:
+        return _probe_cache[key]
+    import numpy as np
+    rng = np.random.RandomState(0)
+    host_dtype = np.uint8 if num_bins <= 256 else np.uint16
+    binned = jnp.asarray(rng.randint(0, max(num_bins - 1, 1),
+                                     (n_probe, num_features),
+                                     dtype=host_dtype))
+    grad = jnp.asarray(rng.randn(n_probe), jnp.float32)
+    hess = jnp.abs(grad) + 0.1
+    mask = jnp.ones((n_probe,), jnp.float32)
+    timings = {}
+    for method in candidates:
+        fn = jax.jit(functools.partial(build_histogram, num_bins=num_bins,
+                                       method=method))
+        try:
+            fn(binned, grad, hess, mask).block_until_ready()   # compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn(binned, grad, hess, mask).block_until_ready()
+            timings[method] = (time.perf_counter() - t0) / reps
+        except Exception:       # a variant may not lower on this backend
+            continue
+    winner = min(timings, key=timings.get) if timings else "matmul"
+    from ..utils.log import log_info
+    log_info("histogram kernel probe "
+             f"({n_probe}x{num_features}, B={num_bins}): "
+             + ", ".join(f"{k}={v * 1e3:.1f}ms" for k, v in timings.items())
+             + f" -> {winner}")
+    _probe_cache[key] = winner
+    return winner
 
 
 def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS) -> list:

@@ -1,0 +1,115 @@
+"""Hierarchical wall-clock timing.
+
+reference: Common::Timer + RAII FunctionTimer (include/LightGBM/utils/
+common.h:1026-1110), compile-time gated by -DUSE_TIMETAG and dumped at exit
+through the single ``global_timer`` (application.cpp:30, tags through the
+hot paths e.g. serial_tree_learner.cpp:150,232,262,322; gbdt.cpp:153,211).
+
+Here the gate is runtime: set ``LIGHTGBM_TPU_TIMETAG=1`` in the environment
+(or call ``global_timer.enable()``) and every tagged section accumulates
+(count, total seconds) under its name; the table prints at interpreter exit
+sorted by total time, like Timer::Print.  Disabled, a tagged section costs
+one attribute check.
+
+Because device work is asynchronous under jit, host-side sections measure
+dispatch + the points where the host blocks (fetching tree arrays, metric
+values) — the same wall-clock decomposition the reference reports, with
+"device program" time showing up in the section that first blocks on it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Timer:
+    """Accumulating named wall-clock sections (thread-safe)."""
+
+    def __init__(self, enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") == "1"
+        self.enabled = enabled
+        self._acc: dict = {}          # name -> [count, total_seconds]
+        self._lock = threading.Lock()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._acc.clear()
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            slot = self._acc.setdefault(name, [0, 0.0])
+            slot[0] += 1
+            slot[1] += seconds
+
+    @contextmanager
+    def section(self, name: str):
+        """``with global_timer.section("GBDT::TrainOneIter"): ...``
+        (reference: FunctionTimer RAII guard, common.h:1091-1110)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def items(self):
+        with self._lock:
+            return {k: tuple(v) for k, v in self._acc.items()}
+
+    def print(self, file=None) -> None:
+        """reference: Timer::Print (common.h:1054-1070)."""
+        if file is None:
+            file = sys.stderr
+        rows = sorted(self.items().items(), key=lambda kv: -kv[1][1])
+        if not rows:
+            return
+        width = max(len(k) for k, _ in rows)
+        print("LightGBM-TPU timers (name, calls, total s, mean ms):",
+              file=file)
+        for name, (cnt, total) in rows:
+            print(f"  {name:<{width}}  {cnt:>8}  {total:>10.3f}  "
+                  f"{total / cnt * 1e3:>10.3f}", file=file)
+
+
+global_timer = Timer()
+
+
+def function_timer(name: str, timer: Timer = global_timer):
+    """Decorator form (reference FunctionTimer wraps whole functions)."""
+
+    def wrap(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if not timer.enabled:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                timer.add(name, time.perf_counter() - t0)
+
+        return inner
+
+    return wrap
+
+
+@atexit.register
+def _print_at_exit() -> None:
+    if global_timer.enabled:
+        global_timer.print()

@@ -22,7 +22,8 @@ def brute_force(binned, grad, hess, mask, B):
     return out
 
 
-@pytest.mark.parametrize("method", ["scatter", "matmul", "matmul_f32"])
+@pytest.mark.parametrize("method", ["scatter", "matmul", "matmul_f32",
+                                    "pallas"])
 def test_histogram_matches_brute_force(method):
     rng = np.random.RandomState(0)
     n, F, B = 500, 7, 16
@@ -109,3 +110,28 @@ def test_compacted_histogram_matches_masked():
                                    caps, method="scatter")
         np.testing.assert_allclose(np.asarray(comp), np.asarray(full),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_matches_scatter_uneven_shapes():
+    """Pallas VPU kernel padding paths: rows not a multiple of the block,
+    features not a multiple of the tile, odd bin count."""
+    rng = np.random.RandomState(3)
+    n, F, B = 2579, 5, 17
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = rng.rand(n).astype(np.float32)
+    mask = (rng.rand(n) < 0.6).astype(np.float32)
+    ref = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                                     jnp.asarray(hess), jnp.asarray(mask),
+                                     B, method="scatter"))
+    got = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+                                     jnp.asarray(hess), jnp.asarray(mask),
+                                     B, method="pallas"))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_measured_best_method_cpu_short_circuits():
+    """On CPU the timed probe must not run (scatter is the measured winner
+    every round); on accelerators it times the variants and caches."""
+    from lightgbm_tpu.ops.histogram import measured_best_method
+    assert measured_best_method(10_000, 8, 64) == "scatter"

@@ -1,0 +1,62 @@
+"""Timer/tracing subsystem (reference: Common::Timer + FunctionTimer,
+include/LightGBM/utils/common.h:1026-1110, -DUSE_TIMETAG)."""
+import io
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.timer import Timer, function_timer, global_timer
+
+
+def test_timer_accumulates_and_prints():
+    t = Timer(enabled=True)
+    with t.section("a"):
+        pass
+    with t.section("a"):
+        pass
+    with t.section("b"):
+        pass
+    items = t.items()
+    assert items["a"][0] == 2 and items["b"][0] == 1
+    buf = io.StringIO()
+    t.print(file=buf)
+    out = buf.getvalue()
+    assert "a" in out and "b" in out and "calls" in out
+
+    @function_timer("fn", timer=t)
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert t.items()["fn"][0] == 1
+
+
+def test_timer_disabled_is_noop():
+    t = Timer(enabled=False)
+    with t.section("x"):
+        pass
+    assert t.items() == {}
+
+
+def test_training_tags_hot_paths():
+    """The tagged sections mirror the reference's global_timer tags
+    (gbdt.cpp:153,211; serial_tree_learner.cpp:150)."""
+    global_timer.reset()
+    global_timer.enable()
+    try:
+        rng = np.random.RandomState(0)
+        X = rng.rand(500, 4)
+        y = (X[:, 0] > 0.5).astype(np.float32)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        bst.predict(X[:10])
+        items = global_timer.items()
+        for key in ("Dataset::Construct", "GBDT::TrainOneIter",
+                    "TreeLearner::Train(dispatch)",
+                    "GBDT::FinishIter(host trees)", "Booster::Predict"):
+            assert key in items, (key, sorted(items))
+        assert items["GBDT::TrainOneIter"][0] == 3
+    finally:
+        global_timer.disable()
+        global_timer.reset()
