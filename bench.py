@@ -283,8 +283,8 @@ def tpu_worker():
     if a later stage wedges or the process dies.  Exit codes: 0 full run
     done, 3 backend init failed, 4 init ok but a later stage failed.
     """
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                          os.path.join(REPO, ".jax_cache"))
+    from lightgbm_tpu.utils.platform import _cache_dir
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
     t0 = time.time()
     try:
         import jax
@@ -369,8 +369,8 @@ class LineReader(threading.Thread):
 def launch_tpu_worker(env_variant):
     env = dict(os.environ)
     env["BENCH_STAGE"] = "tpu-worker"
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
+    from lightgbm_tpu.utils.platform import _cache_dir
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
     if env_variant == "no-remote-compile":
         env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],

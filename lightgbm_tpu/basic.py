@@ -44,7 +44,8 @@ class Booster:
         if train_set is not None:
             self._init_train(train_set)
         elif model_file is not None:
-            with open(model_file) as fh:
+            from .utils.file_io import open_file
+            with open_file(model_file) as fh:
                 self._init_from_string(fh.read())
         elif model_str is not None:
             self._init_from_string(model_str)
@@ -492,7 +493,10 @@ class Booster:
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as fh:
+        # routed through the pluggable file-system seam (reference:
+        # VirtualFileWriter, src/io/file_io.cpp)
+        from .utils.file_io import open_file
+        with open_file(filename, "w") as fh:
             fh.write(self.model_to_string(num_iteration, start_iteration))
         return self
 

@@ -1,0 +1,336 @@
+"""C-API-shaped stable entry points for external runtimes.
+
+reference: include/LightGBM/c_api.h (~70 ``LGBM_*`` functions wrapped by
+ctypes/R/SWIG).  The reference's stable ABI exists so non-Python runtimes
+can drive the library; the TPU build's compute lives behind JAX, so the
+equivalent seam is a FLAT, STABLE, ctypes-convention Python module: every
+function is named after its c_api.h counterpart, returns 0 on success and
+-1 on failure, reports through ``LGBM_GetLastError``, and passes handles +
+out-parameters instead of objects — exactly the calling convention an
+embedding runtime (JNI/pyo3/R's reticulate) binds against.
+
+Covered surface (the subset every reference binding actually uses):
+dataset create (mat/file/sample+push), field set/get, booster create/train/
+predict/save/load, eval, model introspection.  Streaming push mirrors
+c_api.h:98-144.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_last_error = threading.local()
+
+
+def _set_error(msg: str) -> int:
+    _last_error.msg = str(msg)
+    return -1
+
+
+def LGBM_GetLastError() -> str:
+    """reference: c_api.h LGBM_GetLastError."""
+    return getattr(_last_error, "msg", "")
+
+
+def _guard(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def inner(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:   # noqa: BLE001 - ABI boundary
+            return _set_error(f"{type(e).__name__}: {e}")
+
+    return inner
+
+
+_handles: Dict[int, object] = {}
+_next_handle = [1]
+_lock = threading.Lock()
+
+
+def _register(obj) -> int:
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def _get(handle: int):
+    try:
+        return _handles[handle]
+    except KeyError:
+        raise ValueError(f"invalid handle {handle}") from None
+
+
+def _parse_params(parameters: str) -> dict:
+    """reference: Config::Str2Map (config.h:81) — 'k=v k2=v2' strings,
+    with value typing ('false' must parse as False, not a truthy str)."""
+    out = {}
+    for tok in str(parameters or "").replace("\n", " ").split():
+        if "=" not in tok:
+            continue
+        k, v = tok.split("=", 1)
+        low = v.strip().lower()
+        if low in ("true", "false"):
+            out[k] = low == "true"
+            continue
+        try:
+            out[k] = int(v)
+            continue
+        except ValueError:
+            pass
+        try:
+            out[k] = float(v)
+            continue
+        except ValueError:
+            pass
+        out[k] = v
+    return out
+
+
+# ------------------------------------------------------------------ dataset
+
+@_guard
+def LGBM_DatasetCreateFromMat(data, parameters: str, label,
+                              out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_DatasetCreateFromMat."""
+    from .dataset import Dataset
+    ds = Dataset(np.asarray(data), label=label,
+                 params=_parse_params(parameters))
+    out_handle[:] = [_register(ds)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetCreateFromFile(filename: str, parameters: str,
+                               reference_handle: Optional[int],
+                               out_handle: List[int]) -> int:
+    from .dataset import Dataset
+    ref = _get(reference_handle) if reference_handle else None
+    ds = Dataset(str(filename), params=_parse_params(parameters),
+                 reference=ref)
+    out_handle[:] = [_register(ds)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetCreateFromSampledColumn(sample_data, num_total_row: int,
+                                        parameters: str,
+                                        out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_DatasetCreateFromSampledColumn — start a
+    streaming load; push blocks with LGBM_DatasetPushRows."""
+    from .dataset import Dataset
+    ds = Dataset.from_sample(np.asarray(sample_data), int(num_total_row),
+                             params=_parse_params(parameters))
+    out_handle[:] = [_register(ds)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetPushRows(dataset_handle: int, data,
+                         start_row: int) -> int:
+    """reference: c_api.h:98 LGBM_DatasetPushRows."""
+    _get(dataset_handle).push_rows(data, start_row=int(start_row))
+    return 0
+
+
+@_guard
+def LGBM_DatasetSetField(dataset_handle: int, field_name: str,
+                         field_data) -> int:
+    """reference: c_api.h LGBM_DatasetSetField (label/weight/group/
+    init_score)."""
+    ds = _get(dataset_handle)
+    field = str(field_name)
+    if field == "label":
+        ds.set_label(field_data)
+    elif field == "weight":
+        ds.set_weight(field_data)
+    elif field in ("group", "query"):
+        ds.set_group(field_data)
+    elif field == "init_score":
+        ds.set_init_score(field_data)
+    else:
+        raise ValueError(f"unknown field {field!r}")
+    return 0
+
+
+@_guard
+def LGBM_DatasetGetNumData(dataset_handle: int, out: List[int]) -> int:
+    ds = _get(dataset_handle)
+    ds.construct()
+    out[:] = [ds.num_data]
+    return 0
+
+
+@_guard
+def LGBM_DatasetGetNumFeature(dataset_handle: int, out: List[int]) -> int:
+    ds = _get(dataset_handle)
+    ds.construct()
+    out[:] = [len(ds.used_features)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetSaveBinary(dataset_handle: int, filename: str) -> int:
+    _get(dataset_handle).construct().save_binary(str(filename))
+    return 0
+
+
+@_guard
+def LGBM_DatasetFree(dataset_handle: int) -> int:
+    with _lock:
+        _handles.pop(dataset_handle, None)
+    return 0
+
+
+# ------------------------------------------------------------------ booster
+
+@_guard
+def LGBM_BoosterCreate(train_data_handle: int, parameters: str,
+                       out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_BoosterCreate."""
+    from .basic import Booster
+    bst = Booster(params=_parse_params(parameters),
+                  train_set=_get(train_data_handle))
+    out_handle[:] = [_register(bst)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterCreateFromModelfile(filename: str, out_num_iterations: List[int],
+                                    out_handle: List[int]) -> int:
+    from .basic import Booster
+    bst = Booster(model_file=str(filename))
+    out_num_iterations[:] = [bst.current_iteration()]
+    out_handle[:] = [_register(bst)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterLoadModelFromString(model_str: str,
+                                    out_num_iterations: List[int],
+                                    out_handle: List[int]) -> int:
+    from .basic import Booster
+    bst = Booster(model_str=str(model_str))
+    out_num_iterations[:] = [bst.current_iteration()]
+    out_handle[:] = [_register(bst)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterAddValidData(booster_handle: int,
+                             valid_data_handle: int) -> int:
+    bst = _get(booster_handle)
+    bst.add_valid(_get(valid_data_handle),
+                  f"valid_{len(bst.name_valid_sets)}")
+    return 0
+
+
+@_guard
+def LGBM_BoosterUpdateOneIter(booster_handle: int,
+                              out_is_finished: List[int]) -> int:
+    """reference: c_api.h LGBM_BoosterUpdateOneIter."""
+    stopped = _get(booster_handle).update()
+    out_is_finished[:] = [1 if stopped else 0]
+    return 0
+
+
+@_guard
+def LGBM_BoosterUpdateOneIterCustom(booster_handle: int, grad, hess,
+                                    out_is_finished: List[int]) -> int:
+    """reference: c_api.h:507 custom-objective update."""
+    bst = _get(booster_handle)
+    stopped = bst.boosting.train_one_iter(np.asarray(grad, np.float32),
+                                          np.asarray(hess, np.float32))
+    out_is_finished[:] = [1 if stopped else 0]
+    return 0
+
+
+@_guard
+def LGBM_BoosterRollbackOneIter(booster_handle: int) -> int:
+    _get(booster_handle).rollback_one_iter()
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetEval(booster_handle: int, data_idx: int,
+                        out_results: List[float]) -> int:
+    """reference: c_api.h LGBM_BoosterGetEval — data_idx 0 is the train
+    set, i >= 1 the (i-1)-th validation set."""
+    bst = _get(booster_handle)
+    if data_idx == 0:
+        res = bst.boosting.eval_train()
+    else:
+        name = bst.boosting.valid_names[data_idx - 1]
+        res = [r for r in bst.boosting.eval_valid() if r[0] == name]
+    out_results[:] = [float(v) for (_, _, v, _) in res]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetNumClasses(booster_handle: int, out: List[int]) -> int:
+    out[:] = [_get(booster_handle).num_class]
+    return 0
+
+
+@_guard
+def LGBM_BoosterNumberOfTotalModel(booster_handle: int,
+                                   out: List[int]) -> int:
+    out[:] = [_get(booster_handle).num_trees()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetCurrentIteration(booster_handle: int,
+                                    out: List[int]) -> int:
+    out[:] = [_get(booster_handle).current_iteration()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterPredictForMat(booster_handle: int, data, predict_type: int,
+                              num_iteration: int,
+                              out_result: List[np.ndarray]) -> int:
+    """reference: c_api.h:822; predict_type 0=normal 1=raw 2=leaf 3=contrib
+    (C_API_PREDICT_* constants)."""
+    bst = _get(booster_handle)
+    kwargs = {}
+    if predict_type == 1:
+        kwargs["raw_score"] = True
+    elif predict_type == 2:
+        kwargs["pred_leaf"] = True
+    elif predict_type == 3:
+        kwargs["pred_contrib"] = True
+    ni = None if num_iteration <= 0 else int(num_iteration)
+    out_result[:] = [bst.predict(np.asarray(data), num_iteration=ni,
+                                 **kwargs)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterSaveModel(booster_handle: int, start_iteration: int,
+                          num_iteration: int, filename: str) -> int:
+    ni = None if num_iteration <= 0 else int(num_iteration)
+    _get(booster_handle).save_model(str(filename), num_iteration=ni,
+                                    start_iteration=int(start_iteration))
+    return 0
+
+
+@_guard
+def LGBM_BoosterSaveModelToString(booster_handle: int,
+                                  out_str: List[str]) -> int:
+    out_str[:] = [_get(booster_handle).model_to_string()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterFree(booster_handle: int) -> int:
+    with _lock:
+        _handles.pop(booster_handle, None)
+    return 0

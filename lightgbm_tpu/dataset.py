@@ -148,6 +148,13 @@ class Dataset:
             raise RuntimeError("cannot construct Dataset: raw data was freed")
         data = self.raw_data
         if isinstance(data, (str, os.PathLike)):
+            from .io_utils import _param_bool
+            if _param_bool(self.params, "two_round"):
+                # two-pass streamed load: never holds the full float matrix
+                # (reference: two_round config, dataset_loader.cpp:775,1101)
+                from .io_utils import load_text_dataset_two_round
+                load_text_dataset_two_round(str(data), self)
+                return self
             from .io_utils import load_text_dataset
             data = load_text_dataset(str(data), self)
         if _is_sparse(data):
@@ -545,7 +552,8 @@ class Dataset:
             "has_group": self.metadata.query_boundaries is not None,
             "has_init_score": self.metadata.init_score is not None,
         }
-        with open(filename, "wb") as fh:
+        from .utils.file_io import open_file
+        with open_file(filename, "wb") as fh:
             fh.write(_BINARY_MAGIC)
             hdr = json.dumps(meta).encode()
             fh.write(len(hdr).to_bytes(8, "little"))
@@ -559,7 +567,8 @@ class Dataset:
 
     @staticmethod
     def load_binary(filename: str, params: Optional[dict] = None) -> "Dataset":
-        with open(filename, "rb") as fh:
+        from .utils.file_io import open_file
+        with open_file(filename, "rb") as fh:
             magic = fh.read(len(_BINARY_MAGIC))
             if magic != _BINARY_MAGIC:
                 raise ValueError(f"{filename} is not a lightgbm_tpu binary dataset")

@@ -15,8 +15,9 @@ import numpy as np
 
 def detect_format(path: str, num_probe_lines: int = 32) -> Tuple[str, bool]:
     """Return (format, has_header); format in {'csv', 'tsv', 'libsvm'}."""
+    from .utils.file_io import open_file
     lines = []
-    with open(path, "r") as fh:
+    with open_file(path, "r") as fh:
         for _ in range(num_probe_lines):
             ln = fh.readline()
             if not ln:
@@ -95,10 +96,11 @@ def load_text_dataset(path: str, dataset) -> np.ndarray:
 
 
 def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    from .utils.file_io import open_file
     labels = []
     rows = []
     max_feat = -1
-    with open(path) as fh:
+    with open_file(path) as fh:
         for ln in fh:
             ln = ln.strip()
             if not ln:
@@ -131,3 +133,166 @@ def _resolve_column(spec, names, default=None):
             return names.index(nm)
         raise ValueError(f"unknown column {nm!r}")
     return int(s)
+
+
+def _param_bool(params: dict, key: str, default: bool = False) -> bool:
+    """Tolerant bool param: accepts real bools and 'true'/'false' strings
+    (the C-API passes k=v strings, reference Config::Str2Map semantics)."""
+    v = params.get(key, default)
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "no", "")
+    return bool(v)
+
+
+def load_text_dataset_two_round(path: str, dataset,
+                                chunk_rows: int = 200_000) -> None:
+    """Two-pass big-file loading: no full in-memory feature matrix.
+
+    reference: ``two_round`` config (config.h:570-574) switches
+    DatasetLoader to SampleTextDataFromFile (pass 1: row count + uniform
+    sample) followed by ExtractFeaturesFromFile (pass 2: push rows through
+    the decided bins), dataset_loader.cpp:775,1101.  Here pass 1 streams
+    pandas chunks collecting labels + a vectorized reservoir sample; pass 2
+    re-reads chunks and bins them into the preallocated matrix via
+    ``_bin_block``.  Validation sets (``reference=``) reuse the reference
+    dataset's mappers and EFB layout and skip the sampling entirely
+    (LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:229).
+    CSV/TSV only — LibSVM files take the one-shot path (they parse sparse
+    and small).  Fills ``dataset`` in place and marks it constructed.
+    All reads go through the pluggable file seam (utils/file_io.py).
+    """
+    import pandas as pd
+
+    from .utils.file_io import exists as fs_exists, open_file
+
+    params = dataset.params
+    fmt, has_header = detect_format(path)
+    header_override = params.get("header", None)
+    if header_override is not None:
+        has_header = _param_bool(params, "header")
+    if fmt == "libsvm":
+        data = load_text_dataset(path, dataset)
+        dataset.raw_data = data
+        dataset._construct_inner()
+        return
+
+    sep = "\t" if fmt == "tsv" else ","
+    sample_cnt = int(params.get("bin_construct_sample_cnt", 200000))
+    seed = int(params.get("data_random_seed", 1))
+    rng = np.random.RandomState(seed)
+    use_reference = dataset.reference is not None
+
+    def chunks():
+        with open_file(path, "r") as fh:
+            for chunk in pd.read_csv(fh, sep=sep,
+                                     header=0 if has_header else None,
+                                     na_values=["nan", "NA", "na", ""],
+                                     chunksize=chunk_rows):
+                yield chunk
+
+    # ---- pass 1: row count, labels, reservoir sample -----------------------
+    names = None
+    labels = []
+    reservoir = None          # [sample_cnt, F] float64
+    n_seen = 0
+    label_idx = None
+    keep = None
+    for chunk in chunks():
+        if names is None and has_header:
+            names = [str(c) for c in chunk.columns]
+        mat = chunk.to_numpy(dtype=np.float64)
+        if label_idx is None:
+            label_spec = params.get("label_column", params.get("label", 0))
+            label_idx = _resolve_column(label_spec, names, default=0)
+            keep = [i for i in range(mat.shape[1]) if i != label_idx]
+            ignore = params.get("ignore_column",
+                                params.get("ignore_feature"))
+            if ignore:
+                ignored = {_resolve_column(c, names)
+                           for c in str(ignore).split(",")}
+                keep = [i for i in keep if i not in ignored]
+            fn_param = dataset._feature_name_param
+            if fn_param not in ("auto", None):
+                dataset.feature_names = list(fn_param)
+            elif names:
+                dataset.feature_names = [names[i] for i in keep]
+        if label_idx is not None:
+            labels.append(mat[:, label_idx].astype(np.float32))
+        feats = mat[:, keep]
+        if not use_reference:
+            if reservoir is None:
+                reservoir = np.empty((sample_cnt, feats.shape[1]),
+                                     np.float64)
+            k = len(feats)
+            if n_seen < sample_cnt:
+                take = min(sample_cnt - n_seen, k)
+                reservoir[n_seen:n_seen + take] = feats[:take]
+                rest = np.arange(take, k)
+            else:
+                rest = np.arange(k)
+            if len(rest):
+                # vectorized reservoir acceptance (Vitter's R): row j_global
+                # replaces a random slot with prob sample_cnt/(j_global+1)
+                j = n_seen + rest
+                r = (rng.random_sample(len(rest)) * (j + 1)).astype(np.int64)
+                acc = r < sample_cnt
+                reservoir[r[acc]] = feats[rest[acc]]
+        n_seen += len(feats)
+    n = n_seen
+
+    # ---- decide bins + EFB layout ------------------------------------------
+    dataset.num_data = n
+    if use_reference:
+        ref = dataset.reference.construct()
+        dataset.num_total_features = ref.num_total_features
+        dataset.bin_mappers = ref.bin_mappers
+        dataset.used_features = ref.used_features
+        dataset.feature_names = ref.feature_names
+        dataset.feat_group = ref.feat_group
+        dataset.feat_start = ref.feat_start
+        dataset.num_groups = ref.num_groups
+        dataset._group_size = ref._group_size
+        dataset.group_num_bin = ref.group_num_bin
+        dataset.max_group_bin = ref.max_group_bin
+    else:
+        sample = reservoir[:min(sample_cnt, n)]
+        dataset.num_total_features = sample.shape[1]
+        if not dataset.feature_names:
+            dataset.feature_names = [
+                f"Column_{i}" for i in range(dataset.num_total_features)]
+        categorical = dataset._resolve_categorical()
+        dataset._fit_bin_mappers(sample, None, np.arange(len(sample)),
+                                 categorical)
+    dtype = np.uint8 if dataset.max_group_bin <= 256 else np.uint16
+    dataset.binned = np.zeros((n, dataset.num_groups), dtype=dtype)
+
+    # ---- pass 2: bin the rows chunk by chunk -------------------------------
+    lo = 0
+    for chunk in chunks():
+        mat = chunk.to_numpy(dtype=np.float64)
+        feats = mat[:, keep]
+        dataset._bin_block(feats, None, dataset.binned[lo:lo + len(feats)])
+        lo += len(feats)
+    assert lo == n, (lo, n)
+
+    if labels and dataset.metadata.label is None:
+        dataset.metadata.label = np.concatenate(labels)
+    for suffix, attr in ((".weight", "weight"), (".init", "init_score")):
+        f = path + suffix
+        if fs_exists(f) and getattr(dataset.metadata, attr) is None:
+            with open_file(f) as fh:
+                setattr(dataset.metadata, attr,
+                        np.loadtxt(fh, dtype=np.float64))
+    qfile = path + ".query"
+    if fs_exists(qfile) and dataset.metadata.query_boundaries is None:
+        with open_file(qfile) as fh:
+            dataset.metadata.set_group(
+                np.loadtxt(fh, dtype=np.int64).reshape(-1))
+    if dataset.metadata.weight is not None:
+        dataset.metadata.weight = dataset.metadata.weight.astype(np.float32)
+    dataset.metadata.check(n)
+    if dataset.metadata.label is None:
+        dataset.metadata.label = np.zeros(n, np.float32)
+    dataset.constructed = True
+    if dataset.free_raw_data:
+        dataset.raw_data = None

@@ -1,0 +1,59 @@
+"""Pluggable file IO: scheme-routed open with a registration seam.
+
+reference: VirtualFileReader/VirtualFileWriter (src/io/file_io.cpp) — local
+files by default, an HDFS backend compiled in with USE_HDFS
+(CMakeLists.txt:13).  Here the seam is runtime: ``register_file_system``
+installs an opener for a URL scheme; unregistered ``scheme://`` paths fall
+back to fsspec when installed (which covers hdfs://, gs://, s3://, ...);
+plain paths use the builtin ``open``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_OPENERS: Dict[str, Callable] = {}
+
+
+def register_file_system(scheme: str, opener: Callable) -> None:
+    """Install ``opener(path, mode) -> file-like`` for ``scheme://`` paths
+    (the USE_HDFS build-option analogue, made a runtime registry)."""
+    _OPENERS[scheme] = opener
+
+
+def unregister_file_system(scheme: str) -> None:
+    _OPENERS.pop(scheme, None)
+
+
+def open_file(path, mode: str = "r"):
+    """Open ``path`` through the registered backend for its scheme.
+
+    reference: VirtualFileReader::Make / VirtualFileWriter::Make pick the
+    HDFS reader for ``hdfs://`` prefixes (file_io.cpp).
+    """
+    path = str(path)
+    if "://" in path:
+        scheme = path.split("://", 1)[0]
+        if scheme in _OPENERS:
+            return _OPENERS[scheme](path, mode)
+        try:
+            import fsspec
+            return fsspec.open(path, mode).open()
+        except (ImportError, ValueError) as e:
+            raise OSError(
+                f"no file system registered for {scheme}:// and fsspec "
+                f"cannot handle it ({e}); register_file_system({scheme!r}, "
+                "opener) to add one") from e
+    return open(path, mode)
+
+
+def exists(path) -> bool:
+    path = str(path)
+    if "://" in path:
+        try:
+            with open_file(path, "r"):
+                return True
+        except OSError:
+            return False
+    import os
+    return os.path.exists(path)

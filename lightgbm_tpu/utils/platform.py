@@ -57,8 +57,7 @@ def clean_cpu_env(n_devices: int = 8, base=None) -> dict:
     flags.append(f"--xla_force_host_platform_device_count={n_devices}")
     env["XLA_FLAGS"] = " ".join(flags)
     env.setdefault("JAX_ENABLE_X64", "0")
-    cache = os.path.join(_repo_root(), ".jax_cache")
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
     return env
@@ -67,6 +66,18 @@ def clean_cpu_env(n_devices: int = 8, base=None) -> dict:
 def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+
+def _cache_dir() -> str:
+    """Per-host-CPU XLA compile cache dir.
+
+    XLA:CPU AOT blobs embed the compile machine's ISA features and LOAD
+    even on hosts missing them ("could lead to execution errors such as
+    SIGILL" warning observed when the repo cache moved hosts); keying the
+    directory on the CPU fingerprint makes a foreign cache a miss instead.
+    """
+    from ..native.build import _host_tag
+    return os.path.join(_repo_root(), ".jax_cache", _host_tag())
 
 
 def force_cpu_inprocess(n_devices: int = 8) -> None:
@@ -85,8 +96,7 @@ def force_cpu_inprocess(n_devices: int = 8) -> None:
     flags.append(f"--xla_force_host_platform_device_count={n_devices}")
     os.environ["XLA_FLAGS"] = " ".join(flags)
     os.environ["JAX_PLATFORMS"] = "cpu"
-    cache = os.path.join(_repo_root(), ".jax_cache")
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
     import jax

@@ -1,0 +1,102 @@
+"""C-API-shaped seam (reference: tests/c_api_test/test_.py drives
+lib_lightgbm.so with raw ctypes — same flow here through capi.py)."""
+import numpy as np
+
+from lightgbm_tpu import capi
+
+
+def _data(n=2000, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] + X[:, 1] > 1).astype(np.float32)
+    return X, y
+
+
+def test_full_train_predict_flow(tmp_path):
+    """Mirrors the reference c_api_test: dataset -> booster -> 20 iters ->
+    eval -> save/load -> prediction parity (tests/c_api_test/test_.py:12)."""
+    X, y = _data()
+    dh, vh, bh = [0], [0], [0]
+    assert capi.LGBM_DatasetCreateFromMat(
+        X, "max_bin=63 min_data_in_leaf=10", y, dh) == 0
+    assert capi.LGBM_DatasetCreateFromMat(X, "max_bin=63", y, vh) == 0
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=15 verbosity=-1 metric=auc",
+        bh) == 0
+    assert capi.LGBM_BoosterAddValidData(bh[0], vh[0]) == 0
+    fin = [0]
+    for _ in range(20):
+        assert capi.LGBM_BoosterUpdateOneIter(bh[0], fin) == 0
+        if fin[0]:
+            break
+    out_n = [0]
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh[0], out_n) == 0
+    assert out_n[0] > 0
+    ev = []
+    assert capi.LGBM_BoosterGetEval(bh[0], 1, ev) == 0
+    assert len(ev) == 1 and ev[0] > 0.8          # valid AUC
+
+    pred = [None]
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:100], 0, -1, pred) == 0
+    path = str(tmp_path / "model.txt")
+    assert capi.LGBM_BoosterSaveModel(bh[0], 0, -1, path) == 0
+    nh, it = [0], [0]
+    assert capi.LGBM_BoosterCreateFromModelfile(path, it, nh) == 0
+    pred2 = [None]
+    assert capi.LGBM_BoosterPredictForMat(nh[0], X[:100], 0, -1, pred2) == 0
+    np.testing.assert_allclose(pred[0], pred2[0], rtol=1e-12)
+
+    for h in (dh[0], vh[0]):
+        assert capi.LGBM_DatasetFree(h) == 0
+    for h in (bh[0], nh[0]):
+        assert capi.LGBM_BoosterFree(h) == 0
+
+
+def test_streaming_push_via_capi():
+    """reference: c_api.h:98-144 streaming flow through the seam."""
+    X, y = _data(n=3000)
+    dh, bh = [0], [0]
+    assert capi.LGBM_DatasetCreateFromSampledColumn(
+        X[:1000], len(X), "max_bin=63", dh) == 0
+    assert capi.LGBM_DatasetPushRows(dh[0], X[:1500], 0) == 0
+    assert capi.LGBM_DatasetPushRows(dh[0], X[1500:], 1500) == 0
+    assert capi.LGBM_DatasetSetField(dh[0], "label", y) == 0
+    out = [0]
+    assert capi.LGBM_DatasetGetNumData(dh[0], out) == 0
+    assert out[0] == len(X)
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=7 verbosity=-1", bh) == 0
+    fin = [0]
+    assert capi.LGBM_BoosterUpdateOneIter(bh[0], fin) == 0
+
+
+def test_error_protocol():
+    """Failures return -1 and report through LGBM_GetLastError — never
+    raise across the seam (reference ABI convention, c_api.h:58)."""
+    out = [0]
+    rc = capi.LGBM_DatasetGetNumData(999999, out)
+    assert rc == -1
+    assert "invalid handle" in capi.LGBM_GetLastError()
+    rc = capi.LGBM_DatasetSetField(999999, "label", [1.0])
+    assert rc == -1
+
+
+def test_custom_objective_update():
+    X, y = _data(n=1000)
+    dh, bh = [0], [0]
+    assert capi.LGBM_DatasetCreateFromMat(X, "", y, dh) == 0
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=regression num_leaves=7 verbosity=-1", bh) == 0
+    # plain L2 gradients supplied externally
+    from lightgbm_tpu import capi as c
+    import lightgbm_tpu as lgb
+    bst = c._get(bh[0])
+    score = np.zeros(len(y), np.float32)
+    fin = [0]
+    for _ in range(3):
+        grad = score - y
+        hess = np.ones_like(grad)
+        assert c.LGBM_BoosterUpdateOneIterCustom(bh[0], grad, hess, fin) == 0
+        score = bst.predict(X, raw_score=True).astype(np.float32)
+    mse = float(np.mean((score - y) ** 2))
+    assert mse < float(np.mean((0 - y) ** 2))
