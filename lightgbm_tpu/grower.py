@@ -564,7 +564,9 @@ def grow_tree(
     leaf_min = jnp.full(L, -jnp.inf, jnp.float32)
     leaf_max = jnp.full(L, jnp.inf, jnp.float32)
     root_bounds = (leaf_min[0], leaf_max[0]) if use_mc else None
-    root_key = jax.random.fold_in(rng_key, 0) if use_rng else None
+    # node-identity key (parent -1, side 0) — see apply_split's kl/kr
+    root_key = (jax.random.fold_in(jax.random.fold_in(rng_key, 0), 0)
+                if use_rng else None)
     if cegb_enabled:
         best = _LeafFeatBest.empty(L, F).store(
             jnp.array(0),
@@ -838,9 +840,15 @@ def grow_tree(
         else:
             bounds_l = bounds_r = None
 
-        # -- best splits for the two children
-        kl = jax.random.fold_in(rng_key, 1 + 2 * s) if use_rng else None
-        kr = jax.random.fold_in(rng_key, 2 + 2 * s) if use_rng else None
+        # -- best splits for the two children.  Keys derive from NODE
+        # IDENTITY (parent node, side) — not application order — so the
+        # batched grower (grower_rounds.py) draws identical randomness
+        # per node and the two growers stay structurally identical under
+        # extra_trees / feature_fraction_bynode.
+        kl = jax.random.fold_in(jax.random.fold_in(rng_key, s + 1), 0) \
+            if use_rng else None
+        kr = jax.random.fold_in(jax.random.fold_in(rng_key, s + 1), 1) \
+            if use_rng else None
         if cegb_enabled:
             pfl = leaf_feats(hist_l, lg, lh, lc, new_depth,
                              bounds=bounds_l, key=kl)

@@ -1,0 +1,402 @@
+"""Batched-frontier leaf-wise tree growth (the TPU-fast grower).
+
+Same semantics as :mod:`.grower` (LightGBM best-first growth,
+reference: src/treelearner/serial_tree_learner.cpp:149-193) but the
+``lax.while_loop`` advances a ROUND of splits per iteration instead of one
+split, so a 255-leaf tree takes ~log2(255)+eps iterations instead of 254.
+Rationale: on TPU the dominant cost of the serial grower is not compute but
+the per-iteration execution of a ~1.6k-op loop body (measured: ~6 ms fixed
+per split at 100k-500k rows, ~99% of train time); batching the frontier
+amortizes that body over up to ``budget`` splits.
+
+Exactness.  Best-first growth applies, at every step, the max-gain leaf
+(ties: smallest leaf index — the reference's ArgMax over the leaf array).
+A round here applies the top ``k = min(#positive-gain leaves, leaf budget)``
+candidates in that same (gain desc, leaf asc) order, which is exactly the
+sequence best-first would produce PROVIDED no child created by the round
+outranks the round's weakest applied candidate (a child that outranks it
+would, under best-first, have been split before the weaker candidate —
+potentially consuming budget and changing the applied set).  That proviso
+is checked at runtime AFTER the children's best splits are known: if any
+new child's gain >= min(applied gains), the round is rolled back to a
+single best-first step (the fallback reuses the round's own computation —
+the argmax leaf's partition/histogram/search results are slices of the
+batched ones, because per-leaf candidates are independent of one another).
+Hence trees — including node/leaf numbering — are structurally identical
+to the serial grower's for every gain pattern; adversarial
+(gain-increasing) patterns only lose the batching speedup, not exactness.
+Float fields (histogram sums, gains, leaf values) agree to float32
+accumulation order only: the segment scatter sums bins in a different
+order than the serial kernels — the same class of difference as the
+reference's CPU vs GPU histograms (docs/GPU-Performance.rst accuracy
+tables).  Structure can differ only on exact float ties in gains.
+
+Support matrix: EFB bundles, bagging/GOSS weights, per-tree and per-node
+column sampling, extra_trees, monotone constraints, max_depth, and
+data-parallel row sharding (``axis_name`` -> histogram/scalar psums).
+Voting-parallel, feature-parallel, CEGB and forced splits stay on the
+serial grower (GBDT dispatches automatically; see _build_jit_fns).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dataset import FeatureMeta
+from .grower import GrowerConfig, TreeArrays, _LeafBest, _psum, row_goes_left
+from .ops.histogram import (build_histogram, capacity_schedule,
+                            compacted_segment_histogram)
+from .ops.split import (MAX_CAT_WORDS, SplitResult, best_split_for_leaf,
+                        leaf_output)
+
+
+def _pad_scatter(arr: jax.Array, idx: jax.Array, val: jax.Array,
+                 sel: jax.Array) -> jax.Array:
+    """``arr[idx] = val`` for lanes where ``sel``; others hit a dummy row."""
+    M = arr.shape[0]
+    pad = jnp.zeros((1,) + arr.shape[1:], arr.dtype)
+    ext = jnp.concatenate([arr, pad], axis=0)
+    safe = jnp.where(sel, idx, M)
+    return ext.at[safe].set(val.astype(arr.dtype))[:M]
+
+
+def grow_tree_rounds(
+    binned: jax.Array,          # [n, G] uint8/16 (rows possibly per-shard)
+    grad: jax.Array,            # [n] f32
+    hess: jax.Array,            # [n] f32
+    row_mask: jax.Array,        # [n] f32 bagging/GOSS weights (0 = excluded)
+    meta: FeatureMeta,
+    cfg: GrowerConfig,
+    feature_mask: Optional[jax.Array] = None,   # [F] per-tree col sample
+    axis_name: Optional[str] = None,            # mesh axis sharding ROWS
+    monotone_constraints: Optional[jax.Array] = None,  # [F] i32 in {-1,0,1}
+    rng_key: Optional[jax.Array] = None,
+):
+    """Grow one tree; returns (TreeArrays, leaf_id [n] i32)."""
+    meta = meta.resolved()
+    n, G = binned.shape
+    L = cfg.num_leaves
+    Lm1 = max(L - 1, 1)
+    B = cfg.num_bins
+    Bg = meta.max_group_bin if meta.has_bundles else B
+    hp = cfg.hp
+    F = len(meta.num_bin)
+
+    num_bin = jnp.asarray(meta.num_bin)
+    missing_type = jnp.asarray(meta.missing_type)
+    default_bin = jnp.asarray(meta.default_bin)
+    is_cat = jnp.asarray(meta.is_categorical)
+    feat_group = jnp.asarray(meta.feat_group)
+    feat_start = jnp.asarray(meta.feat_start)
+    has_cat = bool(meta.is_categorical.any())
+
+    hist_fn = functools.partial(build_histogram, num_bins=Bg,
+                                method=cfg.hist_method)
+    caps = capacity_schedule(n) if cfg.compact else [n]
+
+    if meta.has_bundles:
+        b_idx = jnp.arange(B, dtype=jnp.int32)
+
+        def expand_hist(ghist, sg, sh, cnt):
+            """[G, Bg, 3] group hist -> [F, B, 3] (FixHistogram bin-0
+            reconstruction; see grower.py)."""
+            gather_bins = jnp.clip(feat_start[:, None] + b_idx[None, :] - 1,
+                                   0, Bg - 1)
+            taken = ghist[feat_group[:, None], gather_bins]
+            valid = (b_idx[None, :] >= 1) & (b_idx[None, :] < num_bin[:, None])
+            h = jnp.where(valid[:, :, None], taken, 0.0)
+            totals = jnp.stack([sg, sh, cnt])
+            return h.at[:, 0, :].set(totals[None, :] - h.sum(axis=1))
+    else:
+        def expand_hist(ghist, sg, sh, cnt):
+            return ghist
+
+    # max splits committed per round.  Any cap preserves exactness (the
+    # round applies a PREFIX of the best-first order and the validation
+    # check still guards interleaving); it bounds the changed-slot search
+    # width and the segment-histogram slot axis.
+    KCAP = min(Lm1, 128)
+
+    use_mc = monotone_constraints is not None
+    mc_j = jnp.asarray(monotone_constraints) if use_mc else None
+    use_rng = hp.extra_trees or cfg.bynode_feature_cnt > 0
+    if use_rng and rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+
+    # ---- per-leaf best-split search, vmapped over all L slots ----------
+    def leaf_key(parent, side):
+        # node-identity key: stable across application order, so batched
+        # and sequential growth draw the same randomness per node
+        return jax.random.fold_in(jax.random.fold_in(rng_key, parent + 1),
+                                  side)
+
+    def one_leaf_best(ghist, sg, sh, cnt, depth, bmin, bmax, parent, side):
+        fm = feature_mask
+        eru = None
+        if use_rng:
+            key = leaf_key(parent, side)
+            if cfg.bynode_feature_cnt > 0:
+                u = jax.random.uniform(jax.random.fold_in(key, 0), (F,))
+                kth = -lax.top_k(-u, cfg.bynode_feature_cnt)[0][-1]
+                bn = (u <= kth).astype(jnp.float32)
+                fm = bn if fm is None else fm * bn
+            if hp.extra_trees:
+                eru = jax.random.uniform(jax.random.fold_in(key, 1), (F, 2))
+        bounds = (bmin, bmax) if use_mc else None
+        hist = expand_hist(ghist, sg, sh, cnt)
+        r = best_split_for_leaf(
+            hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
+            hp, feature_mask=fm, monotone_constraints=mc_j,
+            leaf_output_bounds=bounds, has_categorical=has_cat,
+            extra_rand_u=eru)
+        if cfg.max_depth > 0:
+            r = r._replace(gain=jnp.where(depth >= cfg.max_depth,
+                                          -jnp.inf, r.gain))
+        return r
+
+    search_all = jax.vmap(one_leaf_best)
+
+    def cache_from(sr: SplitResult) -> _LeafBest:
+        return _LeafBest(
+            gain=sr.gain, feature=sr.feature, threshold=sr.threshold,
+            default_left=sr.default_left,
+            left_sum_grad=sr.left_sum_grad, left_sum_hess=sr.left_sum_hess,
+            left_count=sr.left_count,
+            right_sum_grad=sr.right_sum_grad,
+            right_sum_hess=sr.right_sum_hess, right_count=sr.right_count,
+            is_categorical=sr.is_categorical, cat_bitset=sr.cat_bitset)
+
+    # ---- root ----------------------------------------------------------
+    root_hist = _psum(hist_fn(binned, grad, hess, row_mask), axis_name)
+    root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
+    root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
+    root_cnt = _psum(jnp.sum(row_mask), axis_name)
+
+    tree = TreeArrays.empty(L)
+    hist_cache = jnp.zeros((L, G, Bg, 3), jnp.float32).at[0].set(root_hist)
+    leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
+    leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
+    leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
+    leaf_parent_side = jnp.zeros(L, jnp.int32)
+    leaf_min = jnp.full(L, -jnp.inf, jnp.float32)
+    leaf_max = jnp.full(L, jnp.inf, jnp.float32)
+    leaf_id = jnp.zeros(n, jnp.int32)
+
+    best = cache_from(search_all(
+        hist_cache, leaf_sg, leaf_sh, leaf_cnt, tree.leaf_depth,
+        leaf_min, leaf_max, tree.leaf_parent, leaf_parent_side))
+
+    class Carry(NamedTuple):
+        tree: TreeArrays
+        best: _LeafBest
+        hist: jax.Array
+        leaf_sg: jax.Array
+        leaf_sh: jax.Array
+        leaf_cnt: jax.Array
+        leaf_parent_side: jax.Array
+        leaf_id: jax.Array
+        split_idx: jax.Array
+        leaf_min: jax.Array
+        leaf_max: jax.Array
+
+    iota_L = jnp.arange(L, dtype=jnp.int32)
+
+    def active_gains(c: Carry):
+        active = iota_L < c.tree.num_leaves
+        return jnp.where(active, c.best.gain, -jnp.inf)
+
+    def cond(c: Carry):
+        return (c.split_idx < L - 1) & (jnp.max(active_gains(c)) > 0.0)
+
+    def apply_round(c: Carry, sel, rank, k, gl, seg):
+        """Commit the splits of the ``sel`` leaves (rank = application
+        order within the round); returns the updated carry WITHOUT a
+        refreshed best cache (the caller searches afterwards)."""
+        b = c.best
+        node_of = c.split_idx + rank                  # [L] new node ids
+        newleaf_of = c.tree.num_leaves + rank         # [L] right-child leaves
+
+        feat = b.feature
+        lg, lh, lc = b.left_sum_grad, b.left_sum_hess, b.left_count
+        rg, rh, rc = b.right_sum_grad, b.right_sum_hess, b.right_count
+
+        tree = c.tree
+        # fix the parents' dangling child pointers (parents are nodes from
+        # earlier rounds; within-round parents don't exist by construction)
+        pn = jnp.maximum(tree.leaf_parent, 0)
+        fixl = sel & (tree.leaf_parent >= 0) & (c.leaf_parent_side == 0)
+        fixr = sel & (tree.leaf_parent >= 0) & (c.leaf_parent_side == 1)
+        left_child = _pad_scatter(tree.left_child, pn, node_of, fixl)
+        right_child = _pad_scatter(tree.right_child, pn, node_of, fixr)
+        # write the new node rows
+        parent_out = leaf_output(c.leaf_sg, c.leaf_sh, hp.lambda_l1,
+                                 hp.lambda_l2, hp.max_delta_step)
+        new_depth = tree.leaf_depth + 1
+        ps = functools.partial(_pad_scatter, idx=node_of, sel=sel)
+        tree = tree._replace(
+            split_feature=ps(tree.split_feature, val=feat),
+            threshold_bin=ps(tree.threshold_bin, val=b.threshold),
+            default_left=ps(tree.default_left, val=b.default_left),
+            is_categorical=ps(tree.is_categorical, val=b.is_categorical),
+            cat_bitset=ps(tree.cat_bitset, val=b.cat_bitset),
+            left_child=ps(left_child, val=~iota_L),
+            right_child=ps(right_child, val=~newleaf_of),
+            split_gain=ps(tree.split_gain, val=b.gain),
+            internal_value=ps(tree.internal_value, val=parent_out),
+            internal_weight=ps(tree.internal_weight, val=c.leaf_sh),
+            internal_count=ps(tree.internal_count, val=c.leaf_cnt),
+            leaf_parent=_pad_scatter(
+                jnp.where(sel, node_of, tree.leaf_parent),
+                newleaf_of, node_of, sel),
+            leaf_depth=_pad_scatter(
+                jnp.where(sel, new_depth, tree.leaf_depth),
+                newleaf_of, new_depth, sel),
+            num_leaves=tree.num_leaves + k,
+        )
+        leaf_parent_side = _pad_scatter(
+            jnp.where(sel, 0, c.leaf_parent_side),
+            newleaf_of, jnp.ones(L, jnp.int32), sel)
+
+        # -- rows: those in a selected leaf that go right get the new leaf
+        lof = c.leaf_id
+        selr = sel[lof]
+        new_leaf_id = jnp.where(selr & ~gl, newleaf_of[lof], c.leaf_id)
+
+        # -- leaf stats (left child keeps the leaf index: elementwise)
+        leaf_sg = _pad_scatter(jnp.where(sel, lg, c.leaf_sg),
+                               newleaf_of, rg, sel)
+        leaf_sh = _pad_scatter(jnp.where(sel, lh, c.leaf_sh),
+                               newleaf_of, rh, sel)
+        leaf_cnt = _pad_scatter(jnp.where(sel, lc, c.leaf_cnt),
+                                newleaf_of, rc, sel)
+
+        # -- histograms: seg holds the SMALLER child of each selected leaf
+        small_left = lc <= rc
+        small = seg[jnp.clip(rank, 0, KCAP - 1)]       # [L, G, Bg, 3]
+        hist_left = jnp.where(small_left[:, None, None, None],
+                              small, c.hist - small)
+        hist_right = c.hist - hist_left
+        selb = sel[:, None, None, None]
+        hist = _pad_scatter(jnp.where(selb, hist_left, c.hist),
+                            newleaf_of, hist_right, sel)
+
+        # -- monotone bound propagation (see grower.py apply_split)
+        leaf_min, leaf_max = c.leaf_min, c.leaf_max
+        if use_mc:
+            p_min, p_max = leaf_min, leaf_max
+            l_out = jnp.clip(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
+                                         hp.max_delta_step), p_min, p_max)
+            r_out = jnp.clip(leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
+                                         hp.max_delta_step), p_min, p_max)
+            mid = (l_out + r_out) * 0.5
+            mc_f = mc_j[jnp.clip(feat, 0, F - 1)]
+            upd = (~b.is_categorical) & (mc_f != 0)
+            l_min = jnp.where(upd & (mc_f < 0), jnp.maximum(p_min, mid), p_min)
+            l_max = jnp.where(upd & (mc_f > 0), jnp.minimum(p_max, mid), p_max)
+            r_min = jnp.where(upd & (mc_f > 0), jnp.maximum(p_min, mid), p_min)
+            r_max = jnp.where(upd & (mc_f < 0), jnp.minimum(p_max, mid), p_max)
+            leaf_min = _pad_scatter(jnp.where(sel, l_min, leaf_min),
+                                    newleaf_of, r_min, sel)
+            leaf_max = _pad_scatter(jnp.where(sel, l_max, leaf_max),
+                                    newleaf_of, r_max, sel)
+
+        return Carry(tree, c.best, hist, leaf_sg, leaf_sh, leaf_cnt,
+                     leaf_parent_side, new_leaf_id, c.split_idx + k,
+                     leaf_min, leaf_max)
+
+    iota_K = jnp.arange(KCAP, dtype=jnp.int32)
+
+    def cache_scatter(base: _LeafBest, ids, res: SplitResult, valid):
+        """Overwrite cache rows ``ids`` (where ``valid``) with ``res``."""
+        new = cache_from(res)
+        return jax.tree_util.tree_map(
+            lambda b_, v: _pad_scatter(b_, ids, v, valid), base, new)
+
+    def body(c: Carry) -> Carry:
+        gains = active_gains(c)
+        pos = gains > 0.0
+        npos = jnp.sum(pos.astype(jnp.int32))
+        budget = (L - c.tree.num_leaves).astype(jnp.int32)
+        k = jnp.minimum(jnp.minimum(npos, budget), KCAP)
+        # total order (gain desc, leaf asc) = successive best-first ArgMax
+        # picks (reference: SerialTreeLearner::Train loop :175-193)
+        order = jnp.argsort(-gains, stable=True)
+        rank = jnp.zeros(L, jnp.int32).at[order].set(iota_L)
+        sel_b = pos & (rank < k)
+
+        # -- shared heavy work, computed once for the whole batch --------
+        b = c.best
+        lof = c.leaf_id
+        fr = jnp.clip(b.feature[lof], 0, F - 1)        # per-row split feature
+        g_col = jnp.take_along_axis(
+            binned, feat_group[fr][:, None], axis=1)[:, 0].astype(jnp.int32)
+        dec = g_col - feat_start[fr] + 1
+        binf = jnp.where((dec >= 1) & (dec < num_bin[fr]), dec, 0)
+        gl = row_goes_left(binf, b.threshold[lof], b.default_left[lof],
+                           b.is_categorical[lof], b.cat_bitset[lof],
+                           missing_type[fr], default_bin[fr], num_bin[fr])
+        # smaller-child segment histograms: one compacted pass for the
+        # whole round (slot r = the round's r-th split, = the argmax
+        # split's smaller child at r == 0 — the sequential fallback's slice)
+        small_left = b.left_count <= b.right_count
+        selr = sel_b[lof]
+        row_small = selr & (gl == small_left[lof])
+        slot = jnp.where(row_small, rank[lof], KCAP)
+        seg = _psum(compacted_segment_histogram(
+            binned, grad, hess, row_mask, slot, KCAP, Bg, caps), axis_name)
+
+        cb = apply_round(c, sel_b, rank, k, gl, seg)
+
+        # -- best splits for the round's CHANGED slots only: the k left
+        # children (which keep their leaf index: order[:KCAP]) and the k
+        # new right children
+        valid_k = iota_K < k
+        ids = jnp.concatenate([order[:KCAP], c.tree.num_leaves + iota_K])
+        valid = jnp.concatenate([valid_k, valid_k])
+        idc = jnp.clip(ids, 0, L - 1)
+        res = search_all(
+            cb.hist[idc], cb.leaf_sg[idc], cb.leaf_sh[idc], cb.leaf_cnt[idc],
+            cb.tree.leaf_depth[idc], cb.leaf_min[idc], cb.leaf_max[idc],
+            cb.tree.leaf_parent[idc], cb.leaf_parent_side[idc])
+        cb = cb._replace(best=cache_scatter(c.best, idc, res, valid))
+
+        # -- exactness check: would best-first have interleaved a child?
+        child_max = jnp.max(jnp.where(valid, res.gain, -jnp.inf))
+        min_sel = jnp.min(jnp.where(sel_b, gains, jnp.inf))
+        ok = (k <= 1) | (child_max < min_sel)
+
+        def fallback(_):
+            # single best-first step: the argmax leaf's results are the
+            # rank-0 lanes of the batched computation
+            sel_s = pos & (rank == 0)
+            cs = apply_round(c, sel_s, rank, jnp.int32(1), gl, seg)
+            lane0 = (iota_K == 0)
+            valid_s = jnp.concatenate([lane0, lane0])
+            return cs._replace(best=cache_scatter(c.best, idc, res, valid_s))
+
+        return lax.cond(ok, lambda _: cb, fallback, None)
+
+    init = Carry(tree, best, hist_cache, leaf_sg, leaf_sh, leaf_cnt,
+                 leaf_parent_side, leaf_id, jnp.array(0, jnp.int32),
+                 leaf_min, leaf_max)
+    out = lax.while_loop(cond, body, init)
+
+    # finalize leaf values (reference: CalculateSplittedLeafOutput; clamped
+    # to monotone bounds like grower.py)
+    tree = out.tree
+    lv = leaf_output(out.leaf_sg, out.leaf_sh, hp.lambda_l1, hp.lambda_l2,
+                     hp.max_delta_step)
+    if use_mc:
+        lv = jnp.clip(lv, out.leaf_min, out.leaf_max)
+    active = iota_L < tree.num_leaves
+    tree = tree._replace(
+        leaf_value=jnp.where(active, lv, 0.0),
+        leaf_weight=jnp.where(active, out.leaf_sh, 0.0),
+        leaf_count=jnp.where(active, out.leaf_cnt, 0.0),
+    )
+    return tree, out.leaf_id

@@ -239,6 +239,8 @@ def load_text_dataset_two_round(path: str, dataset,
                 reservoir[r[acc]] = feats[rest[acc]]
         n_seen += len(feats)
     n = n_seen
+    if n == 0 or reservoir is None:
+        raise ValueError(f"no data rows found in {path!r}")
 
     # ---- decide bins + EFB layout ------------------------------------------
     dataset.num_data = n

@@ -346,6 +346,78 @@ def compacted_histogram(
     return lax.switch(bucket, [branch(c) for c in caps])
 
 
+def segment_histogram(
+    binned: jax.Array,       # [n, F] uint8/16
+    grad: jax.Array,         # [n]
+    hess: jax.Array,         # [n]
+    weights: jax.Array,      # [n] f32 bagging/GOSS weights
+    slot: jax.Array,         # [n] i32 in [0, num_slots]; num_slots = dropped
+    num_slots: int,
+    num_bins: int,
+) -> jax.Array:
+    """Per-slot masked histogram: [S, F, B, 3] where row r contributes its
+    (g, h, 1)*w to slot[r]'s histogram.  Rows with slot == num_slots are
+    dropped (the dummy slot).
+
+    This is the batched-frontier generalization of ``build_histogram``: one
+    pass over the data builds the histograms of EVERY smaller child of a
+    round's splits (reference equivalent: one ConstructHistograms call per
+    leaf, serial_tree_learner.cpp:380-388 — here a whole frontier per call).
+    Scatter-add formulation: the work is O(n*F) independent of S, unlike a
+    one-hot matmul over (slot, bin) which would cost O(n*F*B*S).
+    """
+    n, F = binned.shape
+    B = num_bins
+    S = num_slots
+    vals = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1) * weights[:, None]
+    offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    flat = (slot[:, None].astype(jnp.int32) * (F * B)
+            + binned.astype(jnp.int32) + offsets)          # [n, F]
+    hist = jnp.zeros(((S + 1) * F * B, 3), dtype=jnp.float32)
+    updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
+    hist = hist.at[flat.reshape(-1)].add(updates.reshape(-1, 3))
+    return hist.reshape(S + 1, F, B, 3)[:S]
+
+
+def compacted_segment_histogram(
+    binned: jax.Array,       # [n, F]
+    grad: jax.Array,
+    hess: jax.Array,
+    weights: jax.Array,      # [n] f32
+    slot: jax.Array,         # [n] i32 in [0, num_slots]; num_slots = dropped
+    num_slots: int,
+    num_bins: int,
+    caps: list,              # static descending capacities
+) -> jax.Array:
+    """``segment_histogram`` over only the rows with a real slot, gather-
+    compacted into the smallest static capacity that fits (see
+    ``compacted_histogram``).  Returns [S, F, B, 3] f32."""
+    n, F = binned.shape
+    member = (slot < num_slots) & (weights > 0)
+    count = jnp.sum(member)
+
+    def branch(cap: int):
+        def run():
+            idx = jnp.nonzero(member, size=cap, fill_value=n)[0]
+            valid = idx < n
+            idxc = jnp.minimum(idx, n - 1)
+            rows = jnp.take(binned, idxc, axis=0)
+            w = jnp.where(valid, jnp.take(weights, idxc), 0.0)
+            g = jnp.take(grad, idxc)
+            h = jnp.take(hess, idxc)
+            s = jnp.where(valid, jnp.take(slot, idxc), num_slots)
+            return segment_histogram(rows, g, h, w, s, num_slots, num_bins)
+        return run
+
+    if len(caps) == 1:
+        return segment_histogram(binned, grad, hess, weights,
+                                 jnp.where(member, slot, num_slots),
+                                 num_slots, num_bins)
+    caps_arr = jnp.asarray(caps, jnp.int32)
+    bucket = jnp.sum(caps_arr >= count) - 1
+    return lax.switch(bucket, [branch(c) for c in caps])
+
+
 def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """The subtraction trick: sibling = parent - child.
 

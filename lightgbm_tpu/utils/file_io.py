@@ -53,7 +53,9 @@ def exists(path) -> bool:
         try:
             with open_file(path, "r"):
                 return True
-        except OSError:
+        except Exception:
+            # registered openers are not bound to raise OSError for a
+            # missing path (e.g. a dict-backed test FS raises KeyError)
             return False
     import os
     return os.path.exists(path)

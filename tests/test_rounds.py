@@ -1,0 +1,216 @@
+"""Batched-frontier grower (grower_rounds.py) vs serial grower equality.
+
+The rounds grower must produce STRUCTURALLY IDENTICAL trees to the serial
+best-first grower — same splits, same node/leaf numbering — for every gain
+pattern (its exactness check falls back to single steps when a round would
+deviate).  Float fields (gains, sums, leaf values) agree only to float32
+accumulation order: the two growers sum histogram bins in different orders,
+the same class of difference as the reference's CPU vs GPU histograms.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.dataset import FeatureMeta
+from lightgbm_tpu.grower import GrowerConfig, grow_tree
+from lightgbm_tpu.grower_rounds import grow_tree_rounds
+from lightgbm_tpu.ops.split import SplitHyperparams
+
+
+def _meta(B, F):
+    return FeatureMeta(
+        num_bin=np.full(F, B, np.int32),
+        missing_type=np.zeros(F, np.int32),
+        default_bin=np.zeros(F, np.int32),
+        most_freq_bin=np.zeros(F, np.int32),
+        is_categorical=np.zeros(F, bool),
+        max_num_bin=B,
+    )
+
+
+def _assert_trees_equal(t1, t2):
+    nl = int(t1.num_leaves)
+    assert nl == int(t2.num_leaves)
+    nn = max(nl - 1, 1)
+    for name in ("split_feature", "threshold_bin", "default_left",
+                 "is_categorical", "left_child", "right_child"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t1, name))[:nn],
+            np.asarray(getattr(t2, name))[:nn], err_msg=name)
+    for name in ("split_gain", "internal_value", "internal_count"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(t1, name))[:nn],
+            np.asarray(getattr(t2, name))[:nn], rtol=3e-5, err_msg=name)
+    for name in ("leaf_value", "leaf_weight", "leaf_count"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(t1, name))[:nl],
+            np.asarray(getattr(t2, name))[:nl], rtol=3e-5, atol=1e-7,
+            err_msg=name)
+
+
+def _grow_both(binned, grad, hess, mask, meta, cfg, mc=None):
+    t_s, lid_s = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                           jnp.asarray(hess), jnp.asarray(mask), meta, cfg,
+                           monotone_constraints=mc)
+    t_r, lid_r = grow_tree_rounds(jnp.asarray(binned), jnp.asarray(grad),
+                                  jnp.asarray(hess), jnp.asarray(mask),
+                                  meta, cfg, monotone_constraints=mc)
+    _assert_trees_equal(t_s, t_r)
+    np.testing.assert_array_equal(np.asarray(lid_s), np.asarray(lid_r))
+
+
+@pytest.fixture
+def problem():
+    rng = np.random.RandomState(7)
+    n, F, B = 4096, 10, 32
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    grad = (rng.randn(n) + 0.7 * (binned[:, 1] > 16)
+            - 0.4 * (binned[:, 3] < 5)).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    return binned, grad, hess, B, F
+
+
+@pytest.mark.parametrize("leaves", [2, 7, 31, 64])
+def test_rounds_equals_serial(problem, leaves):
+    binned, grad, hess, B, F = problem
+    cfg = GrowerConfig(num_leaves=leaves, num_bins=B, hp=SplitHyperparams(),
+                       hist_method="scatter")
+    _grow_both(binned, grad, hess, np.ones(len(grad), np.float32),
+               _meta(B, F), cfg)
+
+
+def test_rounds_equals_serial_bagging_and_depth(problem):
+    binned, grad, hess, B, F = problem
+    rng = np.random.RandomState(3)
+    mask = (rng.rand(len(grad)) < 0.7).astype(np.float32) * 2.0
+    cfg = GrowerConfig(num_leaves=31, max_depth=4, num_bins=B,
+                       hp=SplitHyperparams(min_data_in_leaf=40),
+                       hist_method="scatter")
+    _grow_both(binned, grad, hess, mask, _meta(B, F), cfg)
+
+
+def test_rounds_equals_serial_monotone(problem):
+    binned, grad, hess, B, F = problem
+    mc = np.zeros(F, np.int32)
+    mc[1] = 1
+    mc[3] = -1
+    cfg = GrowerConfig(num_leaves=31, num_bins=B, hp=SplitHyperparams(),
+                       hist_method="scatter")
+    _grow_both(binned, grad, hess, np.ones(len(grad), np.float32),
+               _meta(B, F), cfg, mc=jnp.asarray(mc))
+
+
+def test_rounds_equals_serial_adversarial_xor():
+    """XOR-style data: a child's split gain EXCEEDS its parent's, forcing
+    the rounds grower through its exactness fallback path."""
+    rng = np.random.RandomState(0)
+    n, F, B = 4096, 6, 16
+    binned = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    a = binned[:, 0] >= 8
+    b = binned[:, 1] >= 8
+    grad = (np.where(a ^ b, 1.0, -1.0) + 0.01 * rng.randn(n)
+            ).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    for leaves in (4, 9, 31):
+        cfg = GrowerConfig(num_leaves=leaves, num_bins=B,
+                           hp=SplitHyperparams(), hist_method="scatter")
+        _grow_both(binned, grad, hess, np.ones(n, np.float32),
+                   _meta(B, F), cfg)
+
+
+def test_rounds_equals_serial_extra_trees_and_bynode(problem):
+    """Node RNG keys derive from node identity in both growers, so the
+    randomized modes stay structurally identical too."""
+    import jax
+    binned, grad, hess, B, F = problem
+    cfg = GrowerConfig(num_leaves=31, num_bins=B,
+                       hp=SplitHyperparams(extra_trees=True),
+                       bynode_feature_cnt=5, hist_method="scatter")
+    mask = np.ones(len(grad), np.float32)
+    meta = _meta(B, F)
+    key = jax.random.PRNGKey(42)
+    t_s, lid_s = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                           jnp.asarray(hess), jnp.asarray(mask), meta, cfg,
+                           rng_key=key)
+    t_r, lid_r = grow_tree_rounds(jnp.asarray(binned), jnp.asarray(grad),
+                                  jnp.asarray(hess), jnp.asarray(mask),
+                                  meta, cfg, rng_key=key)
+    _assert_trees_equal(t_s, t_r)
+    np.testing.assert_array_equal(np.asarray(lid_s), np.asarray(lid_r))
+
+
+def test_rounds_data_parallel_matches_single(problem):
+    """Rounds grower under shard_map row sharding == single-device rounds."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    binned, grad, hess, B, F = problem
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=15, num_bins=B,
+                       hp=SplitHyperparams(min_data_in_leaf=10),
+                       hist_method="scatter")
+    mask = np.ones(len(grad), np.float32)
+    ref_tree, ref_leaf = grow_tree_rounds(
+        jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), meta, cfg)
+
+    assert jax.device_count() >= 8
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    sharded = jax.shard_map(
+        lambda b, g, h, m: grow_tree_rounds(b, g, h, m, meta, cfg,
+                                            axis_name="d"),
+        mesh=mesh, in_specs=(P("d"), P("d"), P("d"), P("d")),
+        out_specs=(P(), P("d")), check_vma=False)
+    tree, leaf_id = jax.jit(sharded)(binned, grad, hess, mask)
+
+    nl = int(ref_tree.num_leaves)
+    assert int(tree.num_leaves) == nl
+    np.testing.assert_array_equal(np.asarray(tree.split_feature[:nl - 1]),
+                                  np.asarray(ref_tree.split_feature[:nl - 1]))
+    np.testing.assert_array_equal(np.asarray(tree.threshold_bin[:nl - 1]),
+                                  np.asarray(ref_tree.threshold_bin[:nl - 1]))
+    np.testing.assert_allclose(np.asarray(tree.leaf_value[:nl]),
+                               np.asarray(ref_tree.leaf_value[:nl]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(leaf_id),
+                                  np.asarray(ref_leaf))
+
+
+def test_rounds_engine_matches_serial_model():
+    """End-to-end through the engine (incl. EFB bundling and multiple
+    boosting iterations): same structures, predictions within float
+    accumulation tolerance."""
+    rng = np.random.RandomState(11)
+    n = 3000
+    X = rng.rand(n, 12).astype(np.float32)
+    X[:, 5] = (X[:, 5] > 0.6).astype(np.float32)     # sparse-ish for EFB
+    X[:, 7] = 0.0
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] - X[:, 5] + 0.2 * rng.randn(n)) > 0.5
+         ).astype(np.float32)
+    dumps, preds = {}, {}
+    for mode in ("serial", "rounds"):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "max_bin": 32, "verbosity": -1,
+                  "tpu_tree_growth": mode}
+        ds = lgb.Dataset(X, label=y)
+        booster = lgb.train(params, ds, num_boost_round=8)
+        dumps[mode] = booster.dump_model()
+        preds[mode] = booster.predict(X)
+
+    def structures(d):
+        out = []
+        def walk(node):
+            if "split_feature" in node:
+                out.append((node["split_feature"], node["threshold"],
+                            node["default_left"]))
+                walk(node["left_child"]); walk(node["right_child"])
+        for t in d["tree_info"]:
+            walk(t["tree_structure"])
+        return out
+
+    assert structures(dumps["serial"]) == structures(dumps["rounds"])
+    np.testing.assert_allclose(preds["serial"], preds["rounds"],
+                               rtol=2e-4, atol=2e-6)
