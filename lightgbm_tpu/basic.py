@@ -40,6 +40,8 @@ class Booster:
         self.boosting = None
         self.train_set: Optional[Dataset] = None
         self.name_valid_sets: List[str] = []
+        self._attr: Dict[str, str] = {}
+        self._train_data_name = "training"
 
         if train_set is not None:
             self._init_train(train_set)
@@ -133,6 +135,60 @@ class Booster:
         return self.boosting.current_iteration() if self.boosting else \
             len(self._loaded["models"]) // self._loaded["num_tree_per_iteration"]
 
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """reference: LGBM_BoosterGetLeafValue (src/c_api.cpp)."""
+        return float(self.models[tree_id].leaf_value[leaf_id])
+
+    def upper_bound(self) -> float:
+        """Sum over trees of each tree's max leaf value (reference:
+        GBDT::GetUpperBoundValue, src/boosting/gbdt.cpp:632)."""
+        return float(sum(np.max(m.leaf_value[:m.num_leaves])
+                         for m in self.models))
+
+    def lower_bound(self) -> float:
+        """reference: GBDT::GetLowerBoundValue (src/boosting/gbdt.cpp:640)."""
+        return float(sum(np.min(m.leaf_value[:m.num_leaves])
+                         for m in self.models))
+
+    def model_from_string(self, model_str: str, verbose: bool = True) -> "Booster":
+        """Reset this Booster from a model string (reference:
+        Booster.model_from_string, basic.py:2438)."""
+        self.boosting = None
+        self.train_set = None
+        self._init_from_string(model_str)
+        return self
+
+    def num_feature(self) -> int:
+        return self.num_features()
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Shuffle the order of the models between two iterations, using
+        the reference's exact LCG draw sequence (reference:
+        GBDT::ShuffleModels, src/boosting/gbdt.h:80 — Fisher-Yates with
+        Random(17).NextShort).  Note: like the reference, this only
+        permutes the stored trees; mid-training state (scores, rollback
+        history) is not re-derived.
+        """
+        models = self.models
+        K = self.num_tree_per_iteration
+        total_iter = len(models) // K
+        start = max(0, start_iteration)
+        end = total_iter if end_iteration <= 0 else min(total_iter,
+                                                        end_iteration)
+        indices = list(range(total_iter))
+        x = 17                                   # Random(seed=17)
+        for i in range(start, end - 1):
+            x = (214013 * x + 2531011) & 0xFFFFFFFF
+            r = (x >> 16) & 0x7FFF               # NextShort(i+1, end)
+            j = r % (end - (i + 1)) + (i + 1)
+            indices[i], indices[j] = indices[j], indices[i]
+        shuffled = [models[i * K + k] for i in indices for k in range(K)]
+        models[:] = shuffled
+        if self.boosting is not None:
+            self.boosting.models_version += 1
+        return self
+
     def num_trees(self) -> int:
         return len(self.models)
 
@@ -153,9 +209,43 @@ class Booster:
     # ------------------------------------------------------------------ eval
 
     def eval_train(self, feval=None):
-        out = [("training", n, v, h) for (d, n, v, h) in self.boosting.eval_train()]
-        return out + self._custom_eval(feval, "training", self.boosting.train_score,
+        name = self._train_data_name
+        out = [(name, n, v, h) for (d, n, v, h) in self.boosting.eval_train()]
+        return out + self._custom_eval(feval, name, self.boosting.train_score,
                                        self.train_set)
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        """Evaluate on ``data``, which must be the training set or an added
+        validation set (reference: Booster.eval, basic.py:2274)."""
+        if data is self.train_set:
+            return self.eval_train(feval)
+        for i, vs in enumerate(self.boosting.valid_sets):
+            if vs is data:
+                out = [(name, mn, mv, h)
+                       for (_, mn, mv, h) in self.boosting.eval_one_valid(i)]
+                return out + self._custom_eval(
+                    feval, name, self.boosting.valid_scores[i], vs)
+        raise ValueError(
+            "Data should be either valid data or training data")
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def attr(self, key: str):
+        """reference: Booster.attr (basic.py:2914) — plain string
+        attributes held Python-side."""
+        return self._attr.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        for key, value in kwargs.items():
+            if value is None:
+                self._attr.pop(key, None)
+            elif isinstance(value, str):
+                self._attr[key] = value
+            else:
+                raise ValueError("Only string values are accepted")
+        return self
 
     def eval_valid(self, feval=None):
         out = list(self.boosting.eval_valid())

@@ -989,6 +989,10 @@ class GBDT:
                                   self.valid_metrics[i], self.objective))
         return out
 
+    def eval_one_valid(self, i: int) -> List[Tuple[str, str, float, bool]]:
+        return self._eval(self.valid_names[i], self.valid_scores[i],
+                          self.valid_metrics[i], self.objective)
+
     def _eval(self, dataname, score, metrics, objective):
         from ..utils.timer import global_timer
         with global_timer.section("GBDT::EvalMetrics"):

@@ -458,6 +458,153 @@ class Dataset:
 
     # -- accessors mirroring reference python API ----------------------------
 
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        """Create a validation Dataset aligned with this one (bins with
+        THIS dataset's BinMappers).
+
+        reference: Dataset.create_valid (python-package/lightgbm/basic.py:1142).
+        """
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       feature_name=self._feature_name_param,
+                       categorical_feature=self._categorical_feature_param,
+                       params=dict(params or self.params),
+                       free_raw_data=self.free_raw_data)
+
+    # -- field accessors (reference: Dataset.get_field/set_field,
+    # python-package/lightgbm/basic.py:1255-1339 -> LGBM_DatasetGetField /
+    # SetField, src/c_api.cpp; 'group' follows the reference's asymmetry:
+    # set takes per-query SIZES, get returns CUMULATIVE boundaries) -------
+
+    _FIELDS = ("label", "weight", "init_score", "group")
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name not in self._FIELDS:
+            raise ValueError(f"unknown field {field_name!r}")
+        if field_name == "label":
+            self.metadata.label = (None if data is None else
+                                   np.asarray(data, np.float32).reshape(-1))
+        elif field_name == "weight":
+            self.metadata.weight = (None if data is None else
+                                    np.asarray(data, np.float32).reshape(-1))
+        elif field_name == "init_score":
+            self.metadata.init_score = (None if data is None else
+                                        np.asarray(data, np.float64))
+        else:
+            self.metadata.set_group(data)
+        return self
+
+    def get_field(self, field_name: str):
+        if field_name not in self._FIELDS:
+            raise ValueError(f"unknown field {field_name!r}")
+        if field_name == "group":
+            return self.metadata.query_boundaries
+        if field_name == "init_score":
+            return self.metadata.init_score
+        return getattr(self.metadata, field_name)
+
+    def get_data(self):
+        """The raw data this Dataset was built from (reference:
+        Dataset.get_data, basic.py — raises after raw data was freed)."""
+        if self.raw_data is None and self.constructed:
+            raise RuntimeError(
+                "Cannot get data: raw data was freed after construction "
+                "(pass free_raw_data=False to keep it)")
+        return self.raw_data
+
+    def get_params(self) -> dict:
+        return dict(self.params)
+
+    def get_ref_chain(self, ref_limit: int = 100) -> set:
+        """Chain of Datasets reachable through .reference (reference:
+        Dataset.get_ref_chain, basic.py:1633)."""
+        head, chain = self, set()
+        while len(chain) < ref_limit:
+            if isinstance(head, Dataset):
+                chain.add(head)
+                if head.reference is not None and head.reference not in chain:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return chain
+
+    def num_feature(self) -> int:
+        """Number of (original) features, after construction (reference:
+        LGBM_DatasetGetNumFeature -> max_feature_idx + 1)."""
+        self.construct()
+        return self.num_total_features
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._categorical_feature_param == categorical_feature:
+            return self
+        if self.constructed:
+            raise RuntimeError(
+                "Cannot set categorical feature after dataset construction; "
+                "create a new Dataset")
+        self._categorical_feature_param = categorical_feature
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        if feature_name != "auto":
+            self._feature_name_param = feature_name
+            if self.constructed:
+                if len(feature_name) != self.num_total_features:
+                    raise ValueError(
+                        f"Length of feature names ({len(feature_name)}) does "
+                        f"not equal number of features "
+                        f"({self.num_total_features})")
+                self.feature_names = list(feature_name)
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        if self.reference is reference:
+            return self
+        if self.constructed:
+            raise RuntimeError(
+                "Cannot set reference after dataset construction; "
+                "create a new Dataset")
+        self.reference = reference
+        return self
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append ``other``'s feature columns to this Dataset in place.
+
+        Both must be constructed with the same number of rows (reference:
+        LGBM_DatasetAddFeaturesFrom -> Dataset::AddFeaturesFrom,
+        src/io/dataset.cpp).  Bin groups are concatenated: the merged matrix
+        keeps each source's EFB bundling with the other's group ids offset.
+        """
+        if not (self.constructed and other.constructed):
+            raise ValueError(
+                "Both source and target Datasets must be constructed "
+                "before adding features")
+        if self.num_data != other.num_data:
+            raise ValueError(
+                f"Cannot add features from a Dataset with {other.num_data} "
+                f"rows to one with {self.num_data} rows")
+        base = self.num_total_features
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.used_features = list(self.used_features) + [
+            base + f for f in other.used_features]
+        dtype = (np.uint16 if max(self.max_group_bin, other.max_group_bin) > 256
+                 else np.uint8)
+        self.binned = np.hstack([self.binned.astype(dtype, copy=False),
+                                 other.binned.astype(dtype, copy=False)])
+        self.feat_group = np.concatenate(
+            [self.feat_group, other.feat_group + self.num_groups]).astype(np.int32)
+        self.feat_start = np.concatenate(
+            [self.feat_start, other.feat_start]).astype(np.int32)
+        self._group_size = list(self._group_size) + list(other._group_size)
+        self.group_num_bin = list(self.group_num_bin) + list(other.group_num_bin)
+        self.num_groups += other.num_groups
+        self.max_group_bin = max(self.max_group_bin, other.max_group_bin)
+        self.num_total_features += other.num_total_features
+        self.feature_names = list(self.feature_names) + list(other.feature_names)
+        return self
+
     def get_label(self):
         return self.metadata.label
 
@@ -478,6 +625,12 @@ class Dataset:
 
     def get_init_score(self):
         return self.metadata.init_score
+
+    def get_group(self):
+        """Per-query group SIZES (reference: Dataset.get_group converts the
+        stored cumulative boundaries back with np.diff)."""
+        qb = self.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
 
     def num_features(self) -> int:
         self.construct()

@@ -1,0 +1,146 @@
+"""Python API parity: the reference package's Dataset/Booster method
+surface (python-package/lightgbm/basic.py) on the TPU implementation."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture
+def trained():
+    rng = np.random.RandomState(3)
+    n = 2000
+    X = rng.rand(n, 8).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n)) > 0.7).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    dv = ds.create_valid(X[:500], label=y[:500])
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "binary_logloss"}
+    bst = lgb.train(params, ds, num_boost_round=6, valid_sets=[dv],
+                    valid_names=["v0"])
+    return X, y, ds, dv, bst
+
+
+def test_dataset_fields_and_params():
+    rng = np.random.RandomState(0)
+    X = rng.rand(100, 4).astype(np.float32)
+    ds = lgb.Dataset(X, params={"max_bin": 16})
+    ds.set_field("label", np.arange(100) % 2)
+    ds.set_field("weight", np.ones(100))
+    ds.set_field("init_score", np.zeros(100))
+    ds.set_field("group", [60, 40])
+    np.testing.assert_array_equal(ds.get_field("label"), np.arange(100) % 2)
+    np.testing.assert_array_equal(ds.get_field("group"), [0, 60, 100])
+    np.testing.assert_array_equal(ds.get_group(), [60, 40])
+    assert ds.get_params() == {"max_bin": 16}
+    with pytest.raises(ValueError):
+        ds.set_field("nope", [1])
+    ds.set_field("weight", None)
+    assert ds.get_field("weight") is None
+
+
+def test_dataset_ref_chain_and_setters():
+    rng = np.random.RandomState(0)
+    X = rng.rand(50, 3).astype(np.float32)
+    a = lgb.Dataset(X, label=np.zeros(50))
+    b = lgb.Dataset(X, label=np.zeros(50))
+    b.set_reference(a)
+    c = lgb.Dataset(X, label=np.zeros(50), reference=b)
+    chain = c.get_ref_chain()
+    assert chain == {a, b, c}
+    a.set_feature_name([f"f{i}" for i in range(3)])
+    a.construct()
+    assert a.feature_names == ["f0", "f1", "f2"]
+    assert a.num_feature() == 3
+    with pytest.raises(RuntimeError):
+        a.set_reference(b)
+
+
+def test_dataset_get_data_and_free():
+    rng = np.random.RandomState(0)
+    X = rng.rand(50, 3).astype(np.float32)
+    kept = lgb.Dataset(X, label=np.zeros(50), free_raw_data=False).construct()
+    assert kept.get_data() is not None
+    freed = lgb.Dataset(X, label=np.zeros(50)).construct()
+    with pytest.raises(RuntimeError):
+        freed.get_data()
+
+
+def test_add_features_from_matches_joint_training():
+    rng = np.random.RandomState(1)
+    n = 1500
+    Xa = rng.rand(n, 3).astype(np.float32)
+    Xb = rng.rand(n, 2).astype(np.float32)
+    y = ((Xa[:, 0] + Xb[:, 1] + 0.1 * rng.randn(n)) > 1.0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "enable_bundle": False}
+
+    da = lgb.Dataset(Xa, label=y, params=params).construct()
+    db = lgb.Dataset(Xb, params=params).construct()
+    da.add_features_from(db)
+    assert da.num_feature() == 5
+    merged = lgb.train(params, da, num_boost_round=5)
+
+    joint = lgb.train(params, lgb.Dataset(np.hstack([Xa, Xb]), label=y,
+                                          params=params), num_boost_round=5)
+    Xfull = np.hstack([Xa, Xb])
+    np.testing.assert_allclose(merged.predict(Xfull), joint.predict(Xfull),
+                               rtol=1e-6)
+
+
+def test_booster_attr_and_train_data_name(trained):
+    _, _, ds, dv, bst = trained
+    assert bst.attr("missing") is None
+    bst.set_attr(alpha="1", beta="x")
+    assert bst.attr("alpha") == "1"
+    bst.set_attr(alpha=None)
+    assert bst.attr("alpha") is None
+    with pytest.raises(ValueError):
+        bst.set_attr(gamma=3)
+    bst.set_train_data_name("mytrain")
+    assert bst.eval_train()[0][0] == "mytrain"
+
+
+def test_booster_eval_on_datasets(trained):
+    _, _, ds, dv, bst = trained
+    tr = bst.eval(ds, "anything")
+    assert tr and tr[0][0] == "training"
+    ev = bst.eval(dv, "renamed")
+    assert ev and ev[0][0] == "renamed"
+    assert ev[0][1] == "binary_logloss"
+    with pytest.raises(ValueError):
+        bst.eval(lgb.Dataset(np.zeros((5, 8)), label=np.zeros(5)), "x")
+
+
+def test_booster_bounds_and_leaf_output(trained):
+    _, _, _, _, bst = trained
+    lo, hi = bst.lower_bound(), bst.upper_bound()
+    assert lo <= hi
+    m0 = bst.models[0]
+    assert bst.get_leaf_output(0, 0) == pytest.approx(float(m0.leaf_value[0]))
+    total_lo = sum(float(np.min(m.leaf_value[:m.num_leaves]))
+                   for m in bst.models)
+    assert lo == pytest.approx(total_lo)
+
+
+def test_booster_model_from_string_and_num_feature(trained):
+    X, _, _, _, bst = trained
+    s = bst.model_to_string()
+    pred = bst.predict(X)
+    b2 = lgb.Booster(model_str=s)
+    b2.model_from_string(s)
+    np.testing.assert_allclose(b2.predict(X), pred, rtol=1e-9)
+    assert b2.num_feature() == 8
+
+
+def test_booster_shuffle_models(trained):
+    X, _, _, _, bst = trained
+    pred_before = bst.predict(X)
+    before = [m for m in bst.models]
+    bst.shuffle_models()
+    after = [m for m in bst.models]
+    assert sorted(map(id, before)) == sorted(map(id, after))
+    assert list(map(id, before)) != list(map(id, after))   # must move some
+    # prediction = sum over trees, invariant under order
+    np.testing.assert_allclose(bst.predict(X), pred_before, rtol=1e-6)
