@@ -532,19 +532,21 @@ class GBDT:
         rounds_ok = (not cegb_on and cfg.voting_top_k == 0
                      and self._feature_axis is None
                      and forced_plan is None)
-        if growth == "rounds" and not rounds_ok:
+        if growth in ("rounds", "fast") and not rounds_ok:
             raise ValueError(
-                "tpu_tree_growth=rounds does not support CEGB, voting, "
+                f"tpu_tree_growth={growth} does not support CEGB, voting, "
                 "feature-parallel or forced splits; use serial or auto")
-        if growth not in ("auto", "serial", "rounds"):
+        if growth not in ("auto", "serial", "rounds", "fast"):
             raise ValueError(f"unknown tpu_tree_growth {growth!r}")
+        if growth == "fast":
+            cfg = self.grower_cfg = cfg._replace(rounds_relaxed=True)
         # auto: rounds only on the accelerator.  Measured (round 4, 200k x
         # 28, 255 leaves): on TPU the serial grower is bound by ~6 ms of
         # per-while-step overhead (2.6 s/tree); on CPU ops are cheap but
         # the rounds body's full-frontier vmapped search is real compute
         # (rounds 19.8 s/tree vs serial 2.4 s/tree there).
         on_accel = jax.default_backend() in ("tpu", "axon")
-        use_rounds = growth == "rounds" or (
+        use_rounds = growth in ("rounds", "fast") or (
             growth == "auto" and rounds_ok and on_accel)
         # padded-device feature slot -> inner used-feature index (sharded
         # EFB layout); trees must come back in inner feature numbering

@@ -605,6 +605,33 @@ class Dataset:
         self.feature_names = list(self.feature_names) + list(other.feature_names)
         return self
 
+    def _dump_text(self, filename: str) -> "Dataset":
+        """Debug dump of the binned matrix (reference: Dataset::DumpTextFile,
+        src/io/dataset.cpp:994 via LGBM_DatasetDumpText): header stats,
+        feature names, then one line of per-feature BIN values per row.
+        Not loadable back; for debugging parity only."""
+        self.construct()
+        from .utils.file_io import open_file
+        F = len(self.used_features)
+        with open_file(filename, "w") as fh:
+            fh.write(f"num_features: {F}\n")
+            fh.write(f"num_total_features: {self.num_total_features}\n")
+            fh.write(f"num_groups: {self.num_groups}\n")
+            fh.write(f"num_data: {self.num_data}\n")
+            fh.write("feature_names: "
+                     + ", ".join(self.feature_names) + "\n")
+            meta = self.feature_meta().resolved()
+            for i in range(self.num_data):
+                row = self.binned[i]
+                bins = []
+                for j in range(F):
+                    g = meta.feat_group[j]
+                    st = meta.feat_start[j]
+                    dec = int(row[g]) - st + 1
+                    bins.append(dec if 1 <= dec < meta.num_bin[j] else 0)
+                fh.write(", ".join(str(b) for b in bins) + "\n")
+        return self
+
     def get_label(self):
         return self.metadata.label
 

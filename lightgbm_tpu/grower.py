@@ -201,6 +201,9 @@ class GrowerConfig(NamedTuple):
     num_feature_shards: int = 1    # feature-axis size (static); with EFB the
                                    # caller pre-arranges meta shard-major so
                                    # each shard owns whole bundles
+    rounds_relaxed: bool = False   # rounds grower: skip the best-first
+                                   # exactness fallback (tpu_tree_growth=
+                                   # "fast"; see grower_rounds.py)
     cegb_tradeoff: float = 1.0     # CEGB (reference cost_effective_
     cegb_penalty_split: float = 0.0  # gradient_boosting.hpp:50 DetlaGain)
     cegb_coupled: bool = False     # static: coupled-penalty array passed

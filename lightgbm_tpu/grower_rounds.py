@@ -288,18 +288,7 @@ def grow_tree_rounds(
         # -- monotone bound propagation (see grower.py apply_split)
         leaf_min, leaf_max = c.leaf_min, c.leaf_max
         if use_mc:
-            p_min, p_max = leaf_min, leaf_max
-            l_out = jnp.clip(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
-                                         hp.max_delta_step), p_min, p_max)
-            r_out = jnp.clip(leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
-                                         hp.max_delta_step), p_min, p_max)
-            mid = (l_out + r_out) * 0.5
-            mc_f = mc_j[jnp.clip(feat, 0, F - 1)]
-            upd = (~b.is_categorical) & (mc_f != 0)
-            l_min = jnp.where(upd & (mc_f < 0), jnp.maximum(p_min, mid), p_min)
-            l_max = jnp.where(upd & (mc_f > 0), jnp.minimum(p_max, mid), p_max)
-            r_min = jnp.where(upd & (mc_f > 0), jnp.maximum(p_min, mid), p_min)
-            r_max = jnp.where(upd & (mc_f < 0), jnp.minimum(p_max, mid), p_max)
+            l_min, l_max, r_min, r_max = child_bounds(c)
             leaf_min = _pad_scatter(jnp.where(sel, l_min, leaf_min),
                                     newleaf_of, r_min, sel)
             leaf_max = _pad_scatter(jnp.where(sel, l_max, leaf_max),
@@ -308,6 +297,26 @@ def grow_tree_rounds(
         return Carry(tree, c.best, hist, leaf_sg, leaf_sh, leaf_cnt,
                      leaf_parent_side, new_leaf_id, c.split_idx + k,
                      leaf_min, leaf_max)
+
+    def child_bounds(c: Carry):
+        """Per-leaf monotone bounds the two children of each leaf's cached
+        split would inherit ([L] vectors; see grower.py apply_split)."""
+        b = c.best
+        lg, lh = b.left_sum_grad, b.left_sum_hess
+        rg, rh = b.right_sum_grad, b.right_sum_hess
+        p_min, p_max = c.leaf_min, c.leaf_max
+        l_out = jnp.clip(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
+                                     hp.max_delta_step), p_min, p_max)
+        r_out = jnp.clip(leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
+                                     hp.max_delta_step), p_min, p_max)
+        mid = (l_out + r_out) * 0.5
+        mc_f = mc_j[jnp.clip(b.feature, 0, F - 1)]
+        upd = (~b.is_categorical) & (mc_f != 0)
+        l_min = jnp.where(upd & (mc_f < 0), jnp.maximum(p_min, mid), p_min)
+        l_max = jnp.where(upd & (mc_f > 0), jnp.minimum(p_max, mid), p_max)
+        r_min = jnp.where(upd & (mc_f > 0), jnp.maximum(p_min, mid), p_min)
+        r_max = jnp.where(upd & (mc_f < 0), jnp.minimum(p_max, mid), p_max)
+        return l_min, l_max, r_min, r_max
 
     iota_K = jnp.arange(KCAP, dtype=jnp.int32)
 
@@ -341,8 +350,7 @@ def grow_tree_rounds(
                            b.is_categorical[lof], b.cat_bitset[lof],
                            missing_type[fr], default_bin[fr], num_bin[fr])
         # smaller-child segment histograms: one compacted pass for the
-        # whole round (slot r = the round's r-th split, = the argmax
-        # split's smaller child at r == 0 — the sequential fallback's slice)
+        # whole candidate batch (slot r = the round's r-th candidate)
         small_left = b.left_count <= b.right_count
         selr = sel_b[lof]
         row_small = selr & (gl == small_left[lof])
@@ -350,36 +358,66 @@ def grow_tree_rounds(
         seg = _psum(compacted_segment_histogram(
             binned, grad, hess, row_mask, slot, KCAP, Bg, caps), axis_name)
 
-        cb = apply_round(c, sel_b, rank, k, gl, seg)
-
-        # -- best splits for the round's CHANGED slots only: the k left
-        # children (which keep their leaf index: order[:KCAP]) and the k
-        # new right children
-        valid_k = iota_K < k
-        ids = jnp.concatenate([order[:KCAP], c.tree.num_leaves + iota_K])
-        valid = jnp.concatenate([valid_k, valid_k])
-        idc = jnp.clip(ids, 0, L - 1)
+        # -- candidate children's best splits, BEFORE committing anything:
+        # per-leaf candidates are independent, so lane i's results are
+        # valid under any commit that includes candidate i.  Left children
+        # keep the parent's leaf slot; stats come from the cache.
+        idl = jnp.clip(order[:KCAP], 0, L - 1)          # candidate leaves
+        ph = c.hist[idl]                                # [K, G, Bg, 3]
+        sl = small_left[idl][:, None, None, None]
+        h_left = jnp.where(sl, seg, ph - seg)
+        h_right = ph - h_left
+        lg_, lh_, lc_ = (b.left_sum_grad[idl], b.left_sum_hess[idl],
+                         b.left_count[idl])
+        rg_, rh_, rc_ = (b.right_sum_grad[idl], b.right_sum_hess[idl],
+                         b.right_count[idl])
+        depth_c = c.tree.leaf_depth[idl] + 1
+        if use_mc:
+            bl_min, bl_max, br_min, br_max = child_bounds(c)
+            bmin = jnp.concatenate([bl_min[idl], br_min[idl]])
+            bmax = jnp.concatenate([bl_max[idl], br_max[idl]])
+        else:
+            bmin = bmax = jnp.zeros(2 * KCAP, jnp.float32)
+        node_of_k = c.split_idx + iota_K                # candidate node ids
         res = search_all(
-            cb.hist[idc], cb.leaf_sg[idc], cb.leaf_sh[idc], cb.leaf_cnt[idc],
-            cb.tree.leaf_depth[idc], cb.leaf_min[idc], cb.leaf_max[idc],
-            cb.tree.leaf_parent[idc], cb.leaf_parent_side[idc])
-        cb = cb._replace(best=cache_scatter(c.best, idc, res, valid))
+            jnp.concatenate([h_left, h_right]),
+            jnp.concatenate([lg_, rg_]), jnp.concatenate([lh_, rh_]),
+            jnp.concatenate([lc_, rc_]),
+            jnp.concatenate([depth_c, depth_c]), bmin, bmax,
+            jnp.concatenate([node_of_k, node_of_k]),
+            jnp.concatenate([jnp.zeros(KCAP, jnp.int32),
+                             jnp.ones(KCAP, jnp.int32)]))
 
-        # -- exactness check: would best-first have interleaved a child?
-        child_max = jnp.max(jnp.where(valid, res.gain, -jnp.inf))
-        min_sel = jnp.min(jnp.where(sel_b, gains, jnp.inf))
-        ok = (k <= 1) | (child_max < min_sel)
+        # -- maximal exact prefix: candidate i (in gain order) is the
+        # best-first pop at step i iff its gain >= every child spawned by
+        # candidates 0..i-1 (ties go to the existing leaf: children's leaf
+        # numbers are always larger, and the reference ArgMax takes the
+        # smallest leaf number).
+        cg = jnp.where(jnp.isnan(res.gain), -jnp.inf, res.gain)
+        pair_max = jnp.maximum(cg[:KCAP], cg[KCAP:])
+        pair_max = jnp.where(iota_K < k, pair_max, -jnp.inf)
+        pcm = jax.lax.cummax(pair_max)                  # children of 0..i
+        sel_sorted = -jnp.sort(-gains, stable=True)[:KCAP]   # gains by rank
+        follow = (iota_K == 0) | (sel_sorted >= jnp.concatenate(
+            [jnp.full((1,), -jnp.inf), pcm[:-1]]))
+        if cfg.rounds_relaxed:
+            # "fast" mode: always commit the whole batch.  Deviates from
+            # strict best-first only when a child would have outranked a
+            # batched candidate AND the leaf budget later binds — the same
+            # class of tree-shape deviation the reference accepts between
+            # its CPU and GPU learners.  ~log2(num_leaves) rounds, never a
+            # short prefix.
+            m = k
+        else:
+            m = jnp.minimum(k, jnp.cumprod(
+                follow.astype(jnp.int32)).sum().astype(jnp.int32))
 
-        def fallback(_):
-            # single best-first step: the argmax leaf's results are the
-            # rank-0 lanes of the batched computation
-            sel_s = pos & (rank == 0)
-            cs = apply_round(c, sel_s, rank, jnp.int32(1), gl, seg)
-            lane0 = (iota_K == 0)
-            valid_s = jnp.concatenate([lane0, lane0])
-            return cs._replace(best=cache_scatter(c.best, idc, res, valid_s))
-
-        return lax.cond(ok, lambda _: cb, fallback, None)
+        sel_m = pos & (rank < m)
+        cm = apply_round(c, sel_m, rank, m, gl, seg)
+        idc = jnp.concatenate([idl, jnp.clip(c.tree.num_leaves + iota_K,
+                                             0, L - 1)])
+        valid_m = jnp.concatenate([iota_K < m, iota_K < m])
+        return cm._replace(best=cache_scatter(c.best, idc, res, valid_m))
 
     init = Carry(tree, best, hist_cache, leaf_sg, leaf_sh, leaf_cnt,
                  leaf_parent_side, leaf_id, jnp.array(0, jnp.int32),

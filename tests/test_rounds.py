@@ -179,6 +179,33 @@ def test_rounds_data_parallel_matches_single(problem):
                                   np.asarray(ref_leaf))
 
 
+def test_fast_mode_trains_equivalent_quality():
+    """tpu_tree_growth=fast (no exactness fallback) may pick a different
+    final-level split set, but trained quality must match exact growth."""
+    rng = np.random.RandomState(2)
+    n = 6000
+    X = rng.rand(n, 10).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] - X[:, 3] + 0.2 * rng.randn(n)) > 0.2
+         ).astype(np.float32)
+    Xt, yt = X[:4500], y[:4500]
+    Xv, yv = X[4500:], y[4500:]
+    loss = {}
+    for mode in ("rounds", "fast"):
+        params = {"objective": "binary", "num_leaves": 31, "max_bin": 32,
+                  "metric": "binary_logloss", "verbosity": -1,
+                  "tpu_tree_growth": mode}
+        ds = lgb.Dataset(Xt, label=yt)
+        evals = {}
+        import lightgbm_tpu.callback as cb
+        bst = lgb.train(params, ds, num_boost_round=10,
+                        valid_sets=[ds.create_valid(Xv, label=yv)],
+                        valid_names=["v"],
+                        callbacks=[lgb.record_evaluation(evals)])
+        assert bst.models[0].num_leaves == 31
+        loss[mode] = evals["v"]["binary_logloss"][-1]
+    assert abs(loss["fast"] - loss["rounds"]) < 0.01, loss
+
+
 def test_rounds_engine_matches_serial_model():
     """End-to-end through the engine (incl. EFB bundling and multiple
     boosting iterations): same structures, predictions within float
