@@ -78,6 +78,11 @@ CPU_TREES = int(os.environ.get("BENCH_CPU_TREES", 50))
 SMOKE_N = int(os.environ.get("BENCH_SMOKE_ROWS", 500_000))
 SMOKE_TREES = int(os.environ.get("BENCH_SMOKE_TREES", 3))
 
+# MSLR-shaped ranking stage (BASELINE.md: MS LTR 70.417 s / 500 trees CPU)
+RANK_QUERIES = int(os.environ.get("BENCH_RANK_QUERIES", 12_000))
+RANK_DOCS = int(os.environ.get("BENCH_RANK_DOCS", 100))
+RANK_TREES = int(os.environ.get("BENCH_RANK_TREES", 100))
+
 TOTAL_BUDGET = float(os.environ.get("BENCH_TOTAL_BUDGET", 6600))
 
 # peak dense compute per chip for the MFU estimate (bf16, conservative)
@@ -116,6 +121,83 @@ def error_line(stage, err, extra=None):
     if extra:
         d.update(extra)
     return d
+
+
+def make_mslr_like(n_queries, docs_per_query, f, seed=0):
+    """Synthetic MSLR-WEB30K-shaped ranking data: graded 0-4 relevance from
+    a noisy nonlinear score (the real set is not downloadable here; shape
+    and metric protocol follow docs/Experiments.rst:55-60 / BASELINE.md)."""
+    rng = np.random.RandomState(seed)
+    n = n_queries * docs_per_query
+    w = np.random.RandomState(777).randn(f).astype(np.float32)
+    X = rng.rand(n, f).astype(np.float32)
+    s = X @ w + 1.5 * X[:, 0] * X[:, 1] - X[:, 2] * (X[:, 3] > 0.5)
+    s += rng.randn(n).astype(np.float32) * 0.3 * s.std()
+    # per-query relevance grades: quintile buckets of the score
+    s = s.reshape(n_queries, docs_per_query)
+    order = np.argsort(np.argsort(s, axis=1), axis=1)
+    grade = (order * 5 // docs_per_query).astype(np.float32)
+    group = np.full(n_queries, docs_per_query, np.int32)
+    return X, grade.reshape(-1), group
+
+
+def ndcg_at_k(scores, labels, docs_per_query, k=10):
+    """NDCG@k averaged over equal-size queries (DCGCalculator semantics:
+    gain 2^label-1, log2 position discount)."""
+    s = scores.reshape(-1, docs_per_query)
+    l = labels.reshape(-1, docs_per_query)
+    idx = np.argsort(-s, axis=1)[:, :k]
+    top = np.take_along_axis(l, idx, axis=1)
+    disc = 1.0 / np.log2(np.arange(2, k + 2))
+    dcg = ((2.0 ** top - 1) * disc).sum(axis=1)
+    ideal = np.sort(l, axis=1)[:, ::-1][:, :k]
+    idcg = ((2.0 ** ideal - 1) * disc).sum(axis=1)
+    return float((dcg / np.maximum(idcg, 1e-12)).mean())
+
+
+def run_ranking_bench(n_queries, docs_per_query, trees, leaves, max_bin):
+    """Lambdarank wall-clock + NDCG@10 (the MSLR-side benchmark)."""
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    F = 136                           # MSLR feature count
+    X, y, group = make_mslr_like(n_queries, docs_per_query, F)
+    params = {
+        "objective": "lambdarank",
+        "num_leaves": leaves,
+        "learning_rate": 0.1,
+        "max_bin": max_bin,
+        "metric": "None",
+        "verbosity": -1,
+    }
+    ds = lgb.Dataset(X, label=y, group=group)
+    t0 = time.perf_counter()
+    ds.construct()
+    bin_seconds = time.perf_counter() - t0
+    booster = lgb.Booster(params=params, train_set=ds)
+    t0 = time.perf_counter()
+    booster.update()
+    jax.block_until_ready(booster.boosting.train_score)
+    compile_seconds = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(trees - 1):
+        booster.update()
+    jax.block_until_ready(booster.boosting.train_score)
+    elapsed = (time.perf_counter() - t0) * trees / max(trees - 1, 1)
+    Xh, yh, _ = make_mslr_like(2000, docs_per_query, F, seed=9)
+    pred = booster.predict(Xh, device=True)
+    return {
+        "rows": n_queries * docs_per_query,
+        "queries": n_queries,
+        "features": F,
+        "trees": trees,
+        "train_seconds": round(elapsed, 3),
+        "sec_per_tree": round(elapsed / trees, 4),
+        "compile_seconds": round(compile_seconds, 2),
+        "bin_seconds": round(bin_seconds, 2),
+        "holdout_ndcg@10": round(ndcg_at_k(pred, yh, docs_per_query), 5),
+    }
 
 
 def make_higgs_like(n, f, seed=0):
@@ -337,11 +419,24 @@ def tpu_worker():
         full = run_bench(N, TREES, LEAVES, MAX_BIN)
         full["stage"] = "full"
         emit(full)
-        return 0
     except Exception as e:
         emit({"stage": "full", "error": str(e)[-800:],
               "traceback_tail": traceback.format_exc()[-800:]})
         return 4
+
+    # MSLR-side benchmark (lambdarank + NDCG@10, BASELINE.md) with the
+    # leftover budget — strictly after the headline number is banked
+    if os.environ.get("BENCH_SKIP_RANKING") != "1" and remaining_budget() > 900:
+        try:
+            t1 = time.time()
+            r = run_ranking_bench(RANK_QUERIES, RANK_DOCS, RANK_TREES,
+                                  LEAVES, MAX_BIN)
+            r["stage"] = "ranking"
+            r["elapsed"] = round(time.time() - t1, 1)
+            emit(r)
+        except Exception as e:
+            emit({"stage": "ranking", "error": str(e)[-500:]})
+    return 0
 
 
 class LineReader(threading.Thread):
@@ -533,6 +628,10 @@ def main():
         init = collect(tpu_stages, "init")
         if init:
             tpu_full["backend_init_seconds"] = init.get("elapsed")
+        rank = collect(tpu_stages, "ranking")
+        if rank and "error" not in rank:
+            tpu_full["ranking"] = {k: v for k, v in rank.items()
+                                   if k not in ("stage", "elapsed")}
         if cpu_result and "error" not in cpu_result:
             tpu_full["cpu_reference"] = {
                 "sec_per_tree": cpu_result.get("sec_per_tree"),
