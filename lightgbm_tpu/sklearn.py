@@ -94,6 +94,8 @@ class LGBMModel:
             params["objective"] = "none"
         elif self.objective is None:
             params["objective"] = self._default_objective()
+        self._objective = (self.objective if callable(self.objective)
+                           else params.get("objective", self.objective))
         if self.random_state is not None:
             params["seed"] = (self.random_state if isinstance(self.random_state, int)
                               else 0)
@@ -200,6 +202,14 @@ class LGBMModel:
         if self._Booster is None:
             raise ValueError("No booster found; call fit first")
         return self._Booster
+
+    @property
+    def objective_(self):
+        """The concrete objective used while fitting (reference:
+        sklearn.py:703)."""
+        if self._Booster is None:
+            raise ValueError("No objective found; call fit first")
+        return self._objective
 
     @property
     def best_iteration_(self):
