@@ -134,9 +134,11 @@ def LGBM_DatasetCreateFromSampledColumn(sample_data, num_total_row: int,
 
 @_guard
 def LGBM_DatasetPushRows(dataset_handle: int, data,
-                         start_row: int) -> int:
-    """reference: c_api.h:98 LGBM_DatasetPushRows."""
-    _get(dataset_handle).push_rows(data, start_row=int(start_row))
+                         start_row: int = None) -> int:
+    """reference: c_api.h:98 LGBM_DatasetPushRows (start_row None
+    appends after the previous push)."""
+    sr = None if start_row is None else int(start_row)
+    _get(dataset_handle).push_rows(data, start_row=sr)
     return 0
 
 
@@ -334,3 +336,468 @@ def LGBM_BoosterFree(booster_handle: int) -> int:
     with _lock:
         _handles.pop(booster_handle, None)
     return 0
+
+
+# --------------------------------------------------------------------------
+# round-4 additions: the remaining c_api.h surface
+
+
+def LGBM_SetLastError(msg: str) -> int:
+    """reference: c_api.h LGBM_SetLastError."""
+    _set_error(msg)
+    return 0
+
+
+@_guard
+def LGBM_DatasetCreateByReference(reference_handle: int, num_total_row: int,
+                                  out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_DatasetCreateByReference — an empty aligned
+    dataset to be filled by PushRows."""
+    from .dataset import Dataset
+    ref = _get(reference_handle)
+    ds = Dataset.from_reference_streaming(ref, int(num_total_row))
+    out_handle[:] = [_register(ds)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetPushRowsByCSR(dataset_handle: int, indptr, indices, data,
+                              num_rows: int, start_row: int = None) -> int:
+    """reference: c_api.h:123 — push a CSR block into a streaming dataset."""
+    from scipy import sparse
+    indptr = np.asarray(indptr, np.int64)
+    ds = _get(dataset_handle)
+    ncol = ds.num_total_features or (int(np.max(indices)) + 1 if len(indices) else 0)
+    block = sparse.csr_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32), indptr),
+        shape=(int(num_rows), ncol))
+    ds.push_rows(block, start_row=start_row)
+    return 0
+
+
+@_guard
+def LGBM_DatasetCreateFromCSR(indptr, indices, data, num_rows: int,
+                              num_col: int, parameters: str, label,
+                              reference_handle: int,
+                              out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_DatasetCreateFromCSR."""
+    from scipy import sparse
+    from .dataset import Dataset
+    mat = sparse.csr_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(indptr, np.int64)),
+        shape=(int(num_rows), int(num_col)))
+    ref = _get(reference_handle) if reference_handle else None
+    ds = Dataset(mat, label=label, reference=ref,
+                 params=_parse_params(parameters)).construct()
+    out_handle[:] = [_register(ds)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetCreateFromCSC(col_ptr, indices, data, num_rows: int,
+                              num_col: int, parameters: str, label,
+                              reference_handle: int,
+                              out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_DatasetCreateFromCSC."""
+    from scipy import sparse
+    from .dataset import Dataset
+    mat = sparse.csc_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(col_ptr, np.int64)),
+        shape=(int(num_rows), int(num_col)))
+    ref = _get(reference_handle) if reference_handle else None
+    ds = Dataset(mat, label=label, reference=ref,
+                 params=_parse_params(parameters)).construct()
+    out_handle[:] = [_register(ds)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetCreateFromMats(mats, parameters: str, label,
+                               out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_DatasetCreateFromMats — row-block list."""
+    from .dataset import Dataset
+    data = np.vstack([np.asarray(m) for m in mats])
+    ds = Dataset(data, label=label,
+                 params=_parse_params(parameters)).construct()
+    out_handle[:] = [_register(ds)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetGetSubset(dataset_handle: int, used_row_indices,
+                          parameters: str, out_handle: List[int]) -> int:
+    """reference: c_api.h LGBM_DatasetGetSubset."""
+    ds = _get(dataset_handle)
+    sub = ds.subset(np.asarray(used_row_indices, np.int64),
+                    params=_parse_params(parameters))
+    sub.construct()
+    out_handle[:] = [_register(sub)]
+    return 0
+
+
+@_guard
+def LGBM_DatasetSetFeatureNames(dataset_handle: int, names) -> int:
+    _get(dataset_handle).set_feature_name(list(names))
+    return 0
+
+
+@_guard
+def LGBM_DatasetGetFeatureNames(dataset_handle: int,
+                                out_names: List[str]) -> int:
+    ds = _get(dataset_handle)
+    ds.construct()
+    out_names[:] = list(ds.feature_names)
+    return 0
+
+
+@_guard
+def LGBM_DatasetGetField(dataset_handle: int, field_name: str,
+                         out: List[np.ndarray]) -> int:
+    val = _get(dataset_handle).get_field(str(field_name))
+    out[:] = [val]
+    return 0
+
+
+@_guard
+def LGBM_DatasetAddFeaturesFrom(target_handle: int, source_handle: int) -> int:
+    _get(target_handle).add_features_from(_get(source_handle))
+    return 0
+
+
+@_guard
+def LGBM_DatasetDumpText(dataset_handle: int, filename: str) -> int:
+    _get(dataset_handle)._dump_text(str(filename))
+    return 0
+
+
+@_guard
+def LGBM_DatasetUpdateParamChecking(old_parameters: str,
+                                    new_parameters: str) -> int:
+    """reference: c_api.h LGBM_DatasetUpdateParamChecking — error when a
+    dataset-level parameter changes between boosters sharing a dataset."""
+    from .config import Config
+    old = Config.from_params(_parse_params(old_parameters)).to_dataset_params()
+    new = Config.from_params(_parse_params(new_parameters)).to_dataset_params()
+    diff = {k for k in set(old) | set(new) if old.get(k) != new.get(k)}
+    if diff:
+        return _set_error(
+            f"Cannot change dataset parameters during training: {sorted(diff)}")
+    return 0
+
+
+@_guard
+def LGBM_BoosterMerge(booster_handle: int, other_handle: int) -> int:
+    """reference: c_api.h LGBM_BoosterMerge — append the other booster's
+    trees to this booster's model."""
+    bst = _get(booster_handle)
+    other = _get(other_handle)
+    bst.models.extend(other.models)
+    if bst.boosting is not None:
+        bst.boosting.models_version += 1
+    return 0
+
+
+@_guard
+def LGBM_BoosterResetParameter(booster_handle: int, parameters: str) -> int:
+    _get(booster_handle).reset_parameter(_parse_params(parameters))
+    return 0
+
+
+@_guard
+def LGBM_BoosterResetTrainingData(booster_handle: int,
+                                  train_data_handle: int) -> int:
+    """reference: c_api.h LGBM_BoosterResetTrainingData — swap the training
+    dataset (same bin mappers) keeping the trained model."""
+    import lightgbm_tpu as lgb
+    bst = _get(booster_handle)
+    ds = _get(train_data_handle)
+    # adopt the serialized model's trees on a fresh training state
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    fresh = lgb.Booster(params=dict(bst.params), train_set=ds)
+    fresh.models.extend(loaded.models)
+    fresh.boosting.models_version += 1
+    bst.__dict__.update(fresh.__dict__)
+    return 0
+
+
+@_guard
+def LGBM_BoosterRefit(booster_handle: int, leaf_preds) -> int:
+    """reference: c_api.h LGBM_BoosterRefit."""
+    bst = _get(booster_handle)
+    bst.boosting.refit_leaf_values(np.asarray(leaf_preds),
+                                   bst.config.refit_decay_rate)
+    return 0
+
+
+@_guard
+def LGBM_BoosterShuffleModels(booster_handle: int, start_iter: int,
+                              end_iter: int) -> int:
+    _get(booster_handle).shuffle_models(int(start_iter), int(end_iter))
+    return 0
+
+
+@_guard
+def LGBM_BoosterNumModelPerIteration(booster_handle: int,
+                                     out: List[int]) -> int:
+    out[:] = [_get(booster_handle).num_model_per_iteration()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetNumFeature(booster_handle: int, out: List[int]) -> int:
+    out[:] = [_get(booster_handle).num_feature()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetFeatureNames(booster_handle: int,
+                                out_names: List[str]) -> int:
+    out_names[:] = list(_get(booster_handle).feature_name())
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetEvalCounts(booster_handle: int, out: List[int]) -> int:
+    bst = _get(booster_handle)
+    out[:] = [len(bst.boosting.eval_train())]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetEvalNames(booster_handle: int,
+                             out_names: List[str]) -> int:
+    bst = _get(booster_handle)
+    out_names[:] = [n for (_, n, _, _) in bst.boosting.eval_train()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetLeafValue(booster_handle: int, tree_idx: int,
+                             leaf_idx: int, out: List[float]) -> int:
+    out[:] = [_get(booster_handle).get_leaf_output(int(tree_idx),
+                                                   int(leaf_idx))]
+    return 0
+
+
+@_guard
+def LGBM_BoosterSetLeafValue(booster_handle: int, tree_idx: int,
+                             leaf_idx: int, val: float) -> int:
+    """reference: c_api.h LGBM_BoosterSetLeafValue."""
+    bst = _get(booster_handle)
+    bst.models[int(tree_idx)].leaf_value[int(leaf_idx)] = float(val)
+    if bst.boosting is not None:
+        bst.boosting.models_version += 1
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetUpperBoundValue(booster_handle: int,
+                                   out: List[float]) -> int:
+    out[:] = [_get(booster_handle).upper_bound()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetLowerBoundValue(booster_handle: int,
+                                   out: List[float]) -> int:
+    out[:] = [_get(booster_handle).lower_bound()]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetNumPredict(booster_handle: int, data_idx: int,
+                              out: List[int]) -> int:
+    """reference: c_api.h LGBM_BoosterGetNumPredict — size of the inner
+    score vector for the data_idx-th dataset."""
+    bst = _get(booster_handle)
+    b = bst.boosting
+    score = b.train_score if data_idx == 0 else b.valid_scores[data_idx - 1]
+    out[:] = [int(np.prod(np.asarray(score).shape))]
+    return 0
+
+
+@_guard
+def LGBM_BoosterGetPredict(booster_handle: int, data_idx: int,
+                           out_result: List[np.ndarray]) -> int:
+    """reference: c_api.h LGBM_BoosterGetPredict — inner raw scores kept
+    for the training / validation datasets."""
+    bst = _get(booster_handle)
+    b = bst.boosting
+    score = b.train_score if data_idx == 0 else b.valid_scores[data_idx - 1]
+    n = b.num_data if data_idx == 0 else None
+    s = np.asarray(score)
+    if n is not None and s.shape[-1] >= n:
+        s = s[..., :n]
+    out_result[:] = [s.reshape(-1)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterCalcNumPredict(booster_handle: int, num_row: int,
+                               predict_type: int, num_iteration: int,
+                               out: List[int]) -> int:
+    """reference: c_api.h LGBM_BoosterCalcNumPredict."""
+    bst = _get(booster_handle)
+    K = bst.num_tree_per_iteration
+    total_iter = len(bst.models) // max(K, 1)
+    ni = total_iter if num_iteration <= 0 else min(int(num_iteration),
+                                                   total_iter)
+    if predict_type == 2:      # leaf indices
+        per_row = ni * K
+    elif predict_type == 3:    # SHAP contribs
+        per_row = (bst.num_features() + 1) * max(bst.num_class, 1)
+    else:
+        per_row = max(bst.num_class, 1)
+    out[:] = [int(num_row) * per_row]
+    return 0
+
+
+def _predict_with_type(bst, data, predict_type, num_iteration):
+    kwargs = {}
+    if predict_type == 1:
+        kwargs["raw_score"] = True
+    elif predict_type == 2:
+        kwargs["pred_leaf"] = True
+    elif predict_type == 3:
+        kwargs["pred_contrib"] = True
+    ni = None if num_iteration <= 0 else int(num_iteration)
+    return bst.predict(data, num_iteration=ni, **kwargs)
+
+
+@_guard
+def LGBM_BoosterPredictForCSR(booster_handle: int, indptr, indices, data,
+                              num_rows: int, num_col: int, predict_type: int,
+                              num_iteration: int,
+                              out_result: List[np.ndarray]) -> int:
+    from scipy import sparse
+    mat = sparse.csr_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(indptr, np.int64)),
+        shape=(int(num_rows), int(num_col)))
+    out_result[:] = [_predict_with_type(_get(booster_handle), mat,
+                                        predict_type, num_iteration)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterPredictForCSC(booster_handle: int, col_ptr, indices, data,
+                              num_rows: int, num_col: int, predict_type: int,
+                              num_iteration: int,
+                              out_result: List[np.ndarray]) -> int:
+    from scipy import sparse
+    mat = sparse.csc_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(col_ptr, np.int64)),
+        shape=(int(num_rows), int(num_col))).tocsr()
+    out_result[:] = [_predict_with_type(_get(booster_handle), mat,
+                                        predict_type, num_iteration)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterPredictForCSRSingleRow(booster_handle: int, indptr, indices,
+                                       data, num_col: int, predict_type: int,
+                                       num_iteration: int,
+                                       out_result: List[np.ndarray]) -> int:
+    return LGBM_BoosterPredictForCSR(booster_handle, indptr, indices, data,
+                                     1, num_col, predict_type, num_iteration,
+                                     out_result)
+
+
+@_guard
+def LGBM_BoosterPredictForMatSingleRow(booster_handle: int, row,
+                                       predict_type: int, num_iteration: int,
+                                       out_result: List[np.ndarray]) -> int:
+    out_result[:] = [_predict_with_type(
+        _get(booster_handle), np.asarray(row).reshape(1, -1), predict_type,
+        num_iteration)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterPredictForMats(booster_handle: int, rows, predict_type: int,
+                               num_iteration: int,
+                               out_result: List[np.ndarray]) -> int:
+    data = np.vstack([np.asarray(r).reshape(1, -1) for r in rows])
+    out_result[:] = [_predict_with_type(_get(booster_handle), data,
+                                        predict_type, num_iteration)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterPredictForFile(booster_handle: int, data_filename: str,
+                               data_has_header: int, predict_type: int,
+                               num_iteration: int,
+                               result_filename: str) -> int:
+    """reference: c_api.h LGBM_BoosterPredictForFile — predictions written
+    one row per line (tab-separated for multi-output)."""
+    from .dataset import Dataset
+    from .io_utils import load_text_dataset
+    tmp = Dataset(None, params={"header": bool(data_has_header)})
+    X = load_text_dataset(str(data_filename), tmp)
+    pred = _predict_with_type(_get(booster_handle), X, predict_type,
+                              num_iteration)
+    pred = np.asarray(pred)
+    from .utils.file_io import open_file
+    with open_file(str(result_filename), "w") as fh:
+        for row in (pred if pred.ndim > 1 else pred[:, None]):
+            fh.write("\t".join(repr(float(v)) for v in row) + "\n")
+    return 0
+
+
+@_guard
+def LGBM_BoosterDumpModel(booster_handle: int, start_iteration: int,
+                          num_iteration: int, out_str: List[str]) -> int:
+    """reference: c_api.h LGBM_BoosterDumpModel (JSON)."""
+    import json
+    ni = None if num_iteration <= 0 else int(num_iteration)
+    d = _get(booster_handle).dump_model(num_iteration=ni,
+                                        start_iteration=int(start_iteration))
+    out_str[:] = [json.dumps(d)]
+    return 0
+
+
+@_guard
+def LGBM_BoosterFeatureImportance(booster_handle: int, num_iteration: int,
+                                  importance_type: int,
+                                  out: List[np.ndarray]) -> int:
+    """reference: c_api.h LGBM_BoosterFeatureImportance — importance_type
+    0 = split counts, 1 = total gain."""
+    ni = None if num_iteration <= 0 else int(num_iteration)
+    kind = "gain" if importance_type == 1 else "split"
+    out[:] = [_get(booster_handle).feature_importance(kind,
+                                                      iteration=ni)]
+    return 0
+
+
+def _network_noop(what: str) -> int:
+    from .utils.log import log_warning
+    log_warning(
+        f"{what} is a no-op in lightgbm_tpu: socket/MPI machine lists are "
+        "replaced by the JAX device mesh (configure tree_learner=data/"
+        "feature/voting under a multi-device JAX runtime)")
+    return 0
+
+
+def LGBM_NetworkInit(machines: str, local_listen_port: int,
+                     listen_time_out: int, num_machines: int) -> int:
+    """reference: c_api.h LGBM_NetworkInit (socket transport)."""
+    return _network_noop("LGBM_NetworkInit")
+
+
+def LGBM_NetworkFree() -> int:
+    """reference: c_api.h LGBM_NetworkFree."""
+    return _network_noop("LGBM_NetworkFree")
+
+
+def LGBM_NetworkInitWithFunctions(num_machines: int, rank: int,
+                                  reduce_scatter_ext_fun,
+                                  allgather_ext_fun) -> int:
+    """reference: c_api.h:1036 — external collective injection (the Spark/
+    Dask seam).  The TPU build's collectives are XLA psum/all_gather inside
+    the jitted step; external function injection cannot compose with that,
+    so this reports the mesh-based equivalent instead of silently dropping
+    the functions."""
+    return _network_noop("LGBM_NetworkInitWithFunctions")

@@ -311,6 +311,34 @@ class Dataset:
         ds._append_cursor = 0
         return ds
 
+    @classmethod
+    def from_reference_streaming(cls, reference: "Dataset",
+                                 num_total_rows: int,
+                                 params=None) -> "Dataset":
+        """Empty streaming Dataset aligned with ``reference``'s binning
+        (reference: LGBM_DatasetCreateByReference, c_api.h) — fill with
+        ``push_rows``."""
+        ref = reference.construct()
+        ds = cls(None, reference=reference, params=params)
+        ds.num_data = int(num_total_rows)
+        ds.num_total_features = ref.num_total_features
+        ds.feature_names = list(ref.feature_names)
+        ds.bin_mappers = ref.bin_mappers
+        ds.used_features = ref.used_features
+        ds.feat_group = ref.feat_group
+        ds.feat_start = ref.feat_start
+        ds.num_groups = ref.num_groups
+        ds._group_size = ref._group_size
+        ds.group_num_bin = ref.group_num_bin
+        ds.max_group_bin = ref.max_group_bin
+        dtype = np.uint8 if ds.max_group_bin <= 256 else np.uint16
+        ds.binned = np.zeros((ds.num_data, ds.num_groups), dtype=dtype)
+        ds.raw_data = None
+        ds._pushed = np.zeros(ds.num_data, bool)
+        ds._streaming = True
+        ds._append_cursor = 0
+        return ds
+
     def push_rows(self, chunk, start_row: Optional[int] = None) -> "Dataset":
         """Bin a block of raw rows into [start_row, start_row+len) of the
         preallocated matrix (reference: LGBM_DatasetPushRows, c_api.h:98).

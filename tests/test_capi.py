@@ -100,3 +100,141 @@ def test_custom_objective_update():
         score = bst.predict(X, raw_score=True).astype(np.float32)
     mse = float(np.mean((score - y) ** 2))
     assert mse < float(np.mean((0 - y) ** 2))
+
+
+def test_round4_capi_surface(tmp_path):
+    """The remaining c_api.h surface: CSR create, subset, by-reference
+    streaming, predict variants, dump/importance/bounds/leaf access,
+    merge/shuffle, param checking, network shims."""
+    from scipy import sparse
+
+    X, y = _data(1200, 6)
+    sp = sparse.csr_matrix(X)
+    dh, bh = [0], [0]
+    assert capi.LGBM_DatasetCreateFromCSR(
+        sp.indptr, sp.indices, sp.data, X.shape[0], X.shape[1],
+        "max_bin=31 min_data_in_leaf=5", y, 0, dh) == 0
+    nd = [0]
+    assert capi.LGBM_DatasetGetNumData(dh[0], nd) == 0 and nd[0] == 1200
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=7 verbosity=-1 metric=auc",
+        bh) == 0
+    fin = [0]
+    for _ in range(5):
+        assert capi.LGBM_BoosterUpdateOneIter(bh[0], fin) == 0
+
+    # eval names/counts
+    names, cnt = [], [0]
+    assert capi.LGBM_BoosterGetEvalCounts(bh[0], cnt) == 0 and cnt[0] == 1
+    assert capi.LGBM_BoosterGetEvalNames(bh[0], names) == 0
+    assert names == ["auc"]
+
+    # feature names / num feature
+    fnames, nf = [], [0]
+    assert capi.LGBM_BoosterGetNumFeature(bh[0], nf) == 0 and nf[0] == 6
+    assert capi.LGBM_BoosterGetFeatureNames(bh[0], fnames) == 0
+    assert len(fnames) == 6
+
+    # predict variants agree
+    p_mat, p_csr, p_row, p_mats = [None], [None], [None], [None]
+    assert capi.LGBM_BoosterPredictForMat(bh[0], X[:8], 0, -1, p_mat) == 0
+    s8 = sparse.csr_matrix(X[:8])
+    assert capi.LGBM_BoosterPredictForCSR(
+        bh[0], s8.indptr, s8.indices, s8.data, 8, 6, 0, -1, p_csr) == 0
+    np.testing.assert_allclose(p_csr[0], p_mat[0], rtol=1e-6)
+    assert capi.LGBM_BoosterPredictForMatSingleRow(
+        bh[0], X[0], 0, -1, p_row) == 0
+    np.testing.assert_allclose(p_row[0][0], p_mat[0][0], rtol=1e-6)
+    assert capi.LGBM_BoosterPredictForMats(
+        bh[0], [X[0], X[1]], 0, -1, p_mats) == 0
+    np.testing.assert_allclose(p_mats[0], p_mat[0][:2], rtol=1e-6)
+
+    # calc num predict: leaf and contrib sizes
+    out = [0]
+    assert capi.LGBM_BoosterCalcNumPredict(bh[0], 10, 2, -1, out) == 0
+    assert out[0] == 10 * 5
+    assert capi.LGBM_BoosterCalcNumPredict(bh[0], 10, 3, -1, out) == 0
+    assert out[0] == 10 * 7
+
+    # dump / importance / bounds / leaf values
+    js = [None]
+    assert capi.LGBM_BoosterDumpModel(bh[0], 0, -1, js) == 0
+    import json
+    assert len(json.loads(js[0])["tree_info"]) == 5
+    imp = [None]
+    assert capi.LGBM_BoosterFeatureImportance(bh[0], -1, 0, imp) == 0
+    assert imp[0].sum() > 0
+    lo, hi = [0.0], [0.0]
+    assert capi.LGBM_BoosterGetLowerBoundValue(bh[0], lo) == 0
+    assert capi.LGBM_BoosterGetUpperBoundValue(bh[0], hi) == 0
+    assert lo[0] <= hi[0]
+    lv = [0.0]
+    assert capi.LGBM_BoosterGetLeafValue(bh[0], 0, 0, lv) == 0
+    assert capi.LGBM_BoosterSetLeafValue(bh[0], 0, 0, lv[0] + 1.0) == 0
+    lv2 = [0.0]
+    assert capi.LGBM_BoosterGetLeafValue(bh[0], 0, 0, lv2) == 0
+    assert abs(lv2[0] - lv[0] - 1.0) < 1e-9
+
+    # inner predict scores
+    npred, scores = [0], [None]
+    assert capi.LGBM_BoosterGetNumPredict(bh[0], 0, npred) == 0
+    assert capi.LGBM_BoosterGetPredict(bh[0], 0, scores) == 0
+    assert scores[0].shape[0] == 1200
+
+    # merge + shuffle
+    bh2 = [0]
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=7 verbosity=-1", bh2) == 0
+    assert capi.LGBM_BoosterUpdateOneIter(bh2[0], fin) == 0
+    total = [0]
+    assert capi.LGBM_BoosterMerge(bh[0], bh2[0]) == 0
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh[0], total) == 0
+    assert total[0] == 6
+    assert capi.LGBM_BoosterShuffleModels(bh[0], 0, -1) == 0
+
+    # subset
+    sub = [0]
+    assert capi.LGBM_DatasetGetSubset(
+        dh[0], np.arange(100, 300), "", sub) == 0
+    assert capi.LGBM_DatasetGetNumData(sub[0], nd) == 0 and nd[0] == 200
+
+    # by-reference streaming push
+    ref_stream = [0]
+    assert capi.LGBM_DatasetCreateByReference(dh[0], 100, ref_stream) == 0
+    assert capi.LGBM_DatasetPushRows(ref_stream[0], X[:60], None) == 0
+    s2 = sparse.csr_matrix(X[60:100])
+    assert capi.LGBM_DatasetPushRowsByCSR(
+        ref_stream[0], s2.indptr, s2.indices, s2.data, 40, None) == 0
+    assert capi.LGBM_DatasetGetNumData(ref_stream[0], nd) == 0
+
+    # param checking
+    assert capi.LGBM_DatasetUpdateParamChecking(
+        "max_bin=31", "max_bin=31 learning_rate=0.2") == 0
+    assert capi.LGBM_DatasetUpdateParamChecking(
+        "max_bin=31", "max_bin=63") == -1
+    assert "max_bin" in capi.LGBM_GetLastError()
+
+    # predict-for-file round trip
+    data_f = tmp_path / "pred_in.csv"
+    np.savetxt(data_f, X[:10], delimiter=",", fmt="%.6f")
+    out_f = tmp_path / "pred_out.txt"
+    assert capi.LGBM_BoosterPredictForFile(
+        bh[0], str(data_f), 0, 0, -1, str(out_f)) == 0
+    got = np.loadtxt(out_f)
+    assert got.shape[0] == 10
+
+    # dataset field get + feature names + dump text
+    field = [None]
+    assert capi.LGBM_DatasetGetField(dh[0], "label", field) == 0
+    assert field[0].shape[0] == 1200
+    assert capi.LGBM_DatasetSetFeatureNames(
+        dh[0], [f"f{i}" for i in range(6)]) == 0
+    got_names = []
+    assert capi.LGBM_DatasetGetFeatureNames(dh[0], got_names) == 0
+    assert got_names == [f"f{i}" for i in range(6)]
+    assert capi.LGBM_DatasetDumpText(dh[0], str(tmp_path / "dump.txt")) == 0
+
+    # network shims accept calls without crashing
+    assert capi.LGBM_NetworkInit("ip1:1,ip2:2", 12400, 120, 2) == 0
+    assert capi.LGBM_NetworkFree() == 0
+    assert capi.LGBM_NetworkInitWithFunctions(2, 0, None, None) == 0
