@@ -288,7 +288,13 @@ def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS) -> list:
     O(n * num_leaves) (full masked pass per split) to ~O(n * log(num_leaves))
     — the same asymptotic the reference gets from per-leaf ordered gradients
     (src/io/dataset.cpp:1318-1333) without data-dependent shapes.
+
+    The ladder stops at ``max(min_cap, n/256)``: every rung is a compiled
+    branch of a ``lax.switch`` (XLA compile time scales with them), and a
+    histogram pass over n/256 rows is already noise next to the per-loop-
+    step overhead the compaction exists to avoid.
     """
+    min_cap = max(min_cap, _pad_rows(max(n, 1), min_cap) // 256)
     caps = []
     c = _pad_rows(n, min_cap)
     while c >= min_cap:
