@@ -96,6 +96,18 @@ class Application:
         p.pop("__config_dir__", None)
 
         cfg = Config.from_params(p)
+        if cfg.num_machines > 1:
+            # reference: Application ctor calls Network::Init ONLY when
+            # num_machines > 1 (src/application/application.cpp:96-98) —
+            # stock example confs list mlist.txt at num_machines=1 and
+            # expect it ignored
+            from .parallel.network import init_network
+            init_network(machines=cfg.machines or None,
+                         local_listen_port=cfg.local_listen_port,
+                         listen_time_out=cfg.time_out,
+                         num_machines=cfg.num_machines or None,
+                         machine_list_file=(cfg.machine_list_filename
+                                            or None))
         train_set = Dataset(_resolve(data_path, self.params), params=p)
         valid_sets = [Dataset(_resolve(v, self.params), params=p,
                               reference=train_set) for v in valid_paths]

@@ -648,15 +648,20 @@ class Booster:
     def free_dataset(self) -> "Booster":
         return self
 
-    def free_network(self) -> "Booster":
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """Start the multi-host JAX runtime from a reference-style machine
+        list (reference: Booster.set_network, basic.py:1867 ->
+        LGBM_NetworkInit; here it maps onto jax.distributed — see
+        parallel/network.py)."""
+        from .parallel.network import init_network
+        init_network(machines=machines, local_listen_port=local_listen_port,
+                     listen_time_out=listen_time_out,
+                     num_machines=num_machines)
         return self
 
-    def set_network(self, *args, **kwargs) -> "Booster":
-        from .utils.log import log_warning
-        log_warning(
-            "set_network is a no-op in lightgbm_tpu: socket/MPI machine "
-            "lists are replaced by the JAX device mesh — configure "
-            "tree_learner=data/feature/voting and run under a multi-device "
-            "JAX runtime instead (reference: Booster.set_network, "
-            "basic.py:1867 -> LGBM_NetworkInit)")
+    def free_network(self) -> "Booster":
+        from .parallel.network import free_network
+        free_network()
         return self

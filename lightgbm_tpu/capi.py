@@ -772,24 +772,23 @@ def LGBM_BoosterFeatureImportance(booster_handle: int, num_iteration: int,
     return 0
 
 
-def _network_noop(what: str) -> int:
-    from .utils.log import log_warning
-    log_warning(
-        f"{what} is a no-op in lightgbm_tpu: socket/MPI machine lists are "
-        "replaced by the JAX device mesh (configure tree_learner=data/"
-        "feature/voting under a multi-device JAX runtime)")
+@_guard
+def LGBM_NetworkInit(machines: str, local_listen_port: int,
+                     listen_time_out: int, num_machines: int) -> int:
+    """reference: c_api.h LGBM_NetworkInit (socket transport) — here the
+    machine list starts the multi-host JAX runtime (parallel/network.py)."""
+    from .parallel.network import init_network
+    init_network(machines=machines, local_listen_port=local_listen_port,
+                 listen_time_out=listen_time_out, num_machines=num_machines)
     return 0
 
 
-def LGBM_NetworkInit(machines: str, local_listen_port: int,
-                     listen_time_out: int, num_machines: int) -> int:
-    """reference: c_api.h LGBM_NetworkInit (socket transport)."""
-    return _network_noop("LGBM_NetworkInit")
-
-
+@_guard
 def LGBM_NetworkFree() -> int:
     """reference: c_api.h LGBM_NetworkFree."""
-    return _network_noop("LGBM_NetworkFree")
+    from .parallel.network import free_network
+    free_network()
+    return 0
 
 
 def LGBM_NetworkInitWithFunctions(num_machines: int, rank: int,
@@ -800,4 +799,9 @@ def LGBM_NetworkInitWithFunctions(num_machines: int, rank: int,
     the jitted step; external function injection cannot compose with that,
     so this reports the mesh-based equivalent instead of silently dropping
     the functions."""
-    return _network_noop("LGBM_NetworkInitWithFunctions")
+    from .utils.log import log_warning
+    log_warning(
+        "LGBM_NetworkInitWithFunctions: external collective injection is "
+        "replaced by XLA collectives over the device mesh; use "
+        "LGBM_NetworkInit (jax.distributed) + tree_learner=data instead")
+    return 0

@@ -1,0 +1,143 @@
+"""Multi-host (DCN) runtime initialization from reference-style configs.
+
+The reference's distributed story is `Network::Init` over a socket/MPI
+machine list (src/network/linkers_socket.cpp:23-188: parse `machine_list`,
+bind `local_listen_port`, all-to-all connect).  The TPU build's transport
+IS the JAX runtime: collectives run as XLA psum/all_gather over ICI within
+a slice and DCN across hosts, and multi-host process wiring is
+``jax.distributed.initialize(coordinator, num_processes, process_id)``.
+This module maps the reference's config surface (``machines`` /
+``machine_list_filename`` / ``local_listen_port`` / ``num_machines``,
+config.h:190-210) onto that call, so a LightGBM-style machine list starts
+a multi-host JAX mesh:
+
+- the FIRST machine in the list is the coordinator (the reference's rank-0
+  by list order, linkers_socket.cpp:64-76);
+- this process's rank is its position in the list, matched by local
+  hostname/IP (the reference matches on the bound interface);
+- after ``init_network``, ``jax.devices()`` spans all hosts and the
+  data/feature/voting learners shard over the global mesh unchanged —
+  their collectives are already expressed over Mesh axes.
+
+``Booster.set_network`` and the CLI route here.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import List, Optional, Tuple
+
+from ..utils.log import log_info, log_warning
+
+
+def parse_machine_list(machines: Optional[str] = None,
+                       machine_list_file: Optional[str] = None) -> List[Tuple[str, int]]:
+    """reference: Linkers::Linkers reads `machines` ("ip1:port1,ip2:port2")
+    or one host:port per line of `machine_list_filename`
+    (linkers_socket.cpp:23-63)."""
+    entries: List[str] = []
+    if machines:
+        entries = [tok for tok in str(machines).replace("\n", ",").split(",")
+                   if tok.strip()]
+    elif machine_list_file:
+        from ..utils.file_io import open_file
+        with open_file(machine_list_file) as fh:
+            entries = [ln.strip() for ln in fh.read().splitlines()
+                       if ln.strip()]
+    out = []
+    for e in entries:
+        host, _, port = e.strip().partition(":")
+        out.append((host, int(port) if port else 12400))
+    return out
+
+
+def _local_identifiers() -> set:
+    ids = {"localhost", "127.0.0.1", socket.gethostname()}
+    try:
+        ids.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    try:
+        ids.update(i[4][0] for i in socket.getaddrinfo(
+            socket.gethostname(), None))
+    except OSError:
+        pass
+    return ids
+
+
+def resolve_rank(machine_list: List[Tuple[str, int]],
+                 local_listen_port: Optional[int] = None) -> int:
+    """This process's rank = its position in the machine list (the
+    reference matches the bound interface+port, linkers_socket.cpp:64-76).
+    When several entries share the local host (multi-process-per-host),
+    ``local_listen_port`` disambiguates."""
+    local = _local_identifiers()
+    matches = [i for i, (h, p) in enumerate(machine_list) if h in local]
+    if not matches:
+        raise ValueError(
+            f"none of the machine-list hosts {[h for h, _ in machine_list]} "
+            f"matches this host ({sorted(local)}); set machines= to include "
+            "this machine")
+    if len(matches) > 1 and local_listen_port is not None:
+        port_matches = [i for i in matches
+                        if machine_list[i][1] == local_listen_port]
+        if port_matches:
+            return port_matches[0]
+    return matches[0]
+
+
+def init_network(machines: Optional[str] = None,
+                 local_listen_port: Optional[int] = None,
+                 listen_time_out: int = 120,
+                 num_machines: Optional[int] = None,
+                 machine_list_file: Optional[str] = None,
+                 dry_run: bool = False):
+    """Start the multi-host JAX runtime from a reference-style machine list.
+
+    reference seam: Network::Init (network.cpp:29-58) /
+    LGBM_NetworkInit (c_api.h).  Returns (coordinator_address,
+    num_processes, process_id); with ``dry_run`` nothing is initialized
+    (for tests and introspection).
+    """
+    ml = parse_machine_list(machines, machine_list_file)
+    if not ml and num_machines in (None, 0, 1):
+        log_warning("init_network: no machine list and num_machines<=1; "
+                    "nothing to do")
+        return None
+    if not ml:
+        raise ValueError("init_network needs machines= or machine_list_file=")
+    n = num_machines or len(ml)
+    if n > len(ml):
+        raise ValueError(
+            f"num_machines={n} but machine list has {len(ml)} entries")
+    ml = ml[:n]
+    rank = resolve_rank(ml, local_listen_port)
+    host0, port0 = ml[0]
+    coordinator = f"{host0}:{port0}"
+    if dry_run:
+        return coordinator, n, rank
+    import jax
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        log_warning("init_network: jax.distributed already initialized")
+        return coordinator, n, rank
+    if n == 1:
+        log_info("init_network: single machine; skipping jax.distributed")
+        return coordinator, n, rank
+    os.environ.setdefault("JAX_COORDINATION_SERVICE_TIMEOUT_SECS",
+                          str(int(listen_time_out)))
+    log_info(f"init_network: jax.distributed.initialize("
+             f"{coordinator!r}, num_processes={n}, process_id={rank})")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=n, process_id=rank)
+    return coordinator, n, rank
+
+
+def free_network() -> None:
+    """reference: Network::Dispose / LGBM_NetworkFree."""
+    import jax
+    try:
+        if getattr(jax.distributed, "is_initialized", lambda: False)():
+            jax.distributed.shutdown()
+    except Exception as e:   # noqa: BLE001 — best-effort teardown
+        log_warning(f"free_network: {e}")

@@ -234,7 +234,13 @@ def test_round4_capi_surface(tmp_path):
     assert got_names == [f"f{i}" for i in range(6)]
     assert capi.LGBM_DatasetDumpText(dh[0], str(tmp_path / "dump.txt")) == 0
 
-    # network shims accept calls without crashing
-    assert capi.LGBM_NetworkInit("ip1:1,ip2:2", 12400, 120, 2) == 0
+    # network entry points: single-machine init is a clean no-op; a list
+    # without this host reports the error through LGBM_GetLastError
+    import socket
+    assert capi.LGBM_NetworkInit(
+        f"{socket.gethostname()}:12400", 12400, 120, 1) == 0
+    assert capi.LGBM_NetworkInit("10.255.1.1:1,10.255.1.2:2", 12400, 120,
+                                 2) == -1
+    assert "matches this host" in capi.LGBM_GetLastError()
     assert capi.LGBM_NetworkFree() == 0
     assert capi.LGBM_NetworkInitWithFunctions(2, 0, None, None) == 0

@@ -395,3 +395,26 @@ def test_engine_data_parallel_ranking_matches_serial(objective):
                                rtol=1e-4, atol=1e-5)
     assert abs(ev_s["valid_0"]["ndcg@5"][-1]
                - ev_d["valid_0"]["ndcg@5"][-1]) < 1e-3
+
+
+def test_network_machine_list_mapping():
+    """Reference machine-list configs map onto jax.distributed wiring
+    (parallel/network.py; reference linkers_socket.cpp:23-76)."""
+    import socket
+    from lightgbm_tpu.parallel.network import (init_network,
+                                               parse_machine_list,
+                                               resolve_rank)
+    ml = parse_machine_list("10.0.0.1:12400,10.0.0.2:12401")
+    assert ml == [("10.0.0.1", 12400), ("10.0.0.2", 12401)]
+    host = socket.gethostname()
+    ml2 = parse_machine_list(f"10.0.0.1:12400,{host}:12401")
+    assert resolve_rank(ml2) == 1
+    out = init_network(machines=f"10.0.0.1:12400,{host}:12401",
+                       num_machines=2, dry_run=True)
+    assert out == ("10.0.0.1:12400", 2, 1)
+    # multi-process-per-host: port disambiguates
+    ml3 = parse_machine_list(f"{host}:12400,{host}:12401")
+    assert resolve_rank(ml3, local_listen_port=12401) == 1
+    import pytest
+    with pytest.raises(ValueError):
+        resolve_rank([("10.9.9.9", 1)])
