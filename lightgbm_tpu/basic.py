@@ -445,7 +445,12 @@ class Booster:
         imp = np.zeros(F, np.float64)
         K = self.num_tree_per_iteration
         models = self.models
-        stop = len(models) if not iteration else iteration * K
+        if iteration is None:
+            # reference: Booster.feature_importance defaults to
+            # best_iteration (basic.py:2744)
+            iteration = self.best_iteration
+        stop = len(models) if iteration is None or iteration <= 0 \
+            else iteration * K
         for ht in models[:stop]:
             ns = ht.num_leaves - 1
             for s in range(ns):
@@ -579,7 +584,12 @@ class Booster:
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0) -> str:
-        return save_model_to_string(self)
+        # reference: Booster.save_model/model_to_string default
+        # num_iteration to best_iteration (basic.py:2407,2490) so an
+        # early-stopped model round-trips at its best point
+        if num_iteration is None:
+            num_iteration = self.best_iteration
+        return save_model_to_string(self, num_iteration, start_iteration)
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
@@ -596,8 +606,16 @@ class Booster:
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> dict:
-        """JSON model dump (reference: gbdt_model_text.cpp:21 DumpModel)."""
-        models = self.models
+        """JSON model dump (reference: gbdt_model_text.cpp:21 DumpModel;
+        num_iteration defaults to best_iteration, basic.py:2536)."""
+        if num_iteration is None:
+            num_iteration = self.best_iteration
+        K = max(self.num_tree_per_iteration, 1)
+        total_iter = len(self.models) // K
+        start = max(0, int(start_iteration))
+        stop = (total_iter if num_iteration <= 0
+                else min(total_iter, start + int(num_iteration)))
+        models = self.models[start * K: stop * K]
 
         def node_to_dict(t: HostTree, node: int) -> dict:
             if node < 0:

@@ -318,7 +318,9 @@ def LGBM_BoosterPredictForMat(booster_handle: int, data, predict_type: int,
 @_guard
 def LGBM_BoosterSaveModel(booster_handle: int, start_iteration: int,
                           num_iteration: int, filename: str) -> int:
-    ni = None if num_iteration <= 0 else int(num_iteration)
+    # C semantics: num_iteration <= 0 saves ALL iterations (the Python
+    # layer's best_iteration defaulting happens above this ABI)
+    ni = int(num_iteration)
     _get(booster_handle).save_model(str(filename), num_iteration=ni,
                                     start_iteration=int(start_iteration))
     return 0
@@ -752,7 +754,7 @@ def LGBM_BoosterDumpModel(booster_handle: int, start_iteration: int,
                           num_iteration: int, out_str: List[str]) -> int:
     """reference: c_api.h LGBM_BoosterDumpModel (JSON)."""
     import json
-    ni = None if num_iteration <= 0 else int(num_iteration)
+    ni = int(num_iteration)          # <= 0 dumps all (C semantics)
     d = _get(booster_handle).dump_model(num_iteration=ni,
                                         start_iteration=int(start_iteration))
     out_str[:] = [json.dumps(d)]
@@ -765,7 +767,7 @@ def LGBM_BoosterFeatureImportance(booster_handle: int, num_iteration: int,
                                   out: List[np.ndarray]) -> int:
     """reference: c_api.h LGBM_BoosterFeatureImportance — importance_type
     0 = split counts, 1 = total gain."""
-    ni = None if num_iteration <= 0 else int(num_iteration)
+    ni = int(num_iteration)          # <= 0 covers all (C semantics)
     kind = "gain" if importance_type == 1 else "split"
     out[:] = [_get(booster_handle).feature_importance(kind,
                                                       iteration=ni)]

@@ -49,9 +49,23 @@ def tree_to_string(t: HostTree) -> str:
     return "\n".join(lines)
 
 
-def save_model_to_string(booster) -> str:
-    """booster: lightgbm_tpu.basic.Booster (or GBDT-like with .models)."""
+def save_model_to_string(booster, num_iteration=None,
+                         start_iteration: int = 0) -> str:
+    """booster: lightgbm_tpu.basic.Booster (or GBDT-like with .models).
+
+    ``num_iteration``/``start_iteration`` slice whole boosting iterations
+    (reference: GBDT::SaveModelToString start_iteration/num_iteration,
+    gbdt_model_text.cpp:301; num_iteration <= 0 means all remaining).
+    """
     b = booster
+    K = max(b.num_tree_per_iteration, 1)
+    total_iter = len(b.models) // K
+    start = max(0, int(start_iteration))
+    if num_iteration is None or num_iteration <= 0:
+        stop = total_iter
+    else:
+        stop = min(total_iter, start + int(num_iteration))
+    models = b.models[start * K: stop * K]
     ss: List[str] = []
     ss.append(b.sub_model_name)
     ss.append(f"version={MODEL_VERSION}")
@@ -67,7 +81,7 @@ def save_model_to_string(booster) -> str:
     ss.append("feature_infos=" + " ".join(b.feature_infos))
 
     tree_strs = []
-    for i, t in enumerate(b.models):
+    for i, t in enumerate(models):
         tree_strs.append(f"Tree={i}\n" + tree_to_string(t) + "\n")
     sizes = [len(s) for s in tree_strs]
     ss.append("tree_sizes=" + " ".join(map(str, sizes)))
