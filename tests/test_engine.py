@@ -496,3 +496,23 @@ def test_refit(binary_data, tmp_path):
     refit2 = loaded.refit(Xt, yt, decay_rate=0.0)
     np.testing.assert_allclose(refit2.predict(Xt), refitted.predict(Xt),
                                rtol=1e-5, atol=1e-7)
+
+
+def test_early_stopped_model_round_trips_at_best_iteration(binary_data):
+    """reference: Booster.save_model defaults num_iteration=best_iteration
+    (basic.py:2407) — a save/load round trip must not change predictions."""
+    X, y, Xt, yt = binary_data
+    tr = lgb.Dataset(X, label=y)
+    va = tr.create_valid(Xt, label=yt)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "metric": "auc", "verbosity": -1},
+                    tr, num_boost_round=50, valid_sets=[va],
+                    callbacks=[lgb.early_stopping(3, verbose=False)])
+    assert 0 < bst.best_iteration < 50
+    pred = bst.predict(X)
+    re = lgb.Booster(model_str=bst.model_to_string())
+    assert re.num_trees() == bst.best_iteration
+    np.testing.assert_allclose(re.predict(X), pred, rtol=1e-9)
+    # explicit num_iteration=0 still saves everything
+    full = lgb.Booster(model_str=bst.model_to_string(num_iteration=0))
+    assert full.num_trees() == bst.num_trees()
