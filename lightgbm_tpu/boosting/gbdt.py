@@ -626,12 +626,16 @@ class GBDT:
             return new_score, stacked, jnp.stack(leaf_ids), cegb_used, cegb_rows
 
         if self._mesh is None:
-            def one_iter(score, row_mask, grad, hess, fmask, lr, rng,
-                         cegb_used, cegb_rows):
-                return iter_body(self.binned, score, row_mask, grad, hess,
+            # binned rides as an explicit jit argument: a closed-over
+            # device array would be captured as a program CONSTANT, and at
+            # HIGGS scale (11M x 28 = 308 MB) constant-embedding bloats
+            # lowering/compile
+            def one_iter(binned, score, row_mask, grad, hess, fmask, lr,
+                         rng, cegb_used, cegb_rows):
+                return iter_body(binned, score, row_mask, grad, hess,
                                  fmask, lr, rng, label_a, weight_a,
                                  cegb_used, cegb_rows, None, None)
-            self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
+            self._iter_fn = jax.jit(one_iter, donate_argnums=(1,))
         else:
             from jax.sharding import PartitionSpec as P
             ax_d, ax_f = self._data_axis, self._feature_axis
@@ -653,12 +657,12 @@ class GBDT:
                 out_specs=(krow, P(), krow, P(), rows_spec),
                 check_vma=False)
 
-            def one_iter(score, row_mask, grad, hess, fmask, lr, rng,
-                         cegb_used, cegb_rows):
-                return sharded(self.binned, score, row_mask, grad, hess,
+            def one_iter(binned, score, row_mask, grad, hess, fmask, lr,
+                         rng, cegb_used, cegb_rows):
+                return sharded(binned, score, row_mask, grad, hess,
                                fmask, lr, rng, label_a, weight_a,
                                cegb_used, cegb_rows)
-            self._iter_fn = jax.jit(one_iter, donate_argnums=(0,))
+            self._iter_fn = jax.jit(one_iter, donate_argnums=(1,))
         if not hasattr(self, "_feature_rng"):  # survive jit-fn rebuilds
             self._feature_rng = np.random.RandomState(
                 self.config.feature_fraction_seed)
@@ -828,9 +832,9 @@ class GBDT:
         with global_timer.section("TreeLearner::Train(dispatch)"):
             (self.train_score, stacked, leaf_ids,
              *self._cegb_state) = self._iter_fn(
-                self.train_score, mask, grad, hess, self._feature_masks(),
-                jnp.float32(self.shrinkage_rate), self._node_key(),
-                *self._cegb_state)
+                self.binned, self.train_score, mask, grad, hess,
+                self._feature_masks(), jnp.float32(self.shrinkage_rate),
+                self._node_key(), *self._cegb_state)
         return self._finish_iter(stacked)
 
     def _node_key(self):

@@ -67,7 +67,7 @@ class GOSS(GBDT):
     def _train_with(self, grad, hess, mask):
         (self.train_score, stacked, leaf_ids,
          *self._cegb_state) = self._iter_fn(
-            self.train_score, mask, grad, hess, self._feature_masks(),
-            jnp.float32(self.shrinkage_rate), self._node_key(),
-            *self._cegb_state)
+            self.binned, self.train_score, mask, grad, hess,
+            self._feature_masks(), jnp.float32(self.shrinkage_rate),
+            self._node_key(), *self._cegb_state)
         return self._finish_iter(stacked)

@@ -56,7 +56,7 @@ class RF(GBDT):
         # renormalize to the running mean including the per-tree bias
         s1 = self.train_score * it
         s2, stacked, _, *self._cegb_state = self._iter_fn(
-            s1, mask, self._grad, self._hess,
+            self.binned, s1, mask, self._grad, self._hess,
             self._feature_masks(), jnp.float32(1.0),
             self._node_key(), *self._cegb_state)
         init_col = jnp.asarray(self.init_scores, jnp.float32)[:, None]
