@@ -45,14 +45,33 @@ def detect_format(path: str, num_probe_lines: int = 32) -> Tuple[str, bool]:
     return fmt, has_header
 
 
+
+def _resolve_label_and_columns(params, names, n_cols, dataset=None):
+    """Label / ignore-column / feature-name resolution shared by the
+    one-shot and two-round text loaders (the rules must never diverge)."""
+    label_spec = params.get("label_column", params.get("label", 0))
+    label_idx = _resolve_column(label_spec, names, default=0)
+    keep = [i for i in range(n_cols) if i != label_idx]
+    ignore = params.get("ignore_column", params.get("ignore_feature"))
+    if ignore:
+        ignored = {_resolve_column(c, names) for c in str(ignore).split(",")}
+        keep = [i for i in keep if i not in ignored]
+    if dataset is not None:
+        fn_param = getattr(dataset, "_feature_name_param", "auto")
+        if fn_param not in ("auto", None):
+            dataset.feature_names = list(fn_param)
+        elif names:
+            dataset.feature_names = [names[i] for i in keep]
+    return label_idx, keep
+
+
 def load_text_dataset(path: str, dataset) -> np.ndarray:
     """Load a text file into a dense float matrix; sets label/weight/group on
     ``dataset`` from the label column and sidecar files.  Returns features."""
     params = dataset.params
     fmt, has_header = detect_format(path)
-    header_override = params.get("header", None)
-    if header_override is not None:
-        has_header = bool(header_override)
+    if params.get("header", None) is not None:
+        has_header = _param_bool(params, "header")
 
     if fmt == "libsvm":
         X, y = _load_libsvm(path)
@@ -67,17 +86,10 @@ def load_text_dataset(path: str, dataset) -> np.ndarray:
                          na_values=["nan", "NA", "na", ""])
         names = [str(c) for c in df.columns] if has_header else None
         mat = df.to_numpy(dtype=np.float64)
-        label_spec = params.get("label_column", params.get("label", 0))
-        label_idx = _resolve_column(label_spec, names, default=0)
+        label_idx, keep = _resolve_label_and_columns(
+            params, names, mat.shape[1], dataset)
         labels = mat[:, label_idx].astype(np.float32) if label_idx is not None else None
-        keep = [i for i in range(mat.shape[1]) if i != label_idx]
-        ignore = params.get("ignore_column", params.get("ignore_feature"))
-        if ignore:
-            ignored = {_resolve_column(c, names) for c in str(ignore).split(",")}
-            keep = [i for i in keep if i not in ignored]
         data = mat[:, keep]
-        if names:
-            dataset.feature_names = [names[i] for i in keep]
 
     if labels is not None and dataset.metadata.label is None:
         dataset.metadata.label = labels
@@ -202,20 +214,8 @@ def load_text_dataset_two_round(path: str, dataset,
             names = [str(c) for c in chunk.columns]
         mat = chunk.to_numpy(dtype=np.float64)
         if label_idx is None:
-            label_spec = params.get("label_column", params.get("label", 0))
-            label_idx = _resolve_column(label_spec, names, default=0)
-            keep = [i for i in range(mat.shape[1]) if i != label_idx]
-            ignore = params.get("ignore_column",
-                                params.get("ignore_feature"))
-            if ignore:
-                ignored = {_resolve_column(c, names)
-                           for c in str(ignore).split(",")}
-                keep = [i for i in keep if i not in ignored]
-            fn_param = dataset._feature_name_param
-            if fn_param not in ("auto", None):
-                dataset.feature_names = list(fn_param)
-            elif names:
-                dataset.feature_names = [names[i] for i in keep]
+            label_idx, keep = _resolve_label_and_columns(
+                params, names, mat.shape[1], dataset)
         if label_idx is not None:
             labels.append(mat[:, label_idx].astype(np.float32))
         feats = mat[:, keep]
