@@ -216,9 +216,14 @@ class Booster:
 
     def eval(self, data: Dataset, name: str, feval=None):
         """Evaluate on ``data``, which must be the training set or an added
-        validation set (reference: Booster.eval, basic.py:2274)."""
+        validation set (reference: Booster.eval, basic.py:2274; results
+        carry the CALLER's name)."""
         if data is self.train_set:
-            return self.eval_train(feval)
+            out = [(name, n, v, h)
+                   for (_, n, v, h) in self.boosting.eval_train()]
+            return out + self._custom_eval(feval, name,
+                                           self.boosting.train_score,
+                                           self.train_set)
         for i, vs in enumerate(self.boosting.valid_sets):
             if vs is data:
                 out = [(name, mn, mv, h)

@@ -99,10 +99,14 @@ def _parse_params(parameters: str) -> dict:
 @_guard
 def LGBM_DatasetCreateFromMat(data, parameters: str, label,
                               out_handle: List[int]) -> int:
-    """reference: c_api.h LGBM_DatasetCreateFromMat."""
+    """reference: c_api.h LGBM_DatasetCreateFromMat.  Accepts
+    ``free_raw_data=false`` in the parameter string (needed for
+    LGBM_BoosterResetTrainingData's score replay)."""
     from .dataset import Dataset
-    ds = Dataset(np.asarray(data), label=label,
-                 params=_parse_params(parameters))
+    params = _parse_params(parameters)
+    keep_raw = not params.pop("free_raw_data", True)
+    ds = Dataset(np.asarray(data), label=label, params=params,
+                 free_raw_data=not keep_raw)
     out_handle[:] = [_register(ds)]
     return 0
 
@@ -513,13 +517,18 @@ def LGBM_BoosterResetTrainingData(booster_handle: int,
     """reference: c_api.h LGBM_BoosterResetTrainingData — swap the training
     dataset (same bin mappers) keeping the trained model."""
     import lightgbm_tpu as lgb
+    from .engine import _apply_init_model
     bst = _get(booster_handle)
     ds = _get(train_data_handle)
-    # adopt the serialized model's trees on a fresh training state
-    loaded = lgb.Booster(model_str=bst.model_to_string())
+    # continued-training semantics: adopt the trees AND replay their score
+    # contributions on the new data (GBDT::ResetTrainingData replays
+    # AddScore for every existing model, src/boosting/gbdt.cpp:648) —
+    # otherwise the next UpdateOneIter would fit gradients as if the
+    # model were empty.  Requires the new dataset's raw features
+    # (free_raw_data=False) for the replay.
+    loaded = lgb.Booster(model_str=bst.model_to_string(num_iteration=0))
     fresh = lgb.Booster(params=dict(bst.params), train_set=ds)
-    fresh.models.extend(loaded.models)
-    fresh.boosting.models_version += 1
+    _apply_init_model(fresh, loaded, ds)
     bst.__dict__.update(fresh.__dict__)
     return 0
 
@@ -560,18 +569,27 @@ def LGBM_BoosterGetFeatureNames(booster_handle: int,
     return 0
 
 
+def _eval_names(bst) -> List[str]:
+    """Metric names, computed once per booster (some metrics expand to
+    several outputs, e.g. ndcg@k, so the emitted names come from one
+    evaluation pass and are then cached — they never change afterwards)."""
+    cache = getattr(bst, "_capi_eval_names", None)
+    if cache is None:
+        cache = [n for (_, n, _, _) in bst.boosting.eval_train()]
+        bst._capi_eval_names = cache
+    return cache
+
+
 @_guard
 def LGBM_BoosterGetEvalCounts(booster_handle: int, out: List[int]) -> int:
-    bst = _get(booster_handle)
-    out[:] = [len(bst.boosting.eval_train())]
+    out[:] = [len(_eval_names(_get(booster_handle)))]
     return 0
 
 
 @_guard
 def LGBM_BoosterGetEvalNames(booster_handle: int,
                              out_names: List[str]) -> int:
-    bst = _get(booster_handle)
-    out_names[:] = [n for (_, n, _, _) in bst.boosting.eval_train()]
+    out_names[:] = list(_eval_names(_get(booster_handle)))
     return 0
 
 
@@ -608,15 +626,23 @@ def LGBM_BoosterGetLowerBoundValue(booster_handle: int,
     return 0
 
 
+def _inner_scores(bst, data_idx: int) -> np.ndarray:
+    """Inner raw scores for a dataset, trimmed of any device row padding
+    (train_score is padded to the sharding multiple, _n_pad)."""
+    b = bst.boosting
+    if data_idx == 0:
+        return np.asarray(b.train_score)[..., :b.num_data].reshape(-1)
+    s = np.asarray(b.valid_scores[data_idx - 1])
+    nv = b.valid_sets[data_idx - 1].num_data
+    return s[..., :nv].reshape(-1)
+
+
 @_guard
 def LGBM_BoosterGetNumPredict(booster_handle: int, data_idx: int,
                               out: List[int]) -> int:
     """reference: c_api.h LGBM_BoosterGetNumPredict — size of the inner
     score vector for the data_idx-th dataset."""
-    bst = _get(booster_handle)
-    b = bst.boosting
-    score = b.train_score if data_idx == 0 else b.valid_scores[data_idx - 1]
-    out[:] = [int(np.prod(np.asarray(score).shape))]
+    out[:] = [int(_inner_scores(_get(booster_handle), data_idx).size)]
     return 0
 
 
@@ -625,14 +651,7 @@ def LGBM_BoosterGetPredict(booster_handle: int, data_idx: int,
                            out_result: List[np.ndarray]) -> int:
     """reference: c_api.h LGBM_BoosterGetPredict — inner raw scores kept
     for the training / validation datasets."""
-    bst = _get(booster_handle)
-    b = bst.boosting
-    score = b.train_score if data_idx == 0 else b.valid_scores[data_idx - 1]
-    n = b.num_data if data_idx == 0 else None
-    s = np.asarray(score)
-    if n is not None and s.shape[-1] >= n:
-        s = s[..., :n]
-    out_result[:] = [s.reshape(-1)]
+    out_result[:] = [_inner_scores(_get(booster_handle), data_idx)]
     return 0
 
 

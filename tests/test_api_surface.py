@@ -105,7 +105,7 @@ def test_booster_attr_and_train_data_name(trained):
 def test_booster_eval_on_datasets(trained):
     _, _, ds, dv, bst = trained
     tr = bst.eval(ds, "anything")
-    assert tr and tr[0][0] == "training"
+    assert tr and tr[0][0] == "anything"   # reference uses the passed name
     ev = bst.eval(dv, "renamed")
     assert ev and ev[0][0] == "renamed"
     assert ev[0][1] == "binary_logloss"

@@ -244,3 +244,40 @@ def test_round4_capi_surface(tmp_path):
     assert "matches this host" in capi.LGBM_GetLastError()
     assert capi.LGBM_NetworkFree() == 0
     assert capi.LGBM_NetworkInitWithFunctions(2, 0, None, None) == 0
+
+
+def test_reset_training_data_replays_scores():
+    """LGBM_BoosterResetTrainingData must keep the existing trees' score
+    contributions (GBDT::ResetTrainingData replays AddScore)."""
+    X, y = _data(1500, 4, seed=3)
+    dh, bh = [0], [0]
+    assert capi.LGBM_DatasetCreateFromMat(
+        X, "max_bin=31 free_raw_data=false", y, dh) == 0
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=7 verbosity=-1 metric=binary_logloss",
+        bh) == 0
+    fin = [0]
+    for _ in range(5):
+        assert capi.LGBM_BoosterUpdateOneIter(bh[0], fin) == 0
+    ev0 = []
+    assert capi.LGBM_BoosterGetEval(bh[0], 0, ev0) == 0
+
+    X2, y2 = _data(1500, 4, seed=4)
+    dh2 = [0]
+    assert capi.LGBM_DatasetCreateFromMat(
+        X2, "max_bin=31 free_raw_data=false", y2, dh2) == 0
+    assert capi.LGBM_BoosterResetTrainingData(bh[0], dh2[0]) == 0
+    # training continues from the existing model: its first eval on the
+    # new data must be much better than an untrained model's (replayed
+    # scores), and further iterations must improve it
+    ev1 = []
+    assert capi.LGBM_BoosterGetEval(bh[0], 0, ev1) == 0
+    assert ev1[0] < 0.6                     # logloss with replayed model
+    for _ in range(3):
+        assert capi.LGBM_BoosterUpdateOneIter(bh[0], fin) == 0
+    ev2 = []
+    assert capi.LGBM_BoosterGetEval(bh[0], 0, ev2) == 0
+    assert ev2[0] < ev1[0]
+    total = [0]
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh[0], total) == 0
+    assert total[0] == 8
