@@ -397,7 +397,7 @@ def grow_tree_rounds(
         pair_max = jnp.maximum(cg[:KCAP], cg[KCAP:])
         pair_max = jnp.where(iota_K < k, pair_max, -jnp.inf)
         pcm = jax.lax.cummax(pair_max)                  # children of 0..i
-        sel_sorted = -jnp.sort(-gains, stable=True)[:KCAP]   # gains by rank
+        sel_sorted = gains[idl]                         # gains by rank
         follow = (iota_K == 0) | (sel_sorted >= jnp.concatenate(
             [jnp.full((1,), -jnp.inf), pcm[:-1]]))
         if cfg.rounds_relaxed:

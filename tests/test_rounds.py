@@ -241,3 +241,22 @@ def test_rounds_engine_matches_serial_model():
     assert structures(dumps["serial"]) == structures(dumps["rounds"])
     np.testing.assert_allclose(preds["serial"], preds["rounds"],
                                rtol=2e-4, atol=2e-6)
+
+
+def test_rounds_goss_matches_serial():
+    """GOSS amplified weights flow through the rounds grower's weighted
+    smaller-child selection identically to serial growth."""
+    rng = np.random.RandomState(4)
+    n = 5000
+    X = rng.rand(n, 8).astype(np.float32)
+    y = ((X[:, 0] * X[:, 1] + 0.1 * rng.randn(n)) > 0.25).astype(np.float32)
+    preds = {}
+    for mode in ("serial", "rounds"):
+        params = {"objective": "binary", "boosting": "goss",
+                  "top_rate": 0.3, "other_rate": 0.2, "num_leaves": 15,
+                  "max_bin": 32, "verbosity": -1, "tpu_tree_growth": mode,
+                  "learning_rate": 0.2}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=12)
+        preds[mode] = bst.predict(X)
+    np.testing.assert_allclose(preds["serial"], preds["rounds"],
+                               rtol=2e-4, atol=2e-6)
