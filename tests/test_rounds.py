@@ -260,3 +260,43 @@ def test_rounds_goss_matches_serial():
         preds[mode] = bst.predict(X)
     np.testing.assert_allclose(preds["serial"], preds["rounds"],
                                rtol=2e-4, atol=2e-6)
+
+
+def test_rounds_equals_serial_categorical():
+    """Categorical splits (one-hot + sorted many-vs-many bitsets) through
+    the batched partition's per-row bitset path."""
+    rng = np.random.RandomState(9)
+    n = 5000
+    Xnum = rng.rand(n, 4).astype(np.float32)
+    cat1 = rng.randint(0, 12, n)
+    cat2 = rng.randint(0, 5, n)
+    X = np.column_stack([Xnum, cat1, cat2]).astype(np.float32)
+    eff = np.array([0.9, -0.4, 0.1, 0.6, -0.8, 0.2, 0.5, -0.3, 0.0, 0.7,
+                    -0.6, 0.4])
+    y = ((X[:, 0] + eff[cat1] + 0.3 * (cat2 == 2) + 0.15 * rng.randn(n))
+         > 0.5).astype(np.float32)
+    dumps, preds = {}, {}
+    for mode in ("serial", "rounds"):
+        params = {"objective": "binary", "num_leaves": 15, "max_bin": 32,
+                  "verbosity": -1, "tpu_tree_growth": mode,
+                  "categorical_feature": [4, 5],
+                  "min_data_per_group": 10, "cat_smooth": 5.0}
+        bst = lgb.train(params, lgb.Dataset(
+            X, label=y, categorical_feature=[4, 5]), num_boost_round=6)
+        dumps[mode] = bst.dump_model()
+        preds[mode] = bst.predict(X)
+
+    def structures(d):
+        out = []
+        def walk(nd):
+            if "split_feature" in nd:
+                out.append((nd["split_feature"], nd.get("threshold"),
+                            nd.get("decision_type")))
+                walk(nd["left_child"]); walk(nd["right_child"])
+        for t in d["tree_info"]:
+            walk(t["tree_structure"])
+        return out
+
+    assert structures(dumps["serial"]) == structures(dumps["rounds"])
+    np.testing.assert_allclose(preds["serial"], preds["rounds"],
+                               rtol=2e-4, atol=2e-6)
