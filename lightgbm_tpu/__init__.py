@@ -10,7 +10,7 @@ Public API mirrors the reference Python package (lightgbm):
 Dataset, Booster, train, cv, sklearn-style estimators, callbacks, plotting.
 """
 
-from .basic import Booster
+from .basic import Booster, LightGBMError
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
@@ -20,8 +20,8 @@ from .engine import CVBooster, cv, train
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dataset", "Booster", "Config", "train", "cv", "CVBooster",
-    "early_stopping", "print_evaluation", "record_evaluation",
+    "Dataset", "Booster", "Config", "LightGBMError", "train", "cv",
+    "CVBooster", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException",
 ]
 

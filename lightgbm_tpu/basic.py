@@ -25,6 +25,10 @@ from .tree import HostTree
 from .utils.log import log_info, set_verbosity
 
 
+class LightGBMError(Exception):
+    """Error thrown by this package (reference: basic.py:158)."""
+
+
 class Booster:
     def __init__(self, params: Optional[dict] = None,
                  train_set: Optional[Dataset] = None,
@@ -325,8 +329,23 @@ class Booster:
         the reference (src/boosting/prediction_early_stop.cpp).
         """
         from .utils.timer import global_timer
+        if isinstance(data, str):
+            # file-path prediction input (reference: Predictor reads the
+            # data file through the parsers, src/application/predictor.hpp)
+            from .io_utils import load_text_dataset
+            tmp = Dataset(None, params=dict(self.params))
+            data = load_text_dataset(data, tmp)
         if hasattr(data, "values"):
             data = data.values
+        n_feat = (data.shape[1] if hasattr(data, "shape")
+                  and len(getattr(data, "shape", ())) == 2 else None)
+        if (n_feat is not None and n_feat != self.num_features()
+                and not kwargs.get("predict_disable_shape_check")):
+            raise LightGBMError(
+                f"The number of features in data ({n_feat}) is not the same "
+                f"as it was in training data ({self.num_features()}).\n"
+                "You can set ``predict_disable_shape_check=true`` to discard "
+                "this error, but please be aware what you are doing.")
         if hasattr(data, "tocsr"):  # scipy sparse: chunked densify
             from .predict import predict_csr_chunked
             return predict_csr_chunked(

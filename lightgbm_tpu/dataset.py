@@ -38,6 +38,11 @@ def _as_2d(data) -> np.ndarray:
     """
     if hasattr(data, "values"):  # pandas
         data = data.values
+    if isinstance(data, (list, tuple)) and data and all(
+            isinstance(a, np.ndarray) for a in data):
+        # list of row-chunk arrays (reference: list-of-numpy input,
+        # basic.py __init_from_list_np2d)
+        data = np.vstack([np.atleast_2d(a) for a in data])
     arr = np.asarray(data)
     if arr.ndim != 2:
         raise ValueError(f"data must be 2-D, got shape {arr.shape}")
@@ -96,16 +101,19 @@ class Dataset:
         self,
         data,
         label=None,
-        *,
         reference: Optional["Dataset"] = None,
         weight=None,
         group=None,
         init_score=None,
+        silent: bool = False,
         feature_name="auto",
         categorical_feature="auto",
         params: Optional[dict] = None,
         free_raw_data: bool = True,
     ):
+        # positional order mirrors the reference Dataset.__init__
+        # (python-package/lightgbm/basic.py:730) — callers pass reference/
+        # weight/group positionally; ``silent`` accepted for compatibility
         self.params = dict(params or {})
         self.raw_data = data
         self.reference = reference
@@ -610,9 +618,10 @@ class Dataset:
                 "Both source and target Datasets must be constructed "
                 "before adding features")
         if self.num_data != other.num_data:
-            raise ValueError(
-                f"Cannot add features from a Dataset with {other.num_data} "
-                f"rows to one with {self.num_data} rows")
+            from .basic import LightGBMError
+            raise LightGBMError(
+                f"Cannot add features from {other.num_data}-row Dataset to "
+                f"{self.num_data}-row Dataset")
         base = self.num_total_features
         self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
         self.used_features = list(self.used_features) + [

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Run the REFERENCE package's own python test suite against lightgbm_tpu
+# via a module shim (import lightgbm -> lightgbm_tpu).
+#
+# Status on this image (2026-07-30): test_basic.py 7 passed, 3 failed —
+# every failure is the modern-sklearn API break in the OLD tests
+# (load_breast_cancer(True) positional / load_boston removed), not a
+# package gap.  test_engine.py / test_sklearn.py cannot even import on
+# modern sklearn (load_boston).  Re-run after any API-surface change.
+set -e
+cd "$(dirname "$0")/.."
+SHIM_DIR=$(mktemp -d)
+cat > "$SHIM_DIR/refshim.py" <<EOF
+import sys
+sys.path.insert(0, "$(pwd)")
+from lightgbm_tpu.utils.platform import force_cpu_inprocess
+force_cpu_inprocess(1)
+import lightgbm_tpu
+sys.modules["lightgbm"] = lightgbm_tpu
+EOF
+PYTHONPATH="$SHIM_DIR" python -m pytest -p refshim \
+    /root/reference/tests/python_package_test/test_basic.py \
+    -q -o cache_dir="$SHIM_DIR/.pc" "$@"
