@@ -10,6 +10,7 @@ Public API mirrors the reference Python package (lightgbm):
 Dataset, Booster, train, cv, sklearn-style estimators, callbacks, plotting.
 """
 
+from . import compat  # noqa: F401  (optional-dependency flags)
 from .basic import Booster, LightGBMError
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)

@@ -62,6 +62,42 @@ class Booster:
 
     def _init_train(self, train_set: Dataset) -> None:
         ds_params = self.config.to_dataset_params()
+        if train_set.constructed:
+            # reference: LGBM_DatasetUpdateParamChecking — dataset-level
+            # parameters cannot change once the dataset is constructed
+            # UNLESS the raw data is still around to rebuild from;
+            # min_data_in_leaf may grow, or shrink when feature_pre_filter
+            # was off (the pre-filter dropped features using the old value)
+            old = Config.from_params(train_set.params).to_dataset_params()
+            explicit = {Config.canonical_key(k) for k in self.params}
+            _ck = {"categorical_feature": "categorical_column"}
+            diff = {k for k, v in ds_params.items()
+                    if _ck.get(k, k) in explicit and old.get(k) != v}
+            if diff and train_set.raw_data is not None:
+                # rebuild the dataset under the new parameters (the
+                # reference re-creates the handle when raw data is kept)
+                train_set.params.update({k: ds_params[k] for k in diff})
+                train_set.constructed = False
+                train_set.binned = None
+            else:
+                for k in sorted(diff):
+                    if k == "min_data_in_leaf":
+                        nv, ov = ds_params[k], old.get(k, 0)
+                        if nv > ov or not old.get("feature_pre_filter",
+                                                  True):
+                            train_set.params[k] = nv
+                            continue
+                        raise LightGBMError(
+                            "Reducing `min_data_in_leaf` with "
+                            "`feature_pre_filter=true` may cause "
+                            "unexpected behaviour for features that were "
+                            "pre-filtered by the larger "
+                            "`min_data_in_leaf`.")
+                    disp = {"is_sparse": "is_enable_sparse",
+                            "forcedbins_filename": "forced bins"}.get(k, k)
+                    raise LightGBMError(
+                        f"Cannot change {disp} after constructed Dataset "
+                        "handle.")
         merged = dict(ds_params)
         merged.update(train_set.params)
         train_set.params = merged
@@ -332,9 +368,9 @@ class Booster:
         if isinstance(data, str):
             # file-path prediction input (reference: Predictor reads the
             # data file through the parsers, src/application/predictor.hpp)
-            from .io_utils import load_text_dataset
-            tmp = Dataset(None, params=dict(self.params))
-            data = load_text_dataset(data, tmp)
+            from .io_utils import load_prediction_file
+            data = load_prediction_file(data, self.num_features(),
+                                        dict(self.params))
         if hasattr(data, "values"):
             data = data.values
         n_feat = (data.shape[1] if hasattr(data, "shape")
@@ -488,46 +524,94 @@ class Booster:
         return imp
 
     def trees_to_dataframe(self):
-        """reference: basic.py:1906."""
+        """Preorder node table over the model dump — the reference's exact
+        column set (basic.py:1906: tree_index, node_depth, node_index,
+        children, parent_index, split fields, missing handling,
+        value/weight/count)."""
         import pandas as pd
-        rows = []
+        if self.num_trees() == 0:
+            raise LightGBMError(
+                "There are no trees in this Booster and thus nothing "
+                "to parse")
         fnames = self.feature_name()
-        for ti, t in enumerate(self.models):
-            for s in range(t.num_leaves - 1):
-                rows.append({
-                    "tree_index": ti, "node_index": f"{ti}-S{s}",
-                    "split_feature": fnames[int(t.split_feature[s])],
-                    "threshold": float(t.threshold[s]),
-                    "split_gain": float(t.split_gain[s]),
-                    "internal_value": float(t.internal_value[s]),
-                    "internal_count": int(t.internal_count[s]),
-                    "decision_type": "<=",
-                })
-            for l in range(t.num_leaves):
-                rows.append({
-                    "tree_index": ti, "node_index": f"{ti}-L{l}",
-                    "split_feature": None, "threshold": None, "split_gain": None,
-                    "internal_value": float(t.leaf_value[l]),
-                    "internal_count": int(t.leaf_count[l]) if len(t.leaf_count) else 0,
-                    "decision_type": None,
-                })
+
+        def is_split(nd):
+            return "split_index" in nd
+
+        def nidx(nd, ti):
+            kind = "S" if is_split(nd) else "L"
+            num = nd.get("split_index" if is_split(nd) else "leaf_index", 0)
+            return f"{ti}-{kind}{num}"
+
+        rows = []
+
+        def walk(nd, ti, depth, parent):
+            rec = {
+                "tree_index": ti, "node_depth": depth,
+                "node_index": nidx(nd, ti), "left_child": None,
+                "right_child": None, "parent_index": parent,
+                "split_feature": (fnames[nd["split_feature"]]
+                                  if is_split(nd) else None),
+                "split_gain": None, "threshold": None, "decision_type": None,
+                "missing_direction": None, "missing_type": None,
+                "value": None, "weight": None, "count": None,
+            }
+            if is_split(nd):
+                rec.update(
+                    left_child=nidx(nd["left_child"], ti),
+                    right_child=nidx(nd["right_child"], ti),
+                    split_gain=nd["split_gain"], threshold=nd["threshold"],
+                    decision_type=nd["decision_type"],
+                    missing_direction=("left" if nd["default_left"]
+                                       else "right"),
+                    missing_type=nd["missing_type"],
+                    value=nd["internal_value"], weight=nd["internal_weight"],
+                    count=nd["internal_count"])
+                rows.append(rec)
+                walk(nd["left_child"], ti, depth + 1, rec["node_index"])
+                walk(nd["right_child"], ti, depth + 1, rec["node_index"])
+            else:
+                rec["value"] = nd["leaf_value"]
+                if parent is not None:
+                    # single-node trees keep weight/count as None
+                    # (reference _is_single_node_tree, basic.py:1944)
+                    rec["weight"] = nd.get("leaf_weight")
+                    rec["count"] = nd.get("leaf_count")
+                rows.append(rec)
+
+        for t in self.dump_model()["tree_info"]:
+            walk(t["tree_structure"], t["tree_index"], 1, None)
         return pd.DataFrame(rows)
 
-    def get_split_value_histogram(self, feature, bins=None):
-        """reference: basic.py get_split_value_histogram."""
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style=False):
+        """reference: basic.py:2762 get_split_value_histogram (incl. the
+        xgboost_style (SplitValue, Count) table form)."""
         fnames = self.feature_name()
         fidx = fnames.index(feature) if isinstance(feature, str) else int(feature)
         vals = []
         for t in self.models:
             for s in range(t.num_leaves - 1):
-                if int(t.split_feature[s]) == fidx and \
-                        not (int(t.decision_type[s]) & 1):
+                if int(t.split_feature[s]) == fidx:
+                    if int(t.decision_type[s]) & 1:
+                        raise LightGBMError(
+                            "Cannot compute split value histogram for the "
+                            "categorical feature")
                     vals.append(float(t.threshold[s]))
-        vals = np.asarray(vals)
-        if bins is None:
-            bins = max(min(len(vals), 10), 1) if len(vals) else 1
-        hist, edges = np.histogram(vals, bins=bins) if len(vals) else (np.zeros(1, int), np.array([0.0, 1.0]))
-        return hist, edges
+        if bins is None or (isinstance(bins, int) and xgboost_style):
+            n_unique = len(np.unique(vals))
+            bins = max(min(n_unique, bins) if bins is not None else n_unique,
+                       1)
+        hist, bin_edges = np.histogram(vals, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((bin_edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            try:
+                import pandas as pd
+                return pd.DataFrame(ret, columns=["SplitValue", "Count"])
+            except ImportError:
+                return ret
+        return hist, bin_edges
 
     # -------------------------------------------------------------- model IO
 
@@ -685,7 +769,33 @@ class Booster:
         }
 
     def __copy__(self):
-        return self
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _memo):
+        """reference: Booster.__deepcopy__ — a model-string round trip."""
+        return Booster(model_str=self.model_to_string(num_iteration=0))
+
+    def __getstate__(self):
+        """Pickle as the serialized model plus light host state (the live
+        boosting state holds device buffers and ctypes handles)."""
+        return {
+            "params": self.params,
+            "best_iteration": self.best_iteration,
+            "best_score": self.best_score,
+            "_attr": self._attr,
+            "_train_data_name": self._train_data_name,
+            "model_str": self.model_to_string(num_iteration=0),
+        }
+
+    def __setstate__(self, state):
+        model_str = state.pop("model_str")
+        self.__dict__.update(state)
+        self.config = Config.from_params(dict(self.params))
+        self._loaded = None
+        self.boosting = None
+        self.train_set = None
+        self.name_valid_sets = []
+        self._init_from_string(model_str)
 
     def free_dataset(self) -> "Booster":
         return self

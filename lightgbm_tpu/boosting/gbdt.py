@@ -863,6 +863,15 @@ class GBDT:
         if not should_continue:
             log_warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
+            if self.iter == 0 and not self.models:
+                # reference: first-iteration stumps are kept as CONSTANT
+                # trees carrying the boost-from-average output, so the
+                # model predicts the baseline (gbdt.cpp:387-405
+                # AsConstantTree); later-iteration stumps are dropped
+                for k, ht in enumerate(new_models):
+                    ht.leaf_value[:1] = self.init_scores[k]
+                self.models.extend(new_models)
+                self.models_version += 1
             return True
         self.models.extend(new_models)
         self.models_version += 1

@@ -754,12 +754,11 @@ def LGBM_BoosterPredictForFile(booster_handle: int, data_filename: str,
                                result_filename: str) -> int:
     """reference: c_api.h LGBM_BoosterPredictForFile — predictions written
     one row per line (tab-separated for multi-output)."""
-    from .dataset import Dataset
-    from .io_utils import load_text_dataset
-    tmp = Dataset(None, params={"header": bool(data_has_header)})
-    X = load_text_dataset(str(data_filename), tmp)
-    pred = _predict_with_type(_get(booster_handle), X, predict_type,
-                              num_iteration)
+    from .io_utils import load_prediction_file
+    bst = _get(booster_handle)
+    X = load_prediction_file(str(data_filename), bst.num_features(),
+                             {"header": bool(data_has_header)})
+    pred = _predict_with_type(bst, X, predict_type, num_iteration)
     pred = np.asarray(pred)
     from .utils.file_io import open_file
     with open_file(str(result_filename), "w") as fh:

@@ -1,0 +1,43 @@
+"""Optional-dependency detection flags.
+
+reference: python-package/lightgbm/compat.py — the same probe-and-flag
+pattern (PANDAS_INSTALLED etc.) so downstream code and the reference's own
+test suite can gate on what is available.
+"""
+
+try:
+    import pandas as _pd                           # noqa: F401
+    from pandas import DataFrame, Series           # noqa: F401
+    PANDAS_INSTALLED = True
+except ImportError:
+    PANDAS_INSTALLED = False
+
+    class DataFrame:                               # noqa: D401
+        """Dummy DataFrame when pandas is absent."""
+
+    class Series:
+        """Dummy Series when pandas is absent."""
+
+try:
+    import matplotlib                              # noqa: F401
+    MATPLOTLIB_INSTALLED = True
+except ImportError:
+    MATPLOTLIB_INSTALLED = False
+
+try:
+    import graphviz                                # noqa: F401
+    GRAPHVIZ_INSTALLED = True
+except ImportError:
+    GRAPHVIZ_INSTALLED = False
+
+try:
+    import datatable                               # noqa: F401
+    DATATABLE_INSTALLED = True
+except ImportError:
+    DATATABLE_INSTALLED = False
+
+try:
+    import sklearn                                 # noqa: F401
+    SKLEARN_INSTALLED = True
+except ImportError:
+    SKLEARN_INSTALLED = False

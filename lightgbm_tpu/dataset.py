@@ -229,6 +229,16 @@ class Dataset:
         (dataset.cpp:97-313)."""
         p = self.params
         max_bin = int(p.get("max_bin", 255))
+        # per-feature bin budgets (reference: Config::max_bin_by_feature,
+        # applied per feature in DatasetLoader::ConstructBinMappers)
+        mbbf = p.get("max_bin_by_feature") or []
+        if isinstance(mbbf, str):
+            mbbf = [int(v) for v in mbbf.split(",") if v.strip()]
+        if mbbf and len(mbbf) != self.num_total_features:
+            from .basic import LightGBMError
+            raise LightGBMError(
+                "Length of max_bin_by_feature is not same with feature "
+                "number")
         min_data_in_bin = int(p.get("min_data_in_bin", 3))
         min_data_in_leaf = int(p.get("min_data_in_leaf", 20))
         use_missing = bool(p.get("use_missing", True))
@@ -247,7 +257,8 @@ class Dataset:
             btype = (BinType.CATEGORICAL if f in categorical
                      else BinType.NUMERICAL)
             m.find_bin(
-                vals, total_sample_cnt, max_bin,
+                vals, total_sample_cnt,
+                int(mbbf[f]) if mbbf else max_bin,
                 min_data_in_bin=min_data_in_bin,
                 min_split_data=min_data_in_leaf,
                 pre_filter=pre_filter,
@@ -259,6 +270,16 @@ class Dataset:
             self.bin_mappers.append(m)
         self.used_features = [f for f, m in enumerate(self.bin_mappers)
                               if not m.is_trivial]
+        if not self.used_features and self.bin_mappers:
+            # every feature is constant: keep one never-splittable dummy
+            # column so the jitted grower has a non-empty feature axis and
+            # trains stump trees (the reference trains with zero usable
+            # features the same way — all split gains invalid;
+            # boost_from_average supplies the constant prediction)
+            self.bin_mappers[0] = BinMapper(
+                num_bin=2, is_trivial=False,
+                bin_upper_bound=np.array([0.0, np.inf]))
+            self.used_features = [0]
         # EFB grouping from the sample (reference: FindGroups /
         # FastFeatureBundling, dataset.cpp:97-313)
         for j, f in enumerate(self.used_features):
@@ -675,6 +696,43 @@ class Dataset:
     def set_label(self, label):
         self.metadata.label = np.asarray(label, dtype=np.float32).reshape(-1)
 
+    # attribute-style field access (the reference Dataset keeps .label /
+    # .weight / .init_score / .group instance attributes)
+    @property
+    def label(self):
+        return self.metadata.label
+
+    @label.setter
+    def label(self, value):
+        self.metadata.label = (None if value is None else
+                               np.asarray(value, np.float32).reshape(-1))
+
+    @property
+    def weight(self):
+        return self.metadata.weight
+
+    @weight.setter
+    def weight(self, value):
+        self.metadata.weight = (None if value is None else
+                                np.asarray(value, np.float32).reshape(-1))
+
+    @property
+    def init_score(self):
+        return self.metadata.init_score
+
+    @init_score.setter
+    def init_score(self, value):
+        self.metadata.init_score = (None if value is None else
+                                    np.asarray(value, np.float64))
+
+    @property
+    def group(self):
+        return self.get_group()
+
+    @group.setter
+    def group(self, value):
+        self.metadata.set_group(value)
+
     def get_weight(self):
         return self.metadata.weight
 
@@ -708,9 +766,18 @@ class Dataset:
         idx = np.asarray(used_indices, dtype=np.int64)
         sub = Dataset.__new__(Dataset)
         sub.params = dict(params or self.params)
-        sub.raw_data = None
+        # a kept-raw parent hands its subset the raw rows too (reference:
+        # subsets re-materialize from the parent's data — needed for
+        # fpreproc / continued training on subsets)
+        if self.raw_data is not None and not isinstance(self.raw_data, str):
+            sub.raw_data = (self.raw_data.iloc[idx]
+                            if hasattr(self.raw_data, "iloc")
+                            else self.raw_data[idx])
+            sub.free_raw_data = self.free_raw_data
+        else:
+            sub.raw_data = None
+            sub.free_raw_data = True
         sub.reference = self
-        sub.free_raw_data = True
         qb = None
         if self.metadata.query_boundaries is not None:
             # rows of one query must stay contiguous in the subset (true for

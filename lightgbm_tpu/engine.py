@@ -35,6 +35,14 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     cfg = Config.from_params(params)
     if "num_iterations" in {Config.canonical_key(k) for k in params}:
         num_boost_round = cfg.num_iterations
+    # the resolved round count is logged into the model file's parameters
+    # section (reference train() writes params['num_iterations'])
+    params["num_iterations"] = num_boost_round
+    # reference: train() accepts a bare Dataset / name (engine.py:18)
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]
+    if isinstance(valid_names, str):
+        valid_names = [valid_names]
     if fobj is not None:
         params["objective"] = "none"
     if feature_name != "auto":
@@ -47,20 +55,50 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     if init_model is not None:
         predictor = init_model if isinstance(init_model, Booster) else \
             Booster(model_file=init_model, params=params)
+    # raw features must be captured BEFORE construction possibly frees them
+    # (reference predicts the init scores during lazy construction,
+    # basic.py:840 _set_init_score_by_predictor — free_raw_data=True still
+    # works for a fresh Dataset there)
+    train_raw = train_set.raw_data if predictor is not None else None
 
     booster = Booster(params=params, train_set=train_set)
 
     # continued training: old model predictions become init scores
     # (reference: basic.py:840 _set_init_score_by_predictor)
     if predictor is not None:
-        _apply_init_model(booster, predictor, train_set)
+        _apply_init_model(booster, predictor, train_set, raw=train_raw)
 
+    train_in_valid = False
     if valid_sets:
+        names_given = valid_names is not None
         valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+        added = []
         for vs, name in zip(valid_sets, valid_names):
             if vs is train_set:
+                # reference: a valid set identical to the train set reports
+                # the TRAINING metrics, under the passed name when one was
+                # given (engine.py:175-187 is_valid_contain_train)
+                train_in_valid = True
+                if names_given:
+                    booster.set_train_data_name(name)
                 continue
+            added.append((vs, vs.raw_data))
             booster.add_valid(vs, name)
+        if predictor is not None:
+            # valid scores must also start from the old model's predictions
+            import jax.numpy as jnp
+            K = booster.boosting.num_tree_per_iteration
+            for i, (vs, raw) in enumerate(added):
+                if raw is None:
+                    raise ValueError(
+                        "continued training requires free_raw_data=False "
+                        "on validation Datasets")
+                pred = predictor.predict(raw, raw_score=True)
+                arr = (np.asarray(pred, np.float32).reshape(-1, K).T
+                       if K > 1 else
+                       np.asarray(pred, np.float32).reshape(1, -1))
+                booster.boosting.valid_scores[i] = (
+                    booster.boosting.valid_scores[i] + jnp.asarray(arr))
 
     cbs = set(callbacks or [])
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
@@ -92,8 +130,8 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
         finished = booster.update(fobj=fobj)
         evaluation_result_list = []
         if (valid_sets and booster.boosting.valid_metrics) or feval is not None \
-                or cfg.is_provide_training_metric:
-            if cfg.is_provide_training_metric:
+                or cfg.is_provide_training_metric or train_in_valid:
+            if cfg.is_provide_training_metric or train_in_valid:
                 evaluation_result_list.extend(booster.eval_train(feval))
             evaluation_result_list.extend(booster.eval_valid(feval))
         early_stopped = False
@@ -121,8 +159,10 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     return booster
 
 
-def _apply_init_model(booster: Booster, predictor: Booster, train_set: Dataset):
-    raw = predictor.predict(_recover_raw(train_set), raw_score=True)
+def _apply_init_model(booster: Booster, predictor: Booster, train_set: Dataset,
+                      raw=None):
+    raw = predictor.predict(raw if raw is not None
+                            else _recover_raw(train_set), raw_score=True)
     K = booster.boosting.num_tree_per_iteration
     import jax.numpy as jnp
     n = train_set.num_data
@@ -172,6 +212,10 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
             group = None
             if full_data.metadata.query_boundaries is not None:
                 group = np.diff(full_data.metadata.query_boundaries)
+            if group is not None:
+                # sklearn splitters take PER-ROW group ids (reference:
+                # engine.py:306 np.repeat over the group sizes)
+                group = np.repeat(np.arange(len(group)), group)
             folds = folds.split(X=np.empty(num_data),
                                 y=full_data.get_label(), groups=group)
         return list(folds)
@@ -185,6 +229,16 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
         if nfold > nq:
             raise ValueError(
                 f"nfold={nfold} exceeds the number of query groups ({nq})")
+        try:
+            # reference: the default ranking split IS sklearn's GroupKFold
+            # over per-row group ids (engine.py:301-306) — deterministic,
+            # so cv(folds=GroupKFold(n)) gives identical folds
+            from sklearn.model_selection import GroupKFold
+            flat = np.repeat(np.arange(nq), np.diff(qb))
+            return list(GroupKFold(n_splits=nfold).split(
+                X=np.empty(num_data), groups=flat))
+        except ImportError:
+            pass
         q_idx = np.arange(nq)
         if shuffle:
             rng.shuffle(q_idx)
@@ -257,8 +311,10 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
         agg: Dict[str, List[float]] = collections.defaultdict(list)
         for bst in boosters:
             bst.update(fobj=fobj)
-            res = (bst.eval_train(feval) if eval_train_metric else []) + \
-                bst.eval_valid(feval)
+            # reference cv names the train split 'train' (engine.py:353)
+            res = ([("train", mn, v, h)
+                    for (_, mn, v, h) in bst.eval_train(feval)]
+                   if eval_train_metric else []) + bst.eval_valid(feval)
             for (dname, mname, val, hib) in res:
                 agg[(dname if eval_train_metric else "valid", mname, hib)].append(val)
         evaluation_result_list = [

@@ -107,6 +107,37 @@ def load_text_dataset(path: str, dataset) -> np.ndarray:
     return data
 
 
+def load_prediction_file(path: str, n_model_features: int,
+                         params: dict) -> np.ndarray:
+    """Feature matrix for PREDICTION from a text file.
+
+    reference: the Predictor's parser is created with the model's feature
+    count, so a data file WITHOUT a label column (width == num_features)
+    predicts directly while a training-style file (width == num_features+1)
+    has its label column dropped (src/application/predictor.hpp parser
+    setup).  LibSVM files always carry the label first.
+    """
+    fmt, has_header = detect_format(path)
+    if params.get("header", None) is not None:
+        has_header = _param_bool(params, "header")
+    if fmt == "libsvm":
+        X, _ = _load_libsvm(path)
+        if X.shape[1] < n_model_features:
+            X = np.pad(X, ((0, 0), (0, n_model_features - X.shape[1])))
+        return X
+    import pandas as pd
+    sep = "\t" if fmt == "tsv" else ","
+    df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
+                     na_values=["nan", "NA", "na", ""])
+    names = [str(c) for c in df.columns] if has_header else None
+    mat = df.to_numpy(dtype=np.float64)
+    if mat.shape[1] == n_model_features:
+        return mat
+    label_idx, keep = _resolve_label_and_columns(params, names,
+                                                 mat.shape[1])
+    return mat[:, keep]
+
+
 def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
     from .utils.file_io import open_file
     labels = []

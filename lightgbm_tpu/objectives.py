@@ -235,9 +235,8 @@ class BinaryLogloss(ObjectiveFunction):
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         lbl = np.asarray(metadata.label, np.float64)
-        uniq = set(np.unique(lbl).tolist())
-        if not uniq <= {0.0, 1.0}:
-            raise ValueError("binary objective requires labels in {0, 1}")
+        # reference: is_pos = label > 0 (binary_objective.hpp:35) — any
+        # positive value counts as the positive class, no {0,1} check
         self.label_sign = jnp.asarray(np.where(lbl > 0, 1.0, -1.0), jnp.float32)
         cnt_pos = float((lbl > 0).sum())
         cnt_neg = float(len(lbl) - cnt_pos)
