@@ -158,11 +158,18 @@ def feature_best_splits(
     min_gain_shift = parent_gain + hp.min_gain_to_split
 
     # ---- numerical features ------------------------------------------------
-    # missing bin per feature: NaN bin = num_bin-1, Zero bin = default_bin
+    # missing bin per feature: NaN bin = num_bin-1, Zero bin = default_bin.
+    # Features WITHOUT a dedicated missing direction (missing_type None, or
+    # num_bin <= 2 — the reference's dispatch guard) run the plain scan
+    # with the missing bin treated as an ordinary bin
+    # (feature_histogram.hpp:96-258: the two-direction template is only
+    # instantiated for num_bin > 2 with missing handling).
+    has_missing_dir = (missing_type != MissingType.NONE) & (num_bin > 2)
     miss_bin = jnp.where(
         missing_type == MissingType.NAN, num_bin - 1,
         jnp.where(missing_type == MissingType.ZERO, default_bin, -1),
     )  # [F]; -1 = no missing handling
+    miss_bin = jnp.where(has_missing_dir, miss_bin, -1)
     is_missing_bin = bins[None, :] == miss_bin[:, None]             # [F, B]
     valid_bin = bins[None, :] < num_bin[:, None]                    # [F, B]
 
@@ -208,14 +215,18 @@ def feature_best_splits(
         return gain, (lg, lh - K_EPSILON, lc)
 
     # valid thresholds: t in [0, num_bin-2], t not the missing bin when Zero
-    t_valid = (bins[None, :] < (num_bin - 1)[:, None]) & valid_bin
+    # thresholds stop one short of the last scannable bin; with a dedicated
+    # NaN bin the last REAL bin is num_bin-2, so t <= num_bin-3 (reference
+    # scan bound: num_bin - 2 - NA_AS_MISSING, feature_histogram.hpp:782+)
+    na_dir = has_missing_dir & (missing_type == MissingType.NAN)
+    t_valid = (bins[None, :] <
+               (num_bin - 1 - na_dir.astype(jnp.int32))[:, None]) & valid_bin
     t_valid &= ~((missing_type[:, None] == MissingType.ZERO) & is_missing_bin)
     if use_rand:
         rand_t = jnp.floor(
             extra_rand_u[:, 0] * jnp.maximum(num_bin - 1, 1).astype(jnp.float32)
         ).astype(jnp.int32)
         t_valid &= bins[None, :] == rand_t[:, None]
-    has_missing_dir = (missing_type != MissingType.NONE) & (num_bin > 2)
 
     gain_r, left_r = eval_dir(jnp.zeros((F, 1), dtype=bool))   # missing -> right
     gain_l, left_l = eval_dir(jnp.ones((F, 1), dtype=bool))    # missing -> left
@@ -245,7 +256,12 @@ def feature_best_splits(
                   jnp.take_along_axis(left_r[1], t_r_idx[:, None], 1)[:, 0])
     num_lc = pick(jnp.take_along_axis(left_l[2], t_l[:, None], 1)[:, 0],
                   jnp.take_along_axis(left_r[2], t_r_idx[:, None], 1)[:, 0])
-    num_dl = use_left
+    # plain-scan features: the reference emits default_left=false for
+    # NaN-type (so NaN-bin rows follow the ordinary bin comparison at the
+    # partition) and default_left=true otherwise (feature_histogram.hpp:
+    # 89,200)
+    num_dl = jnp.where(has_missing_dir, use_left,
+                       missing_type != MissingType.NAN)
 
     # ---- categorical features ---------------------------------------------
     cat = _best_categorical(
