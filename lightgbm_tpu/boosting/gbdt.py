@@ -598,7 +598,13 @@ class GBDT:
                     tree = tree._replace(
                         split_feature=feat_perm_j[tree.split_feature])
                 if use_renew:
-                    residual = label_r - new_score[k]
+                    if getattr(self, "_rf_renew_const_init", False):
+                        # RF renews leaf outputs against the CONSTANT init
+                        # score, not the running average (reference
+                        # residual_getter, rf.hpp:130-135)
+                        residual = label_r - jnp.float32(self.init_scores[k])
+                    else:
+                        residual = label_r - new_score[k]
                     w = row_mask * weight_r
                     pct = leaf_percentile(leaf_id, residual, w,
                                           cfg.num_leaves, float(renew_pct))

@@ -7,10 +7,9 @@ score as a bias (AddBias, rf.hpp:137), and train/valid scores are the
 RUNNING MEAN of the trees' outputs (MultiplyScore dance, rf.hpp:140-142);
 prediction averages over iterations (average_output).
 
-Known deviation: for percentile-renewing objectives (L1/quantile/MAPE) the
-shared jitted step renews leaf outputs against the running-average score
-rather than the constant init score (reference residual_getter, rf.hpp:133)
-— the difference vanishes as the forest converges.
+Percentile-renewing objectives (L1/quantile/MAPE) renew leaf outputs
+against the CONSTANT init score (reference residual_getter, rf.hpp:133);
+the jitted step is rebuilt in that mode once the init scores are final.
 """
 
 from __future__ import annotations
@@ -46,6 +45,12 @@ class RF(GBDT):
         score0 = jnp.broadcast_to(init_col, self.train_score.shape)
         g, h = self._gradients_fn(score0)
         self._grad, self._hess = g, h
+        # percentile-renewing objectives (L1/quantile/MAPE) must renew
+        # against the constant init score (reference residual_getter,
+        # rf.hpp:130-135); rebuild the jitted step with that mode now that
+        # init_scores are final
+        self._rf_renew_const_init = True
+        self._build_jit_fns()
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if grad is not None:

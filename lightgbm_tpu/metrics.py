@@ -226,9 +226,13 @@ class MultiErrorMetric(Metric):
         k = self.config.multi_error_top_k
         idx = self.label.astype(np.int64)
         true_score = p[idx, np.arange(p.shape[1])]
-        rank = (p > true_score[None, :]).sum(axis=0)
-        err = (rank >= k).astype(np.float64)
-        return [(self.name, self._avg(err), False)]
+        # reference (multiclass_metric.hpp MultiErrorMetric): a row is
+        # CORRECT iff #(scores >= true score, ties included) <= top_k,
+        # and the emitted name is multi_error@k for k > 1
+        num_larger = (p >= true_score[None, :]).sum(axis=0)
+        err = (num_larger > k).astype(np.float64)
+        name = self.name if k <= 1 else f"{self.name}@{k}"
+        return [(name, self._avg(err), False)]
 
 
 class AucMuMetric(Metric):

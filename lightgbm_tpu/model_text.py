@@ -150,7 +150,13 @@ def parse_tree(block: str) -> HostTree:
 def load_model_from_string(s: str) -> dict:
     """Parse a reference-format model string into a dict of attributes +
     HostTree list."""
-    header, _, rest = s.partition("tree_sizes=")
+    header, sep, rest = s.partition("tree_sizes=")
+    if not sep:
+        # tree_sizes is advisory (the reference re-parses on mismatch,
+        # gbdt_model_text.cpp LoadModelFromString) — a model string
+        # without it still loads by scanning the Tree= blocks
+        i = s.find("Tree=")
+        header, rest = (s[:i], "sizes\n" + s[i:]) if i >= 0 else (s, "")
     lines = header.splitlines()
     out = {
         "sub_model_name": lines[0].strip() if lines else "tree",
