@@ -103,6 +103,8 @@ class Booster:
         train_set.params = merged
         train_set.construct()
         self.train_set = train_set
+        self.pandas_categorical = getattr(train_set, "pandas_categorical",
+                                          None)
         self.objective = create_objective(self.config)
         self.boosting = create_boosting(self.config, train_set, self.objective)
         # resolve metrics
@@ -371,6 +373,14 @@ class Booster:
             from .io_utils import load_prediction_file
             data = load_prediction_file(data, self.num_features(),
                                         dict(self.params))
+        if hasattr(data, "dtypes") and hasattr(data, "columns"):
+            # pandas: re-apply the training category mappings (reference:
+            # predict routes through _data_from_pandas with the stored
+            # pandas_categorical, basic.py:523)
+            from .dataset import _data_from_pandas
+            data = _data_from_pandas(
+                data, None, None,
+                getattr(self, "pandas_categorical", None))[0]
         if hasattr(data, "values"):
             data = data.values
         n_feat = (data.shape[1] if hasattr(data, "shape")
@@ -697,7 +707,21 @@ class Booster:
         # early-stopped model round-trips at its best point
         if num_iteration is None:
             num_iteration = self.best_iteration
-        return save_model_to_string(self, num_iteration, start_iteration)
+        out = save_model_to_string(self, num_iteration, start_iteration)
+        # category value lists ride in the model file (reference:
+        # _dump_pandas_categorical, basic.py:385)
+        import json as _json
+        pc = getattr(self, "pandas_categorical", None)
+
+        def _default(o):
+            import numpy as _np
+            if isinstance(o, _np.generic):
+                return o.item()
+            raise TypeError(f"not JSON serializable: {type(o)}")
+
+        out += ("\npandas_categorical:"
+                + _json.dumps(pc, default=_default) + "\n")
+        return out
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
@@ -711,6 +735,7 @@ class Booster:
     def _init_from_string(self, s: str) -> None:
         self._loaded = load_model_from_string(s)
         self.objective = None
+        self.pandas_categorical = self._loaded.get("pandas_categorical")
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> dict:

@@ -51,6 +51,55 @@ def _as_2d(data) -> np.ndarray:
     return arr
 
 
+def _data_from_pandas(data, feature_name, categorical_feature,
+                      pandas_categorical):
+    """DataFrame -> float matrix with category columns as codes.
+
+    reference: _data_from_pandas (python-package/lightgbm/basic.py:331):
+    category-dtype columns map to their codes (-1/unseen -> NaN); the
+    category VALUE lists (pandas_categorical) are recorded at train time
+    and re-applied to valid/predict frames so codes align; 'auto'
+    categorical_feature resolves to the NOT-ordered category columns
+    (ordered categoricals stay ordinal/numeric).
+    Returns (values, feature_name, categorical_feature, pandas_categorical).
+    """
+    if not (hasattr(data, "dtypes") and hasattr(data, "columns")):
+        return data, feature_name, categorical_feature, pandas_categorical
+    import pandas as pd
+    if feature_name in ("auto", None):
+        data = data.rename(columns=str)
+    cat_cols = [str(c) for c in
+                data.select_dtypes(include=["category"]).columns]
+    cat_cols_not_ordered = [c for c in cat_cols
+                            if not data[c].cat.ordered]
+    if pandas_categorical is None:     # train dataset
+        pandas_categorical = [list(data[c].cat.categories)
+                              for c in cat_cols]
+    else:
+        if len(cat_cols) != len(pandas_categorical):
+            raise ValueError(
+                "train and valid dataset categorical_feature do not match.")
+        for col, category in zip(cat_cols, pandas_categorical):
+            if list(data[col].cat.categories) != list(category):
+                data[col] = data[col].cat.set_categories(category)
+    if cat_cols:
+        data = data.copy()
+        data[cat_cols] = (data[cat_cols]
+                          .apply(lambda x: x.cat.codes)
+                          .replace({-1: np.nan}))
+    if categorical_feature is not None:
+        if categorical_feature == "auto":
+            categorical_feature = cat_cols_not_ordered
+        else:
+            categorical_feature = list(categorical_feature)
+    if feature_name == "auto":
+        feature_name = [str(c) for c in data.columns]
+    values = data.values
+    if values.dtype not in (np.float32, np.float64):
+        values = values.astype(np.float32)
+    return values, feature_name, categorical_feature, pandas_categorical
+
+
 def _sample_indices(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
     if num_data <= sample_cnt:
         return np.arange(num_data)
@@ -129,6 +178,7 @@ class Dataset:
             self.metadata.init_score = np.asarray(init_score, dtype=np.float64)
         self._feature_name_param = feature_name
         self._categorical_feature_param = categorical_feature
+        self.pandas_categorical = None      # category values per cat column
         # filled by construct():
         self.constructed = False
         self.bin_mappers: List[BinMapper] = []         # per ORIGINAL feature
@@ -155,6 +205,21 @@ class Dataset:
         if self.raw_data is None:
             raise RuntimeError("cannot construct Dataset: raw data was freed")
         data = self.raw_data
+        if hasattr(data, "dtypes") and hasattr(data, "columns"):
+            # pandas: category columns -> codes with the category values
+            # recorded (train) or re-applied (valid/aligned sets)
+            pc_in = None
+            if self.reference is not None:
+                pc_in = getattr(self.reference.construct(),
+                                "pandas_categorical", None)
+            data, fn, cf, pc = _data_from_pandas(
+                data, self._feature_name_param,
+                self._categorical_feature_param, pc_in)
+            self.pandas_categorical = pc
+            if self._feature_name_param in ("auto", None) and fn:
+                self.feature_names = list(fn)
+            if self._categorical_feature_param in ("auto", None):
+                self._categorical_auto_resolved = cf or []
         if isinstance(data, (str, os.PathLike)):
             from .io_utils import _param_bool
             if _param_bool(self.params, "two_round"):
@@ -488,16 +553,23 @@ class Dataset:
         cf = self._categorical_feature_param
         if cf == "auto" or cf is None:
             cats = set()
-            if hasattr(self.raw_data, "dtypes"):  # pandas: category dtype columns
-                for i, dt in enumerate(self.raw_data.dtypes):
-                    if str(dt) == "category":
-                        cats.add(i)
+            # pandas auto-resolution: the NOT-ordered category columns
+            # (recorded by _data_from_pandas during construct)
+            auto = getattr(self, "_categorical_auto_resolved", None)
+            if auto:
+                cats |= self._names_to_indices(auto)
             # also honor categorical_feature in params (CLI-style)
             pcf = self.params.get("categorical_feature") or self.params.get("categorical_column")
             if pcf:
                 cats |= self._names_to_indices(pcf)
             return cats
         return self._names_to_indices(cf)
+
+    @property
+    def categorical_feature(self):
+        """The categorical_feature spec as given (reference keeps the
+        user's names/indices on the Dataset)."""
+        return self._categorical_feature_param
 
     def _names_to_indices(self, spec) -> set:
         if isinstance(spec, str):
@@ -799,6 +871,7 @@ class Dataset:
         )
         sub._feature_name_param = self.feature_names
         sub._categorical_feature_param = self._categorical_feature_param
+        sub.pandas_categorical = getattr(self, "pandas_categorical", None)
         sub.constructed = True
         sub.bin_mappers = self.bin_mappers
         sub.used_features = self.used_features

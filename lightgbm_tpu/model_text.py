@@ -197,6 +197,16 @@ def load_model_from_string(s: str) -> dict:
     if "parameters:" in tail:
         pstr = tail.partition("parameters:")[2].partition("end of parameters")[0]
         out["params_str"] = pstr.strip()
+    # category value lists (reference: _load_pandas_categorical, basic.py:395)
+    key = "pandas_categorical:"
+    pos = s.rfind(key)
+    if pos >= 0:
+        import json as _json
+        try:
+            out["pandas_categorical"] = _json.loads(
+                s[pos + len(key):].partition("\n")[0])
+        except ValueError:
+            out["pandas_categorical"] = None
     return out
 
 
