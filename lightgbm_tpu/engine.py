@@ -273,6 +273,10 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
     """reference: engine.py:375."""
     params = dict(params)
+    if fobj is not None:
+        # custom objective: no built-in objective, hence no default metric
+        # (reference cv sets objective to none, engine.py:485)
+        params["objective"] = "none"
     if metrics is not None:
         params["metric"] = metrics
     cfg = Config.from_params(params)

@@ -267,6 +267,7 @@ def feature_best_splits(
     cat = _best_categorical(
         hist, sum_grad, sum_hess, num_data, num_bin, valid_bin, hp,
         rand_u=(extra_rand_u[:, 1] if use_rand else None),
+        missing_type=missing_type,
     ) if has_categorical else None
 
     # each feature's gain is shifted by ITS OWN parent gain (categorical
@@ -362,7 +363,7 @@ def pick_best_feature(pf: PerFeatureBest, sum_grad, sum_hess,
 
 
 def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin,
-                      hp, rand_u=None):
+                      hp, rand_u=None, missing_type=None):
     """Categorical split search, vectorized over features.
 
     reference: FindBestThresholdCategoricalInner (feature_histogram.hpp:259-460).
@@ -467,6 +468,20 @@ def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin,
     member = member.at[jnp.arange(F)[:, None], order].set(in_left_sorted & s_usable)
     member_oh = k_idx == oh_k[:, None]
     member = jnp.where(is_onehot[:, None], member_oh, member)
+    # normalize: the NaN category (bin num_bin-1 when the feature has one,
+    # i.e. missing_type NaN) must never sit in the stored goes-LEFT set —
+    # prediction routes NaN right when it is not listed (the reference
+    # never emits -1 in a categorical threshold).  Swapping sides keeps
+    # the identical partition: new left = old right.
+    if missing_type is not None:
+        is_nan_bin = (k_idx == (num_bin - 1)[:, None]) & \
+            (missing_type == MissingType.NAN)[:, None]
+        nan_left = jnp.any(member & is_nan_bin, axis=1)
+        member = jnp.where(nan_left[:, None],
+                           valid_bin & ~member & ~is_nan_bin, member)
+        cat_lg = jnp.where(nan_left, sum_grad - cat_lg, cat_lg)
+        cat_lh = jnp.where(nan_left, sum_hess - cat_lh, cat_lh)
+        cat_lc = jnp.where(nan_left, num_data - cat_lc, cat_lc)
     word = (jnp.arange(B, dtype=jnp.uint32) // 32)
     bitpos = (jnp.arange(B, dtype=jnp.uint32) % 32)
     bit = jnp.where(member, jnp.uint32(1) << bitpos[None, :], jnp.uint32(0))
