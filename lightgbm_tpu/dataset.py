@@ -893,6 +893,9 @@ class Dataset:
         self.construct()
         meta = {
             "version": 1,
+            "params": {k: v for k, v in self.params.items()
+                       if isinstance(v, (int, float, str, bool, list))
+                       or v is None},
             "num_data": int(self.num_data),
             "num_total_features": int(self.num_total_features),
             "used_features": list(map(int, self.used_features)),
@@ -932,7 +935,10 @@ class Dataset:
             n = int.from_bytes(fh.read(8), "little")
             meta = json.loads(fh.read(n).decode())
             ds = Dataset.__new__(Dataset)
-            ds.params = dict(params or {})
+            # the binary cache carries the construction params (reference:
+            # SaveBinaryFile serializes the Config the dataset was built
+            # with) so param-change checking sees the true old values
+            ds.params = dict(params or meta.get("params") or {})
             ds.raw_data = None
             ds.reference = None
             ds.free_raw_data = True

@@ -122,10 +122,12 @@ def test_efb_bundling_exact_parity():
     X = np.column_stack([onehot, dense])
     y = ((cat % 3 == 0) * 1.0 + 0.4 * dense[:, 0] + 0.2 * rng.randn(n) > 0.5)
 
-    ds_on = lgb.Dataset(X, label=y.astype(np.float64))
+    ds_on = lgb.Dataset(X, label=y.astype(np.float64),
+                        params={"min_data_in_leaf": 5})
     ds_on.construct()
     ds_off = lgb.Dataset(X, label=y.astype(np.float64),
-                         params={"enable_bundle": False})
+                         params={"enable_bundle": False,
+                                 "min_data_in_leaf": 5})
     ds_off.construct()
     # the 12 one-hot columns must share a handful of merged columns
     assert ds_on.num_groups < ds_off.num_groups == len(ds_off.used_features)
@@ -136,7 +138,8 @@ def test_efb_bundling_exact_parity():
     b_on = lgb.train(params, ds_on, num_boost_round=8, verbose_eval=False)
     b_off = lgb.train({**params, "enable_bundle": False},
                       lgb.Dataset(X, label=y.astype(np.float64),
-                                  params={"enable_bundle": False}),
+                                  params={"enable_bundle": False,
+                                          "min_data_in_leaf": 5}),
                       num_boost_round=8, verbose_eval=False)
     # bundled histograms reconstruct the shared default bin from f32 leaf
     # totals (FixHistogram), so gains match only to float precision; the
@@ -157,7 +160,7 @@ def test_efb_binary_cache_roundtrip(tmp_path):
     onehot = np.eye(8)[rng.randint(0, 8, n)]
     X = np.column_stack([onehot, rng.randn(n, 2)])
     y = (onehot[:, 0] + rng.randn(n) * 0.1 > 0.5).astype(np.float64)
-    ds = lgb.Dataset(X, label=y)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
     ds.construct()
     path = str(tmp_path / "efb.bin")
     ds.save_binary(path)

@@ -83,7 +83,7 @@ def test_local_binned_matches_global_construct():
 def test_distributed_parts_train():
     """A rank's local Dataset trains through the normal engine."""
     X, y = _global_data()
-    parts = _run_ranks(X, y)
+    parts = _run_ranks(X, y, params={"min_data_in_leaf": 5})
     bst = lgb.train({"objective": "binary", "num_leaves": 7,
                      "verbosity": -1, "min_data_in_leaf": 5},
                     parts[0], num_boost_round=3)

@@ -85,7 +85,8 @@ def test_push_rows_sparse_chunks():
     Xs = sps.random(n, f, density=0.1, random_state=0, format="csr")
     Xd = Xs.toarray()
     y = (np.asarray(Xs.sum(axis=1)).ravel() > 0.5).astype(np.float32)
-    ds = Dataset.from_sample(Xd[:500], n)
+    ds = Dataset.from_sample(Xd[:500], n,
+                         params={"min_data_in_leaf": 5})
     ds.push_rows(Xs[:1200])                    # sparse chunk
     ds.push_rows(Xd[1200:])                    # dense chunk
     ds.set_label(y)
