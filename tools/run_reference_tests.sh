@@ -2,11 +2,22 @@
 # Run the REFERENCE package's own python test suite against lightgbm_tpu
 # via a module shim (import lightgbm -> lightgbm_tpu).
 #
-# Status on this image (2026-07-30): test_basic.py 7 passed, 3 failed —
-# every failure is the modern-sklearn API break in the OLD tests
-# (load_breast_cancer(True) positional / load_boston removed), not a
-# package gap.  test_engine.py / test_sklearn.py cannot even import on
-# modern sklearn (load_boston).  Re-run after any API-surface change.
+# Status on this image (2026-07-30, end of round 4):
+#   test_basic.py   7 passed; 3 failures are modern-sklearn API breaks in
+#                   the OLD tests (load_breast_cancer(True) positional)
+#   test_engine.py  ~45/50 passing.  Remaining failures and why:
+#     - data-substitution: sklearn removed load_boston, so the shim below
+#       builds a synthetic stand-in; tests asserting exact iteration
+#       counts / thresholds measured on REAL boston can miss marginally
+#       (test_early_stopping_for_only_first_metric,
+#        test_get_split_value_histogram, test_mape_dart)
+#     - test_auc_mu: asserts 2-class multiclass AUC trajectory == binary
+#       AUC trajectory exactly; ours agree to ~4e-5 (rank-equivalence of
+#       softmax-2 vs sigmoid training differs at float level)
+#   test_sklearn.py / test_plotting.py cannot even import on modern
+#   sklearn (from sklearn.datasets import load_boston at module top).
+#
+# Re-run after any API-surface change.
 set -e
 cd "$(dirname "$0")/.."
 SHIM_DIR=$(mktemp -d)
@@ -17,7 +28,49 @@ from lightgbm_tpu.utils.platform import force_cpu_inprocess
 force_cpu_inprocess(1)
 import lightgbm_tpu
 sys.modules["lightgbm"] = lightgbm_tpu
+
+# modern-sklearn compatibility for the OLD reference tests
+import numpy as _np
+import sklearn.datasets as _skd
+
+try:
+    _has_boston = hasattr(_skd, "load_boston")
+except Exception:          # sklearn raises from __getattr__
+    _has_boston = False
+if not _has_boston:
+    def load_boston(return_X_y=False):
+        rng = _np.random.RandomState(42)
+        X = rng.rand(506, 13) * 10.0
+        w = rng.randn(13) * 0.5
+        # centered signal: y in the real-boston range (~5..50, mean ~22)
+        y = (X - 5.0) @ w + rng.randn(506) * 0.5 + 22.0
+        if return_X_y:
+            return X, y
+        class _B:  # noqa: N801
+            data, target = X, y
+        return _B
+    _skd.load_boston = load_boston
+
+_OLD_SIGS = {
+    "load_breast_cancer": ("return_X_y",),
+    "load_iris": ("return_X_y",),
+    "load_wine": ("return_X_y",),
+    "load_linnerud": ("return_X_y",),
+    "load_digits": ("n_class", "return_X_y"),
+}
+
+def _positional_ok(orig, argnames):
+    def f(*a, **k):
+        for name, val in zip(argnames, a):
+            k[name] = val
+        return orig(**k)
+    return f
+
+for _n, _sig in _OLD_SIGS.items():
+    if hasattr(_skd, _n):
+        setattr(_skd, _n, _positional_ok(getattr(_skd, _n), _sig))
 EOF
 PYTHONPATH="$SHIM_DIR" python -m pytest -p refshim \
     /root/reference/tests/python_package_test/test_basic.py \
+    /root/reference/tests/python_package_test/test_engine.py \
     -q -o cache_dir="$SHIM_DIR/.pc" "$@"
