@@ -278,6 +278,10 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
         # (reference cv sets objective to none, engine.py:485)
         params["objective"] = "none"
     if metrics is not None:
+        # the metrics ARG overwrites every metric alias in params
+        # (reference cv pops all _ConfigAliases 'metric' keys first)
+        for k in [k for k in params if Config.canonical_key(k) == "metric"]:
+            params.pop(k)
         params["metric"] = metrics
     cfg = Config.from_params(params)
     if cfg.objective in ("binary",) or cfg.objective.startswith("multiclass"):

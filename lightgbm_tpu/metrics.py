@@ -413,5 +413,9 @@ def create_metric(name: str, config: Config) -> Optional[Metric]:
     if name.lower() in ("none", "na", "null", "custom"):
         return None
     if name not in _REGISTRY:
-        raise ValueError(f"unknown metric {name!r}")
+        # reference: Metric::CreateMetric returns nullptr for unknown
+        # names and training proceeds without it (src/metric/metric.cpp)
+        from .utils.log import log_warning
+        log_warning(f"Unknown metric {name!r} (ignored)")
+        return None
     return _REGISTRY[name](config)
