@@ -25,8 +25,7 @@ from .tree import HostTree
 from .utils.log import log_info, set_verbosity
 
 
-class LightGBMError(Exception):
-    """Error thrown by this package (reference: basic.py:158)."""
+from .config import LightGBMError  # noqa: F401  (public at lgb.basic.*)
 
 
 class Booster:
@@ -111,6 +110,30 @@ class Booster:
         names = self.config.metric or self.config.default_metric()
         self._metric_names = [m for m in names
                               if m.lower() not in ("none", "na", "null", "custom")]
+        # objective/metric/num_class conflicts (reference:
+        # Config::CheckParamConflict + metric factory fatals)
+        from .config import _METRIC_ALIASES, _OBJECTIVE_ALIASES
+        obj = _OBJECTIVE_ALIASES.get(self.config.objective,
+                                     self.config.objective)
+        is_multi_obj = obj in ("multiclass", "multiclassova")
+        if is_multi_obj and self.config.num_class <= 1:
+            raise LightGBMError(
+                "Number of classes should be specified and greater than 1 "
+                "for multiclass training")
+        if not is_multi_obj and obj != "none" and self.config.num_class > 1:
+            raise LightGBMError(
+                "Number of classes must be 1 for non-multiclass training")
+        multi_metrics = {"multi_logloss", "multi_error", "auc_mu"}
+        binary_metrics = {"binary_logloss", "binary_error"}
+        for m in self._metric_names:
+            canon = _METRIC_ALIASES.get(m, m)
+            if canon in multi_metrics and self.config.num_class <= 1:
+                raise LightGBMError(
+                    "Number of classes should be specified and greater "
+                    "than 1 for multiclass training")
+            if canon in binary_metrics and is_multi_obj:
+                raise LightGBMError(
+                    "Multiclass objective and metrics don't match")
         train_metrics = []
         for m in self._metric_names:
             mt = create_metric(m, self.config)

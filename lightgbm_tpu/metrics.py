@@ -59,7 +59,10 @@ class _PointwiseRegressionMetric(Metric):
 
     def eval(self, score, objective):
         if self.convert and objective is not None:
-            score = np.asarray(objective.convert_output(score))
+            # custom objective (None): raw scores stand in for outputs
+            # (reference metric Eval with objective==nullptr)
+            score = np.asarray(objective.convert_output(score)
+                               if objective is not None else score)
         return [(self.name, self.transform(self._avg(self.point_loss(score))), self.higher_better)]
 
 
@@ -211,7 +214,9 @@ class MultiLoglossMetric(Metric):
     name = "multi_logloss"
 
     def eval(self, score, objective):
-        p = np.asarray(objective.convert_output(score), np.float64)  # [K, n]
+        p = np.asarray(objective.convert_output(score)
+                       if objective is not None else score,
+                       np.float64)  # [K, n]
         eps = 1e-15
         idx = self.label.astype(np.int64)
         pt = np.clip(p[idx, np.arange(p.shape[1])], eps, 1.0)
