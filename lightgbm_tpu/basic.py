@@ -106,7 +106,13 @@ class Booster:
                                           None)
         self.objective = create_objective(self.config)
         self.boosting = create_boosting(self.config, train_set, self.objective)
-        # resolve metrics
+        self._resolve_metrics()
+
+    def _resolve_metrics(self) -> None:
+        """(Re)build train/valid metric objects from the current config
+        (reference: Booster::CreateObjectiveAndMetrics, c_api.cpp — also
+        re-run on ResetConfig when the metric list changes)."""
+        train_set = self.train_set
         names = self.config.metric or self.config.default_metric()
         self._metric_names = [m for m in names
                               if m.lower() not in ("none", "na", "null", "custom")]
@@ -134,13 +140,20 @@ class Booster:
             if canon in binary_metrics and is_multi_obj:
                 raise LightGBMError(
                     "Multiclass objective and metrics don't match")
-        train_metrics = []
+        train_metrics = self._build_metrics(train_set.metadata,
+                                            train_set.num_data)
+        valid_metrics = [self._build_metrics(ds.metadata, ds.num_data)
+                         for ds in self.boosting.valid_sets]
+        self.boosting.set_metrics(train_metrics, valid_metrics)
+
+    def _build_metrics(self, metadata, num_data):
+        ms = []
         for m in self._metric_names:
             mt = create_metric(m, self.config)
             if mt is not None:
-                mt.init(train_set.metadata, train_set.num_data)
-                train_metrics.append(mt)
-        self.boosting.set_metrics(train_metrics, [])
+                mt.init(metadata, num_data)
+                ms.append(mt)
+        return ms
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         if data.reference is None:
@@ -148,13 +161,8 @@ class Booster:
         data.construct()
         self.boosting.add_valid(data, name)
         self.name_valid_sets.append(name)
-        ms = []
-        for m in self._metric_names:
-            mt = create_metric(m, self.config)
-            if mt is not None:
-                mt.init(data.metadata, data.num_data)
-                ms.append(mt)
-        self.boosting.valid_metrics.append(ms)
+        self.boosting.valid_metrics.append(
+            self._build_metrics(data.metadata, data.num_data))
         return self
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
@@ -261,14 +269,44 @@ class Booster:
         return self.num_tree_per_iteration
 
     def reset_parameter(self, params: dict) -> "Booster":
-        self.params.update(params)
-        self.config.update(params)
-        if self.boosting is not None:
-            self.boosting.shrinkage_rate = self.config.learning_rate
-            # learning_rate is a traced scalar in the jitted step, so a
-            # per-iteration lr schedule must NOT trigger a rebuild/recompile
-            if set(params) - {"learning_rate"}:
+        if not set(params) - {"learning_rate"}:
+            # hot path: per-iteration lr schedules (callback.py) reset only
+            # learning_rate every iteration — a traced scalar, so no
+            # rebuild, no rollback snapshot, no recompile
+            self.params.update(params)
+            self.config.update(params)
+            if self.boosting is not None:
+                self.boosting.shrinkage_rate = self.config.learning_rate
+            return self
+        import copy
+        old_params = dict(self.params)
+        old_cfg_state = copy.deepcopy(self.config.__dict__)
+        old_metric_names = list(getattr(self, "_metric_names", []))
+        try:
+            self.params.update(params)
+            self.config.update(params)
+            if self.boosting is not None:
+                self.boosting.shrinkage_rate = self.config.learning_rate
                 self.boosting._build_jit_fns()
+                # a changed metric list (or @k knobs) must be reflected in
+                # eval output and LGBM_BoosterGetEvalNames (reference
+                # ResetConfig re-creates the metrics)
+                if any(Config.canonical_key(k) in
+                       ("metric", "eval_at", "multi_error_top_k")
+                       for k in params):
+                    self._resolve_metrics()
+        except Exception:
+            # a rejected reset must not poison the booster: restore the
+            # previous params/config IN PLACE (boosting shares the config
+            # object) and rebuild dependent state
+            self.params = old_params
+            self.config.__dict__.clear()
+            self.config.__dict__.update(old_cfg_state)
+            self._metric_names = old_metric_names
+            if self.boosting is not None:
+                self.boosting.shrinkage_rate = self.config.learning_rate
+                self.boosting._build_jit_fns()
+            raise
         return self
 
     # ------------------------------------------------------------------ eval

@@ -529,7 +529,16 @@ def LGBM_BoosterResetTrainingData(booster_handle: int,
     loaded = lgb.Booster(model_str=bst.model_to_string(num_iteration=0))
     fresh = lgb.Booster(params=dict(bst.params), train_set=ds)
     _apply_init_model(fresh, loaded, ds)
+    # the reference preserves Python-side booster attributes across a
+    # training-data swap: carry over attrs/best_iteration/name explicitly
+    # and drop every stale key (a blind update would leave caches behind)
+    preserved = {k: bst.__dict__[k]
+                 for k in ("_attr", "best_iteration", "best_score",
+                           "_train_data_name")
+                 if k in bst.__dict__}
+    bst.__dict__.clear()
     bst.__dict__.update(fresh.__dict__)
+    bst.__dict__.update(preserved)
     return 0
 
 
@@ -570,14 +579,10 @@ def LGBM_BoosterGetFeatureNames(booster_handle: int,
 
 
 def _eval_names(bst) -> List[str]:
-    """Metric names, computed once per booster (some metrics expand to
-    several outputs, e.g. ndcg@k, so the emitted names come from one
-    evaluation pass and are then cached — they never change afterwards)."""
-    cache = getattr(bst, "_capi_eval_names", None)
-    if cache is None:
-        cache = [n for (_, n, _, _) in bst.boosting.eval_train()]
-        bst._capi_eval_names = cache
-    return cache
+    """Metric names, derived from the configured metric objects without an
+    evaluation pass (Metric.names); recomputed on every call so parameter
+    resets that change the metric list are always reflected."""
+    return [n for m in bst.boosting.train_metrics for n in m.names()]
 
 
 @_guard
@@ -816,12 +821,12 @@ def LGBM_NetworkInitWithFunctions(num_machines: int, rank: int,
                                   allgather_ext_fun) -> int:
     """reference: c_api.h:1036 — external collective injection (the Spark/
     Dask seam).  The TPU build's collectives are XLA psum/all_gather inside
-    the jitted step; external function injection cannot compose with that,
-    so this reports the mesh-based equivalent instead of silently dropping
-    the functions."""
-    from .utils.log import log_warning
-    log_warning(
+    the jitted step; external function injection cannot compose with that.
+    Failing fast (reference failure semantics for an unsupported transport)
+    keeps a Spark/Dask-style caller from proceeding to train partition-local
+    models with no aggregation."""
+    return _set_error(
         "LGBM_NetworkInitWithFunctions: external collective injection is "
-        "replaced by XLA collectives over the device mesh; use "
-        "LGBM_NetworkInit (jax.distributed) + tree_learner=data instead")
-    return 0
+        "not supported by the TPU build (collectives are XLA psum/"
+        "all_gather inside the jitted step); use LGBM_NetworkInit "
+        "(jax.distributed) + tree_learner=data instead")

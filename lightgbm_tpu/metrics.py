@@ -40,6 +40,11 @@ class Metric:
     def eval(self, score: np.ndarray, objective) -> List[Tuple[str, float, bool]]:
         raise NotImplementedError
 
+    def names(self) -> List[str]:
+        """Names this metric will emit from :meth:`eval`, derivable without
+        an evaluation pass (reference: Metric::GetName, metric.h:40)."""
+        return [self.name]
+
     def _avg(self, pointwise: np.ndarray) -> float:
         if self.weight is not None:
             return float((pointwise * self.weight).sum() / self.sum_weight)
@@ -239,6 +244,10 @@ class MultiErrorMetric(Metric):
         name = self.name if k <= 1 else f"{self.name}@{k}"
         return [(name, self._avg(err), False)]
 
+    def names(self):
+        k = self.config.multi_error_top_k
+        return [self.name if k <= 1 else f"{self.name}@{k}"]
+
 
 class AucMuMetric(Metric):
     """reference: multiclass_metric.hpp auc_mu (average pairwise class AUC)."""
@@ -334,6 +343,12 @@ class NDCGMetric(Metric):
             results.append((f"ndcg@{k}", float(vals.mean()), True))
         return results
 
+    def names(self):
+        # the same eval_at snapshot eval() iterates (taken at init), so
+        # GetEvalNames/GetEvalCounts always agree with the emitted values
+        ks = getattr(self, "eval_at", self.config.eval_at)
+        return [f"ndcg@{k}" for k in ks]
+
 
 class MapMetric(Metric):
     """reference: map_metric.hpp MAP@k."""
@@ -365,6 +380,10 @@ class MapMetric(Metric):
                 vals[q] = float((prec * rel).sum() / npos) if npos > 0 else 1.0
             results.append((f"map@{k}", float(vals.mean()), True))
         return results
+
+    def names(self):
+        ks = getattr(self, "eval_at", self.config.eval_at)
+        return [f"map@{k}" for k in ks]
 
 
 class CrossEntropyMetric(_PointwiseRegressionMetric):

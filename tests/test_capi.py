@@ -243,7 +243,11 @@ def test_round4_capi_surface(tmp_path):
                                  2) == -1
     assert "matches this host" in capi.LGBM_GetLastError()
     assert capi.LGBM_NetworkFree() == 0
-    assert capi.LGBM_NetworkInitWithFunctions(2, 0, None, None) == 0
+    # external collective injection is unsupported: must FAIL FAST (a
+    # caller believing distributed aggregation is wired would otherwise
+    # train divergent partition-local models)
+    assert capi.LGBM_NetworkInitWithFunctions(2, 0, None, None) != 0
+    assert "NetworkInitWithFunctions" in capi.LGBM_GetLastError()
 
 
 def test_reset_training_data_replays_scores():
@@ -281,3 +285,42 @@ def test_reset_training_data_replays_scores():
     total = [0]
     assert capi.LGBM_BoosterNumberOfTotalModel(bh[0], total) == 0
     assert total[0] == 8
+
+
+def test_eval_names_follow_parameter_and_data_resets():
+    """GetEvalNames must track metric-list changes from ResetParameter
+    (reference ResetConfig re-creates metrics) and survive a training-data
+    swap; booster attributes survive ResetTrainingData."""
+    X, y = _data(1000, 4, seed=5)
+    dh, bh = [0], [0]
+    assert capi.LGBM_DatasetCreateFromMat(
+        X, "max_bin=31 free_raw_data=false", y, dh) == 0
+    assert capi.LGBM_BoosterCreate(
+        dh[0], "objective=binary num_leaves=7 verbosity=-1 "
+        "metric=binary_logloss", bh) == 0
+    names, cnt = [], [0]
+    assert capi.LGBM_BoosterGetEvalNames(bh[0], names) == 0
+    assert names == ["binary_logloss"]
+    assert capi.LGBM_BoosterResetParameter(bh[0], "metric=auc,binary_error") == 0
+    assert capi.LGBM_BoosterGetEvalNames(bh[0], names) == 0
+    assert names == ["auc", "binary_error"]
+    assert capi.LGBM_BoosterGetEvalCounts(bh[0], cnt) == 0
+    assert cnt[0] == 2
+
+    # Python-side booster attributes (attrs are a basic.py concern in the
+    # reference too) survive a training-data swap; eval names keep working
+    bst = capi._get(bh[0])
+    bst.set_attr(note="kept")
+    bst.set_train_data_name("mytrain")
+    fin = [0]
+    assert capi.LGBM_BoosterUpdateOneIter(bh[0], fin) == 0
+    X2, y2 = _data(1000, 4, seed=6)
+    dh2 = [0]
+    assert capi.LGBM_DatasetCreateFromMat(
+        X2, "max_bin=31 free_raw_data=false", y2, dh2) == 0
+    assert capi.LGBM_BoosterResetTrainingData(bh[0], dh2[0]) == 0
+    bst = capi._get(bh[0])
+    assert bst.attr("note") == "kept"
+    assert bst._train_data_name == "mytrain"
+    assert capi.LGBM_BoosterGetEvalNames(bh[0], names) == 0
+    assert names == ["auc", "binary_error"]

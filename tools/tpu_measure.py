@@ -1,0 +1,105 @@
+"""One-process TPU measurement session for the rounds grower.
+
+Single-tenant tunnel doctrine (docs/PERFORMANCE.md): exactly ONE process
+may hold the axon backend; this script does init -> all measurements ->
+clean exit in one process, banking partial results to a JSON file after
+every stage so a wedge/crash still leaves data on disk.
+
+Run ALONE (no concurrent TPU process):  python tools/tpu_measure.py out.json
+
+Stages (gate with TM_SKIP_<STAGE>=1):
+  init        backend init time
+  higgs_1m    rounds grower, 1M x 28, 20 trees        (quick validation)
+  higgs_11m   rounds grower, 11M x 28, 500 trees      (the headline number;
+              auto-shrunk to 60 trees if the 1M sec/tree looks pathological)
+  ranking     lambdarank MSLR-shaped 1.2M docs, 100 trees
+Shapes match bench.py exactly so this run warms the persistent XLA
+compile cache for the driver's bench run.
+"""
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.utils.platform import _cache_dir  # noqa: E402
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else os.path.join(REPO, "tpu_measure.json")
+T0 = time.time()
+DATA = {"started_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "stages": []}
+
+
+def bank(stage, **kw):
+    kw["stage"] = stage
+    kw["t_elapsed"] = round(time.time() - T0, 1)
+    DATA["stages"].append(kw)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(DATA, f, indent=1, default=str)
+    os.replace(tmp, OUT)
+    print(f"[tpu_measure] {stage}: {json.dumps(kw, default=str)[:500]}",
+          flush=True)
+
+
+def guard(stage, fn, *a, **kw):
+    if os.environ.get(f"TM_SKIP_{stage.upper()}") == "1":
+        bank(stage, skipped=True)
+        return None
+    t1 = time.time()
+    try:
+        r = fn(*a, **kw)
+        out = dict(r) if isinstance(r, dict) else {"result": r}
+        out["stage_seconds"] = round(time.time() - t1, 1)
+        bank(stage, **out)
+        return r
+    except Exception as e:
+        bank(stage, error=str(e)[-600:], tb=traceback.format_exc()[-1500:])
+        return None
+
+
+def main():
+    t = time.time()
+    try:
+        import jax
+        devs = jax.devices()
+        import jax.numpy as jnp
+        jnp.ones((8, 8)).sum().block_until_ready()
+    except Exception as e:
+        bank("init", error=str(e)[-600:])
+        return 3
+    d = devs[0]
+    bank("init", seconds=round(time.time() - t, 1), platform=d.platform,
+         kind=getattr(d, "device_kind", ""))
+    if d.platform == "cpu" and os.environ.get("TM_ALLOW_CPU") != "1":
+        bank("abort", reason="backend resolved to cpu")
+        return 3
+
+    import bench
+
+    r1 = guard("higgs_1m",
+               bench.run_bench, 1_000_000, 20, 255, 63, tag="-1m")
+
+    trees_11m = int(os.environ.get("TM_TREES_11M", 0)) or None
+    if trees_11m is None:
+        spt = (r1 or {}).get("sec_per_tree")
+        trees_11m = 500 if (spt is not None and spt < 0.6) else 60
+    guard("higgs_11m",
+          bench.run_bench, 11_000_000, trees_11m, 255, 63)
+
+    guard("ranking",
+          bench.run_ranking_bench, 12_000, 100, 100, 255, 63)
+
+    bank("done", total_seconds=round(time.time() - T0, 1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
