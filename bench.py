@@ -171,7 +171,10 @@ def run_ranking_bench(n_queries, docs_per_query, trees, leaves, max_bin):
         "metric": "None",
         "verbosity": -1,
     }
-    ds = lgb.Dataset(X, label=y, group=group)
+    # params at creation time: constructing first and handing differing
+    # dataset params to the Booster is a LightGBMError (reference
+    # DatasetUpdateParamChecking semantics) — the round-4 CPU-fallback bug
+    ds = lgb.Dataset(X, label=y, group=group, params=params)
     t0 = time.perf_counter()
     ds.construct()
     bin_seconds = time.perf_counter() - t0
@@ -310,7 +313,7 @@ def run_bench(n, trees, leaves, max_bin, tag=""):
         "metric": "None",
         "verbosity": -1,
     }
-    train_set = lgb.Dataset(X, label=y)
+    train_set = lgb.Dataset(X, label=y, params=params)
     t_bin0 = time.perf_counter()
     train_set.construct()          # binning happens here, outside the clock
     bin_seconds = time.perf_counter() - t_bin0
@@ -506,10 +509,79 @@ def cpu_worker():
 
 
 def collect(stages_list, key):
+    """LAST stage dict for ``key`` (stages accumulate across worker retry
+    attempts; the latest attempt's telemetry wins)."""
+    out = None
     for obj in stages_list:
         if obj.get("stage") == key:
-            return obj
-    return None
+            out = obj
+    return out
+
+
+def collect_ok(stages_list, key):
+    """LAST error-free stage dict for ``key`` — an errored attempt must
+    never mask a later successful retry."""
+    out = None
+    for obj in stages_list:
+        if obj.get("stage") == key and "error" not in obj:
+            out = obj
+    return out
+
+
+def _annotate(line, tpu_stages, cpu_result):
+    """Attach telemetry (probe/init/ranking/cpu reference) to a result."""
+    probe = collect_ok(tpu_stages, "kernel_probe")
+    if probe:
+        line["hist_kernel_probe_ms"] = {
+            k: v for k, v in probe.items() if k not in ("stage", "elapsed")}
+    init = collect_ok(tpu_stages, "init")
+    if init:
+        line["backend_init_seconds"] = init.get("elapsed")
+    rank = collect_ok(tpu_stages, "ranking")
+    if rank:
+        line["ranking"] = {k: v for k, v in rank.items()
+                           if k not in ("stage", "elapsed")}
+    if cpu_result and "error" not in cpu_result:
+        line["cpu_reference"] = {
+            "sec_per_tree": cpu_result.get("sec_per_tree"),
+            "rows": cpu_result.get("rows"),
+            "holdout_auc": cpu_result.get("holdout_auc"),
+        }
+    return line
+
+
+def build_best_line(tpu_stages, cpu_result, note):
+    """The best driver-parseable result line available RIGHT NOW.
+
+    Priority: TPU full > TPU smoke (partial) > CPU fallback > placeholder.
+    The driver records the LAST stdout JSON line, so the parent re-emits
+    this at every state change — any kill point leaves a valid line.
+    """
+    full = collect_ok(tpu_stages, "full")
+    if full:
+        line = {k: v for k, v in full.items() if k != "stage"}
+        return _annotate(line, tpu_stages, cpu_result), True
+    smoke = collect_ok(tpu_stages, "smoke")
+    if smoke:
+        line = {k: v for k, v in smoke.items() if k != "stage"}
+        line["metric"] += f" PARTIAL-SMOKE ({note})"
+        line["vs_baseline"] = 0.0      # scaled-down run, not comparable
+        return _annotate(line, tpu_stages, cpu_result), False
+    if cpu_result and "error" not in cpu_result:
+        line = {k: v for k, v in cpu_result.items() if k != "stage"}
+        line["metric"] += f" CPU-FALLBACK ({note})"
+        line["vs_baseline"] = 0.0
+        partial = {k: collect(tpu_stages, k)
+                   for k in ("init", "kernel_probe", "smoke")}
+        line["tpu_partial"] = {k: v for k, v in partial.items() if v}
+        return line, False
+    err = (cpu_result or {}).get("error", "no result yet")
+    line = error_line("train", err)
+    partial = {k: collect(tpu_stages, k)
+               for k in ("init", "kernel_probe", "smoke")}
+    line["tpu_partial"] = {k: v for k, v in partial.items() if v}
+    line["note"] = note
+    return line, False
 
 
 def main():
@@ -525,43 +597,66 @@ def main():
     if not try_tpu:
         log("no TPU plugin in env (or BENCH_FORCE_CPU): CPU measurement only")
 
+    # a valid (placeholder) result line lands FIRST — rc=124 at any later
+    # point still leaves the driver a parseable last line
+    emit(error_line("startup", "bench started; no measurement banked yet",
+                    {"note": "placeholder — superseded by later lines"}))
+
     cpu_proc, cpu_reader = launch_cpu_fallback()
     log(f"cpu fallback started ({CPU_N} rows x {CPU_TREES} trees)")
 
     tpu_stages = []        # all stage dicts from every worker attempt
-    tpu_full = None
     attempt = 0
     proc, reader = (None, None)
-    cpu_emitted = False
     cpu_result = None
+    emitted_state = None   # dedup: (n tpu stages, cpu done?, note)
+
+    def note_now():
+        if not try_tpu:
+            return ("BENCH_FORCE_CPU=1" if force_cpu
+                    else "no TPU plugin in environment")
+        exhausted = remaining_budget() <= 120
+        init = collect(tpu_stages, "init")
+        if init and init.get("ok") is False:
+            why = f"tpu init failed: {init.get('error', '')[:200]}"
+        elif collect(tpu_stages, "smoke") or collect(tpu_stages, "full"):
+            why = "tpu run in progress"
+        else:
+            why = "tpu pending"
+        if exhausted:
+            why = f"tpu attempts exhausted within budget; last state: {why}"
+        return why
+
+    def refresh_emission(force=False):
+        """Re-emit the best-available line when state changed."""
+        nonlocal emitted_state
+        state = (len(tpu_stages),
+                 tuple(s.get("stage") for s in tpu_stages),
+                 cpu_result is not None, note_now())
+        if state == emitted_state and not force:
+            return
+        line, is_full = build_best_line(tpu_stages, cpu_result, note_now())
+        emit(line)
+        emitted_state = state
+        return is_full
 
     def poll_cpu():
-        nonlocal cpu_emitted, cpu_result
+        nonlocal cpu_result
         if cpu_result is None and cpu_proc.poll() is not None:
             cpu_reader.join(timeout=10)
             cpu_result = collect(cpu_reader.lines, "cpu")
             if cpu_result is None:
-                cpu_result = {"error": "cpu worker produced no result"}
-            else:
-                log(f"cpu fallback done: {cpu_result.get('sec_per_tree')}"
-                    " s/tree")
-        # emit the insurance line once the CPU number exists and no TPU
-        # result has landed yet — the driver keeps the LAST json line, so
-        # a later TPU success overrides this
-        if (cpu_result is not None and not cpu_emitted
-                and "error" not in cpu_result and tpu_full is None):
-            line = dict(cpu_result)
-            line.pop("stage", None)
-            line["metric"] += " CPU-FALLBACK (tpu pending/unavailable)"
-            line["vs_baseline"] = 0.0   # scaled-down run, not comparable
-            partial = {k: collect(tpu_stages, k)
-                       for k in ("init", "kernel_probe", "smoke")}
-            line["tpu_partial"] = {k: v for k, v in partial.items() if v}
-            emit(line)
-            return True
-        return False
+                cpu_result = {"error": "cpu worker produced no result line "
+                                       f"(rc={cpu_proc.returncode})"}
+            log(f"cpu fallback done: {cpu_result.get('sec_per_tree')} s/tree"
+                f" (error={cpu_result.get('error', 'none')[:200]})")
 
-    while try_tpu and remaining_budget() > 120 and tpu_full is None:
+    def have_full():
+        return collect_ok(tpu_stages, "full") is not None
+
+    # runs until the worker exits (even after "full" lands — the ranking
+    # stage follows it) or the budget floor is hit
+    while try_tpu and remaining_budget() > 120:
         if proc is None:
             # alternate env variants: odd attempts drop the remote-compile
             # service that killed the round-2 run
@@ -573,14 +668,21 @@ def main():
                 "init means a lingering claim that will expire; killing "
                 "would start a fresh wedge)")
             proc, reader = launch_tpu_worker(variant)
+            seen_lines = 0
+        # drain worker stage lines AS THEY ARRIVE: a smoke result banked
+        # mid-run becomes the driver-visible line even if we die later
+        new = reader.lines[seen_lines:]
+        if new:
+            tpu_stages.extend(new)
+            seen_lines += len(new)
         rc = proc.poll()
         if rc is not None:
             reader.join(timeout=10)   # let the drain thread parse the tail
-            tpu_stages.extend(reader.lines)
-            tpu_full = collect(reader.lines, "full")
-            if tpu_full is not None and "error" not in tpu_full:
+            new = reader.lines[seen_lines:]
+            tpu_stages.extend(new)
+            seen_lines += len(new)
+            if have_full():
                 break
-            tpu_full = None
             init = collect(reader.lines, "init")
             log(f"tpu worker attempt {attempt} exited rc={rc}; "
                 f"init={json.dumps(init)[:300] if init else None}")
@@ -590,81 +692,45 @@ def main():
                 # transient tunnel failure — stop burning budget on retries
                 log("plugin resolved to CPU backend; abandoning TPU attempts")
                 try_tpu = False
+                refresh_emission()
                 break
             if remaining_budget() < 300:
                 break
+            refresh_emission()
             time.sleep(20)
             continue
-        cpu_emitted = poll_cpu() or cpu_emitted
+        poll_cpu()
+        refresh_emission()
         time.sleep(2)
 
-    if proc is not None and tpu_full is None:
-        proc.kill()
-        proc.wait()
-        reader.join(timeout=10)
-        tpu_stages.extend(reader.lines)
+    if proc is not None:
+        # budget exhausted with the worker still alive.  With a full result
+        # in hand, leave it running (it is finishing the ranking stage; the
+        # parent's exit closes the pipe and it winds down on its own — an
+        # external kill would wedge the single-tenant tunnel).  Without one
+        # there is nothing more to wait for either way; collect what it
+        # printed and move on.
+        reader.join(timeout=5)
+        tpu_stages.extend(reader.lines[seen_lines:])
 
-    # make sure the CPU insurance line lands if nothing better exists; with
-    # a TPU result in hand, never block on the CPU worker — emit now
-    if cpu_result is None and tpu_full is None:
+    # without a TPU full result, wait for the CPU insurance number; with
+    # one in hand never block on the CPU worker
+    if cpu_result is None and not have_full():
         try:
             budget = max(60, min(3000, remaining_budget()))
             cpu_proc.wait(timeout=budget)
         except subprocess.TimeoutExpired:
             cpu_proc.kill()
-        cpu_reader.join(timeout=10)
-        cpu_result = collect(cpu_reader.lines, "cpu") or \
-            {"error": "cpu worker produced no result"}
+        poll_cpu()
+        if cpu_result is None:
+            cpu_result = {"error": "cpu worker produced no result"}
+    if cpu_proc.poll() is None:
+        cpu_proc.kill()
 
-    if tpu_full is not None:
-        if cpu_proc.poll() is None:
-            cpu_proc.kill()
-        tpu_full.pop("stage", None)
-        probe = collect(tpu_stages, "kernel_probe")
-        if probe:
-            tpu_full["hist_kernel_probe_ms"] = {
-                k: v for k, v in probe.items()
-                if k not in ("stage", "elapsed")}
-        init = collect(tpu_stages, "init")
-        if init:
-            tpu_full["backend_init_seconds"] = init.get("elapsed")
-        rank = collect(tpu_stages, "ranking")
-        if rank and "error" not in rank:
-            tpu_full["ranking"] = {k: v for k, v in rank.items()
-                                   if k not in ("stage", "elapsed")}
-        if cpu_result and "error" not in cpu_result:
-            tpu_full["cpu_reference"] = {
-                "sec_per_tree": cpu_result.get("sec_per_tree"),
-                "rows": cpu_result.get("rows"),
-                "holdout_auc": cpu_result.get("holdout_auc"),
-            }
-        emit(tpu_full)
-        return 0
-
-    # no TPU result: emit CPU fallback (or error) with partial TPU telemetry
-    partial = {k: collect(tpu_stages, k)
-               for k in ("init", "kernel_probe", "smoke")}
-    partial = {k: v for k, v in partial.items() if v}
-    init = partial.get("init")
-    if not try_tpu:
-        reason = ("BENCH_FORCE_CPU=1" if force_cpu
-                  else "no TPU plugin in environment")
-    elif init and not init.get("ok"):
-        reason = init.get("error", "init failed")[:300]
-    else:
-        reason = "tpu attempts exhausted within budget"
-    if cpu_result and "error" not in cpu_result:
-        if not cpu_emitted:
-            line = dict(cpu_result)
-            line.pop("stage", None)
-            line["metric"] += f" CPU-FALLBACK (tpu unavailable: {reason})"
-            line["vs_baseline"] = 0.0
-            line["tpu_partial"] = partial
-            emit(line)
-        return 0
-    emit(error_line("train", cpu_result.get("error", "unknown"),
-                    {"tpu_partial": partial}))
-    return 1
+    refresh_emission(force=True)
+    full_ok = have_full()
+    cpu_ok = cpu_result is not None and "error" not in cpu_result
+    return 0 if (full_ok or cpu_ok) else 1
 
 
 if __name__ == "__main__":

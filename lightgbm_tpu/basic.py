@@ -185,7 +185,6 @@ class Booster:
         """Refit the existing trees' leaf values to new data (structures
         unchanged).  reference: basic.py:2521 Booster.refit ->
         LGBM_BoosterRefit -> GBDT::RefitTree (gbdt.cpp:267)."""
-        import copy as _copy
         leaf_pred = self.predict(data, pred_leaf=True)
         if self.boosting is not None:
             params = dict(self.params)
@@ -197,7 +196,7 @@ class Booster:
         params["refit_decay_rate"] = decay_rate
         new_booster = Booster(params=params,
                               train_set=Dataset(data, label=label))
-        new_booster.boosting.models = [_copy.deepcopy(m) for m in self.models]
+        new_booster.boosting.models = [copy.deepcopy(m) for m in self.models]
         new_booster.boosting.iter = (
             len(new_booster.boosting.models)
             // max(new_booster.boosting.num_tree_per_iteration, 1))
@@ -278,7 +277,6 @@ class Booster:
             if self.boosting is not None:
                 self.boosting.shrinkage_rate = self.config.learning_rate
             return self
-        import copy
         old_params = dict(self.params)
         old_cfg_state = copy.deepcopy(self.config.__dict__)
         old_metric_names = list(getattr(self, "_metric_names", []))

@@ -42,15 +42,47 @@ import pytest
 
 REFPKG = os.environ.get("LGBM_REF_PKG", "/tmp/refpkg")
 EXAMPLES = "/root/reference/examples"
+_REFLIB = os.path.join(REFPKG, "lightgbm", "lib_lightgbm.so")
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(os.path.join(REFPKG, "lightgbm", "lib_lightgbm.so")),
-    reason="reference lib not built (run tools/build_reference.sh)",
-)
+
+def _ensure_reference_built() -> str:
+    """Build the reference lib on demand (~2 min, cached in /tmp across
+    runs) so the parity suite executes unskipped on any image with the
+    toolchain; set LGBM_REF_SKIP_BUILD=1 to skip instead.  Called from the
+    reflgb fixture (NOT at import time: collection must stay cheap) and
+    serialized through a lock file for parallel pytest workers."""
+    if os.path.exists(_REFLIB):
+        return ""
+    if os.environ.get("LGBM_REF_SKIP_BUILD") == "1":
+        return "reference lib not built (LGBM_REF_SKIP_BUILD=1)"
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "build_reference.sh")
+    import fcntl
+    with open("/tmp/lgb_refbuild.lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)   # one builder at a time
+        if os.path.exists(_REFLIB):         # another worker built it
+            return ""
+        proc = subprocess.Popen(["sh", script], stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        try:
+            out, _ = proc.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            import signal
+            os.killpg(proc.pid, signal.SIGKILL)   # sh AND make children
+            proc.wait()
+            return "reference build timed out"
+        if proc.returncode != 0:
+            return f"reference build failed rc={proc.returncode}: " \
+                   f"{out.decode()[-300:]}"
+    return "" if os.path.exists(_REFLIB) else "reference build produced no lib"
 
 
 @pytest.fixture(scope="module")
 def reflgb():
+    reason = _ensure_reference_built()
+    if reason:
+        pytest.skip(reason)
     sys.path.insert(0, REFPKG)
     import lightgbm
     return lightgbm

@@ -1,21 +1,33 @@
 #!/bin/sh
-# Build the reference LightGBM (/root/reference) out-of-source and stage the
-# Python package with the fresh lib at /tmp/refpkg for tests/test_parity.py.
+# Build the reference LightGBM out-of-tree and stage the Python package with
+# the fresh lib at /tmp/refpkg for tests/test_parity.py.
 #
 # The reference CMakeLists pins EXECUTABLE/LIBRARY_OUTPUT_PATH to its own
-# (read-only-by-policy) source dir (CMakeLists.txt:199-200), so the binaries
-# land there during `make` and are immediately moved out.
+# source dir with a plain SET() (CMakeLists.txt:199-200) which cannot be
+# overridden from the command line, and /root/reference is read-only by
+# policy — so the source tree is first copied to a scratch dir and built
+# there (~2 min).
 set -e
+SRC=${3:-/tmp/refsrc}
 BUILD=${1:-/tmp/lgb_build}
 PKG=${2:-/tmp/refpkg}
+if [ ! -f "$SRC/.copy_complete" ]; then
+    # stage into a temp dir and rename so an interrupted copy can never
+    # leave a half-populated cache that later runs mistake for complete
+    rm -rf "$SRC" "$SRC.tmp"
+    mkdir -p "$SRC.tmp"
+    cp -r /root/reference/CMakeLists.txt /root/reference/src \
+          /root/reference/include /root/reference/compute \
+          /root/reference/python-package /root/reference/VERSION.txt \
+          "$SRC.tmp/"
+    touch "$SRC.tmp/.copy_complete"
+    mv "$SRC.tmp" "$SRC"
+fi
 mkdir -p "$BUILD"
 cd "$BUILD"
-cmake /root/reference -DCMAKE_BUILD_TYPE=Release > cmake.log 2>&1
+cmake "$SRC" -DCMAKE_BUILD_TYPE=Release > cmake.log 2>&1
 make -j"$(nproc)" > make.log 2>&1
-for f in lightgbm lib_lightgbm.so; do
-    [ -f "/root/reference/$f" ] && mv "/root/reference/$f" "$BUILD/$f"
-done
 mkdir -p "$PKG"
-cp -r /root/reference/python-package/lightgbm "$PKG/"
-cp "$BUILD/lib_lightgbm.so" "$PKG/lightgbm/"
-echo "reference staged: $PKG/lightgbm (CLI: $BUILD/lightgbm)"
+cp -r "$SRC/python-package/lightgbm" "$PKG/"
+cp "$SRC/lib_lightgbm.so" "$PKG/lightgbm/"
+echo "reference staged: $PKG/lightgbm (CLI: $SRC/lightgbm)"
