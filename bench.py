@@ -611,8 +611,12 @@ def main():
     cpu_result = None
     emitted_state = None   # dedup: (n tpu stages, cpu done?, note)
 
+    abandon_reason = None   # set when TPU attempts are abandoned mid-run
+
     def note_now():
         if not try_tpu:
+            if abandon_reason:
+                return abandon_reason
             return ("BENCH_FORCE_CPU=1" if force_cpu
                     else "no TPU plugin in environment")
         exhausted = remaining_budget() <= 120
@@ -692,6 +696,8 @@ def main():
                 # transient tunnel failure — stop burning budget on retries
                 log("plugin resolved to CPU backend; abandoning TPU attempts")
                 try_tpu = False
+                abandon_reason = ("tpu plugin present but backend resolved "
+                                  "to CPU (tunnel did not yield a TPU)")
                 refresh_emission()
                 break
             if remaining_budget() < 300:

@@ -423,11 +423,15 @@ class GBDT:
         self._cegb_coupled_pen = None
         self._cegb_lazy_pen = None
         if cegb_enabled:
-            if self._mesh is not None and self.tree_learner_type in (
-                    "feature", "voting"):
+            if self._mesh is not None and self.tree_learner_type == "voting":
+                # recorded design exclusion (see grower.py): exact CEGB
+                # needs global per-feature candidates, which voting exists
+                # to avoid materializing — data-parallel gives the same
+                # result at honest cost
                 raise NotImplementedError(
-                    "CEGB is implemented for the serial and data-parallel "
-                    "learners; use tree_learner=serial or data")
+                    "CEGB needs global per-feature candidates; "
+                    "voting-parallel exists to avoid building exactly "
+                    "those — use tree_learner=data with CEGB instead")
             for name, lst in (("cegb_penalty_feature_coupled", coupled),
                               ("cegb_penalty_feature_lazy", lazy)):
                 if lst and len(lst) != ntf:
@@ -436,14 +440,37 @@ class GBDT:
                         f"{name} should be the same size as feature number "
                         f"({len(lst)} vs {ntf})")
             uf = np.asarray(self.train_set.used_features, np.int64)
+
+            def _pen_device_layout(vals):
+                """Inner-feature penalties -> the grower's global feature
+                order (device-slot order under feature sharding; pad slots
+                get zero penalty so they can never be selected anyway)."""
+                p = np.asarray(vals, np.float32)[uf]
+                if self._feat_perm is not None:
+                    p = np.concatenate([p, np.zeros(1, np.float32)])[
+                        self._feat_perm]
+                elif self._feature_axis is not None and self._f_pad > len(p):
+                    p = np.concatenate(
+                        [p, np.zeros(self._f_pad - len(p), np.float32)])
+                return jnp.asarray(p)
+
             if coupled:
-                self._cegb_coupled_pen = jnp.asarray(
-                    np.asarray(coupled, np.float32)[uf])
+                self._cegb_coupled_pen = _pen_device_layout(coupled)
             if lazy:
-                self._cegb_lazy_pen = jnp.asarray(
-                    np.asarray(lazy, np.float32)[uf])
+                self._cegb_lazy_pen = _pen_device_layout(lazy)
         self._cegb_enabled = cegb_enabled
         forced_plan = self._build_forced_plan()
+        if forced_plan is not None and self._feat_perm is not None:
+            # the grower under sharded-EFB feature layout numbers features
+            # by padded DEVICE slot; the plan is built in inner numbering
+            Fi = len(self.train_set.used_features)
+            inv = np.zeros(Fi, np.int64)
+            slot_is_real = self._feat_perm < Fi
+            inv[self._feat_perm[slot_is_real]] = \
+                np.nonzero(slot_is_real)[0].astype(np.int64)
+            forced_plan = (forced_plan[0],
+                           inv[np.asarray(forced_plan[1], np.int64)],
+                           forced_plan[2])
         # resolve hist_method="auto" by MEASURING the kernel variants on
         # the live accelerator at the training shape (reference: the
         # GetShareStates col-vs-row timed probe, dataset.cpp:589-684);
@@ -473,9 +500,13 @@ class GBDT:
             cegb_coupled=bool(coupled),
             cegb_lazy=bool(lazy),
             n_forced=0 if forced_plan is None else len(forced_plan[0]),
+            forced_exact_parity=self.config.tpu_forced_split_parity,
         )
-        # cross-tree CEGB device state (reference keeps it in the learner)
-        F_inner = len(self.train_set.used_features)
+        # cross-tree CEGB device state (reference keeps it in the learner),
+        # indexed by the grower's GLOBAL feature id (device slots under
+        # feature sharding)
+        F_inner = (self._f_pad if self._feature_axis is not None
+                   else len(self.train_set.used_features))
         used0 = jnp.zeros((F_inner,), bool)
         rows0 = jnp.zeros((F_inner, self._n_pad) if lazy else (1, 1), bool)
         if lazy and self._mesh is not None and self._data_axis is not None:

@@ -210,6 +210,12 @@ class GrowerConfig(NamedTuple):
     cegb_lazy: bool = False        # static: per-row on-demand penalties
     n_forced: int = 0              # static count of forced splits (reference
                                    # ForceSplits, serial_tree_learner.cpp:411)
+    forced_exact_parity: bool = False  # reproduce the reference's
+                                   # GatherInfoForThreshold stats convention
+                                   # (bin == threshold accumulates RIGHT,
+                                   # feature_histogram.hpp:527 — one bin off
+                                   # vs its own DataPartition::Split) so
+                                   # forced-split trees match bit-for-bit
 
 
 def _psum(x, axis_name):
@@ -373,24 +379,56 @@ def grow_tree(
 
     voting = (cfg.voting_top_k > 0 and axis_name is not None)
     if voting and feature_axis_name is not None:
+        # recorded design exclusion (not a gap vs the reference): the
+        # reference's tree_learner is a single choice of
+        # serial|feature|data|voting — its factory cross product is
+        # (learner x device), never (learner x learner)
+        # (src/treelearner/tree_learner.cpp:13-36).  Voting elects features
+        # to compress the DATA-axis histogram reduction; sharding features
+        # at the same time removes the very all-feature local histograms
+        # the vote is computed from.  The data x feature 2-D mesh already
+        # exceeds the reference's composition surface.
         raise NotImplementedError("voting-parallel is a data-axis mode; "
-                                  "combine with feature sharding is not "
-                                  "supported")
+                                  "combining it with feature sharding is "
+                                  "contradictory (the vote needs all-"
+                                  "feature local histograms) — use a "
+                                  "data x feature mesh without voting")
 
     # CEGB (reference: cost_effective_gradient_boosting.hpp) — penalties are
     # subtracted from candidate gains; candidates are cached per
     # (leaf, feature) penalty-free and penalized at selection time, so the
     # coupled penalty disappears for EVERY cached candidate the moment a
-    # feature is first used (UpdateLeafBestSplits semantics, made exact)
+    # feature is first used (UpdateLeafBestSplits semantics, made exact).
+    # CEGB state (used-feature flags, lazy paid-rows bitmap, penalty
+    # arrays) is indexed by GLOBAL feature id even under feature sharding;
+    # per-shard views are sliced at the use sites below.
     cegb_enabled = (cfg.cegb_penalty_split > 0.0 or cfg.cegb_coupled
                     or cfg.cegb_lazy)
-    if cegb_enabled and (voting or feature_axis_name is not None):
+    F_glob = len(meta.num_bin)    # global feature count (== F when unsharded)
+    if cegb_enabled and voting:
+        # recorded design exclusion: this build's CEGB is EXACT — it keeps
+        # a per-(leaf, feature) candidate cache built from global
+        # histograms and penalizes at selection time.  Voting exists to
+        # avoid materializing global per-feature candidates (only elected
+        # features' histograms are ever summed), so exact CEGB under
+        # voting would psum every feature's histogram and degenerate
+        # voting into data-parallel.  Use tree_learner=data for CEGB at
+        # scale (same result, honest cost).
         raise NotImplementedError(
-            "CEGB is implemented for the serial and data-parallel learners")
+            "CEGB needs global per-feature candidates; voting-parallel "
+            "exists to avoid building exactly those — use "
+            "tree_learner=data with CEGB instead")
     if cegb_feat_used is None:
-        cegb_feat_used = jnp.zeros(F, bool)
+        cegb_feat_used = jnp.zeros(F_glob, bool)
     if cegb_used_rows is None:
-        cegb_used_rows = jnp.zeros((F, n) if cfg.cegb_lazy else (1, 1), bool)
+        cegb_used_rows = jnp.zeros((F_glob, n) if cfg.cegb_lazy else (1, 1),
+                                   bool)
+
+    def _shard_view(arr, axis=0):
+        """Slice a globally-indexed per-feature array to this shard."""
+        if feature_axis_name is None:
+            return arr
+        return lax.dynamic_slice_in_dim(arr, f_offset, F, axis=axis)
 
     def cegb_gains(fb: "_LeafFeatBest", leaf_cnt_arr, used):
         """[L, F] penalized gains from the candidate cache (the reference's
@@ -402,8 +440,9 @@ def grow_tree(
                          * leaf_cnt_arr[:, None])
         if cfg.cegb_coupled:
             pen = pen + jnp.where(
-                used[None, :], 0.0,
-                cfg.cegb_tradeoff * cegb_coupled_penalty[None, :])
+                _shard_view(used)[None, :], 0.0,
+                cfg.cegb_tradeoff
+                * _shard_view(cegb_coupled_penalty)[None, :])
         if cfg.cegb_lazy:
             pen = pen + fb.lazy_pen
         return jnp.where(jnp.isfinite(fb.gain), fb.gain - pen, -jnp.inf)
@@ -415,14 +454,27 @@ def grow_tree(
         paid for the feature)."""
         if not cfg.cegb_lazy:
             return jnp.zeros((F,), jnp.float32)
-        cnt = (~used_rows).astype(jnp.float32) @ in_leaf.astype(jnp.float32)
-        return cfg.cegb_tradeoff * cegb_lazy_penalty * _psum(cnt, axis_name)
+        rows_l = _shard_view(used_rows)
+        cnt = (~rows_l).astype(jnp.float32) @ in_leaf.astype(jnp.float32)
+        return (cfg.cegb_tradeoff * _shard_view(cegb_lazy_penalty)
+                * _psum(cnt, axis_name))
+
+    def cegb_global_best_gain(fb, leaf_cnt_arr, used, num_leaves):
+        """Scalar max penalized gain over active leaves, merged across
+        feature shards — computed in the loop BODY and carried so the
+        while-loop cond stays collective-free and replicated."""
+        active = jnp.arange(L) < num_leaves
+        g = cegb_gains(fb, leaf_cnt_arr, used)
+        m = jnp.max(jnp.where(active[:, None], g, -jnp.inf))
+        if feature_axis_name is not None:
+            m = lax.pmax(m, feature_axis_name)
+        return m
 
     # per-node randomness: extra_trees thresholds + by-node column sampling.
     # The key is REPLICATED across shards (reference syncs random seeds
     # across machines, application.cpp:169-174); by-node masks are sampled
     # over the GLOBAL feature axis then sliced per shard.
-    F_total = len(meta.num_bin)
+    F_total = F_glob
     use_rng = hp.extra_trees or cfg.bynode_feature_cnt > 0
     if use_rng and rng_key is None:
         rng_key = jax.random.PRNGKey(0)
@@ -596,9 +648,11 @@ def grow_tree(
         split_idx: jax.Array  # number of splits applied so far
         leaf_min: jax.Array   # [L] monotone lower bounds
         leaf_max: jax.Array   # [L] monotone upper bounds
-        cegb_used: jax.Array  # [F] bool: features used in any split
-        cegb_rows: jax.Array  # [F, n] bool lazy-paid rows ([1,1] dummy)
+        cegb_used: jax.Array  # [F_glob] bool: features used in any split
+        cegb_rows: jax.Array  # [F_glob, n] bool lazy-paid rows ([1,1] dummy)
         forced_aborted: jax.Array  # scalar bool: forced plan abandoned
+        cegb_next_gain: jax.Array  # scalar f32: globally-merged best
+        #                            penalized gain (dummy 0 when CEGB off)
 
     def current_selection(c: Carry):
         """Best-first choice: (leaf, SplitResult) of the max-gain leaf."""
@@ -622,6 +676,19 @@ def grow_tree(
                 right_count=c.leaf_cnt[leaf] - lc,
                 is_categorical=is_cat_b[f],
                 cat_bitset=c.best.cat_bitset[leaf, f])
+            if feature_axis_name is not None:
+                # each shard proposes its local (leaf, feature) winner;
+                # the global choice is the max gain across shards (gather
+                # order = shard order, so exact ties resolve to the
+                # smaller global feature id — the reference's SplitInfo
+                # tie-break, split_info.hpp:126)
+                r = r._replace(feature=r.feature + f_offset)
+                gathered = jax.tree_util.tree_map(
+                    lambda x: lax.all_gather(x, feature_axis_name),
+                    (leaf, r))
+                winner = jnp.argmax(gathered[1].gain)
+                leaf, r = jax.tree_util.tree_map(
+                    lambda x: x[winner], gathered)
         else:
             b = c.best
             gains = jnp.where(active, b.gain, -jnp.inf)
@@ -641,10 +708,6 @@ def grow_tree(
         return leaf, r
 
     if cfg.n_forced > 0:
-        if voting or feature_axis_name is not None:
-            raise NotImplementedError(
-                "forced splits are implemented for the serial and "
-                "data-parallel learners")
         fp_leaf = jnp.asarray(forced_plan[0], jnp.int32)
         fp_feat = jnp.asarray(forced_plan[1], jnp.int32)
         fp_thr = jnp.asarray(forced_plan[2], jnp.int32)
@@ -653,11 +716,19 @@ def grow_tree(
             """Stats for the current forced step's planned split.
 
             reference: GatherInfoForThreshold (feature_histogram.hpp:486).
-            Deliberate deviation: left/right masses here follow this
-            grower's own partition rule (bin <= threshold goes left,
-            missing follows default_left=True), where the reference's
-            gather assigns bin == threshold to the RIGHT — one bin off vs
-            its own DataPartition::Split.
+            Left/right masses follow this grower's partition rule; with
+            cfg.forced_exact_parity the reference's own convention
+            (bin == threshold goes RIGHT) is reproduced instead — see
+            the deviation note in docs/COMPONENTS.md.
+
+            Learner coverage: under feature sharding the planned feature
+            lives on one shard — it computes the left-mass and the others
+            receive it by a psum-select (the same owner-broadcast pattern
+            as apply_split's partition).  Under voting-parallel the
+            histogram cache is shard-local, so the leaf's group histogram
+            is psum'd over the data axis first (forced steps are few;
+            this one collective replaces the reference's reduce-scatter
+            on the forced path).
             """
             from .binning import MissingType
             s = c.split_idx
@@ -665,19 +736,46 @@ def grow_tree(
             feat = fp_feat[s]
             thr = fp_thr[s]
             sg, sh, cnt = c.leaf_sg[leaf], c.leaf_sh[leaf], c.leaf_cnt[leaf]
-            hist_f = expand_hist(c.hist[leaf], sg, sh, cnt)[feat]   # [B, 3]
+            h_leaf = c.hist[leaf]
+            if voting:
+                h_leaf = _psum(h_leaf, axis_name)   # local -> global hist
+            if feature_axis_name is not None:
+                lf_raw = feat - f_offset
+                owns = (lf_raw >= 0) & (lf_raw < F)
+                lf = jnp.clip(lf_raw, 0, F - 1)
+            else:
+                owns = jnp.bool_(True)
+                lf = feat
+            hist_f = expand_hist(h_leaf, sg, sh, cnt)[lf]   # [B, 3]
             b = jnp.arange(B, dtype=jnp.int32)
-            nb = num_bin[feat]
-            mt = missing_type[feat]
-            db = default_bin[feat]
-            cat = is_cat_b[feat]
+            nb = num_bin[lf]
+            mt = missing_type[lf]
+            db = default_bin[lf]
+            cat = is_cat_b[lf]
             valid = b < nb
             miss_bin = jnp.where(mt == MissingType.NAN, nb - 1,
                                  jnp.where(mt == MissingType.ZERO, db, -1))
-            sel_num = valid & ((b <= thr) | (b == miss_bin))
+            if cfg.forced_exact_parity:
+                # reference stats convention: bins >= threshold accumulate
+                # on the RIGHT (GatherInfoForThresholdNumerical's loop
+                # breaks at t + offset < threshold), default/NaN bins are
+                # skipped from the right pass — i.e. land LEFT
+                sel_num = valid & ((b < thr) | (b == miss_bin))
+            else:
+                # self-consistent rule: stats follow this grower's own
+                # partition (bin <= threshold goes left), avoiding the
+                # reference's stats-vs-partition one-bin mismatch
+                sel_num = valid & ((b <= thr) | (b == miss_bin))
             sel_cat = valid & (b == thr)   # one-hot categorical forced split
             sel = jnp.where(cat, sel_cat, sel_num)
             lsum = jnp.sum(jnp.where(sel[:, None], hist_f, 0.0), axis=0)
+            if feature_axis_name is not None:
+                # owner shard broadcasts its numbers (and the categorical
+                # flag, which downstream bitset/default_left logic needs)
+                lsum = lax.psum(jnp.where(owns, lsum, 0.0),
+                                feature_axis_name)
+                cat = lax.psum(jnp.where(owns, cat.astype(jnp.float32),
+                                         0.0), feature_axis_name) > 0.5
             lg, lh, lc = lsum[0], lsum[1], lsum[2]
             rg, rh, rc = sg - lg, sh - lh, cnt - lc
             parent_gain = leaf_gain(sg, sh + 2 * K_EPSILON,
@@ -703,8 +801,10 @@ def grow_tree(
     def cond(c: Carry):
         active = jnp.arange(L) < c.tree.num_leaves
         if cegb_enabled:
-            g = cegb_gains(c.best, c.leaf_cnt, c.cegb_used)
-            best_gain = jnp.max(jnp.where(active[:, None], g, -jnp.inf))
+            # carried scalar (computed in the body, pmax-merged across
+            # feature shards there) — collectives are not allowed in a
+            # while-loop cond, and a per-shard max would diverge
+            best_gain = c.cegb_next_gain
         else:
             best_gain = jnp.max(jnp.where(active, c.best.gain, -jnp.inf))
         more = best_gain > 0.0
@@ -868,9 +968,12 @@ def grow_tree(
                            bounds=bounds_r, key=kr)
             best = best.store(leaf, rl).store(new_leaf, rr)
 
+        next_gain = (cegb_global_best_gain(best, leaf_cnt, cegb_used,
+                                           tree.num_leaves)
+                     if cegb_enabled else jnp.float32(0.0))
         return Carry(tree, best, hist, leaf_sg, leaf_sh, leaf_cnt,
                      leaf_parent_side, leaf_id, s + 1, leaf_min, leaf_max,
-                     cegb_used, cegb_rows, c.forced_aborted)
+                     cegb_used, cegb_rows, c.forced_aborted, next_gain)
 
     def body(c: Carry) -> Carry:
         leaf, r = current_selection(c)
@@ -881,8 +984,24 @@ def grow_tree(
         # planned split replaces the best-first choice; a failed forced
         # split (non-positive gain) abandons the REST of the plan and
         # training continues best-first (abort_last_forced_split :507-519)
-        f_leaf, f_r = forced_split_result(c)
+        # forced work (with its voting/feature-shard collectives) runs
+        # ONLY while the plan lasts — the predicate is replicated, so
+        # every shard takes the same branch and the collectives stay
+        # matched; after the forced phase, splits pay nothing extra
         in_forced = (c.split_idx < cfg.n_forced) & ~c.forced_aborted
+
+        def _forced_dummy(cc):
+            z = jnp.float32(0.0)
+            return jnp.int32(0), SplitResult(
+                gain=jnp.float32(-jnp.inf), feature=jnp.int32(0),
+                threshold=jnp.int32(0), default_left=jnp.bool_(True),
+                left_sum_grad=z, left_sum_hess=z, left_count=z,
+                right_sum_grad=z, right_sum_hess=z, right_count=z,
+                is_categorical=jnp.bool_(False),
+                cat_bitset=jnp.zeros((MAX_CAT_WORDS,), jnp.uint32))
+
+        f_leaf, f_r = lax.cond(in_forced, forced_split_result,
+                               _forced_dummy, c)
         ok = f_r.gain > 0.0
         apply_forced = in_forced & ok
         aborted = c.forced_aborted | (in_forced & ~ok)
@@ -895,10 +1014,13 @@ def grow_tree(
                        lambda cc: cc, c)
         return out._replace(forced_aborted=aborted)
 
+    init_gain = (cegb_global_best_gain(best, leaf_cnt, cegb_feat_used,
+                                       tree.num_leaves)
+                 if cegb_enabled else jnp.float32(0.0))
     init = Carry(tree, best, hist_cache, leaf_sg, leaf_sh, leaf_cnt,
                  leaf_parent_side, leaf_id, jnp.array(0, jnp.int32),
                  leaf_min, leaf_max, cegb_feat_used, cegb_used_rows,
-                 jnp.array(False))
+                 jnp.array(False), init_gain)
     out = lax.while_loop(cond, body, init)
 
     # finalize leaf values (clamped to monotone bounds, reference:

@@ -214,3 +214,27 @@ class TestForcedSplits:
         mse0 = float(np.mean((b0.predict(X) - y) ** 2))
         mse1 = float(np.mean((b1.predict(X) - y) ** 2))
         assert mse1 < mse0 * 1.5
+
+
+def test_forced_exact_parity_stats_convention(tmp_path):
+    """tpu_forced_split_parity reproduces the reference's
+    GatherInfoForThreshold stats convention (bin == threshold accumulates
+    RIGHT, feature_histogram.hpp:527), which is one bin off from the
+    default self-consistent rule (bin <= threshold left).  With mass in
+    the threshold bin the recorded left count must strictly shrink."""
+    X, y = _data(800, 4)
+    fn = os.path.join(str(tmp_path), "forced.json")
+    with open(fn, "w") as f:
+        json.dump({"feature": 0, "threshold": 0.5}, f)
+    base = dict(BASE, num_leaves=2, forcedsplits_filename=fn)
+    b_def = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=1)
+    b_par = lgb.train(dict(base, tpu_forced_split_parity=True),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+    t_def, t_par = b_def.boosting.models[0], b_par.boosting.models[0]
+    assert int(t_def.split_feature[0]) == 0
+    assert int(t_par.split_feature[0]) == 0
+    assert int(t_def.threshold_in_bin[0]) == int(t_par.threshold_in_bin[0])
+    l_def, r_def = float(t_def.leaf_count[0]), float(t_def.leaf_count[1])
+    l_par, r_par = float(t_par.leaf_count[0]), float(t_par.leaf_count[1])
+    assert l_def + r_def == l_par + r_par == len(X)
+    assert l_par < l_def            # threshold-bin mass moved to the right

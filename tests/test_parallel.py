@@ -418,3 +418,156 @@ def test_network_machine_list_mapping():
     import pytest
     with pytest.raises(ValueError):
         resolve_rank([("10.9.9.9", 1)])
+
+
+# ---------------------------------------------------------------------------
+# learner-combination matrix: CEGB and forced splits compose with the
+# distributed learners (the reference wires both through SerialTreeLearner
+# hooks shared by every learner, serial_tree_learner.cpp:65-68,411-521,
+# 529-532; here the sharded growers must match serial exactly)
+
+def _struct_match(a, b):
+    assert len(a.boosting.models) == len(b.boosting.models)
+    for ms, mf in zip(a.boosting.models, b.boosting.models):
+        np.testing.assert_array_equal(ms.split_feature, mf.split_feature)
+        np.testing.assert_array_equal(ms.threshold_in_bin,
+                                      mf.threshold_in_bin)
+
+
+def test_cegb_feature_parallel_matches_serial():
+    import lightgbm_tpu as lgb
+    X, y = _binary_xy()
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+            "min_data_in_leaf": 20, "enable_bundle": False,
+            "cegb_penalty_split": 0.002,
+            "cegb_penalty_feature_coupled": [0.3] * X.shape[1]}
+    bst_s = lgb.train(dict(base, tree_learner="serial"),
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bst_f = lgb.train(dict(base, tree_learner="feature"),
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bst_f.boosting._mesh is not None
+    # the penalties actually bit: the CEGB model must differ from plain
+    plain = lgb.train({k: v for k, v in base.items()
+                       if not k.startswith("cegb")},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    assert not np.allclose(plain.predict(X), bst_s.predict(X)), \
+        "test premise: CEGB penalties changed the model"
+    _struct_match(bst_s, bst_f)
+    np.testing.assert_allclose(bst_s.predict(X), bst_f.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cegb_lazy_feature_parallel_matches_serial():
+    import lightgbm_tpu as lgb
+    X, y = _binary_xy()
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+            "min_data_in_leaf": 20, "enable_bundle": False,
+            "cegb_penalty_feature_lazy": [0.004] * X.shape[1]}
+    bst_s = lgb.train(dict(base, tree_learner="serial"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    bst_f = lgb.train(dict(base, tree_learner="feature"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    _struct_match(bst_s, bst_f)
+    np.testing.assert_allclose(bst_s.predict(X), bst_f.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cegb_feature_parallel_with_efb_matches_serial():
+    """CEGB under the sharded-EFB layout: penalties/used-state ride in
+    device-slot order (padded, permuted) and must still match serial."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    n = 500
+    groups = rng.randint(0, 8, size=n)
+    X = np.zeros((n, 8), np.float32)
+    X[np.arange(n), groups] = rng.rand(n) + 0.5
+    X = np.concatenate([X, rng.rand(n, 4).astype(np.float32)], axis=1)
+    y = ((groups % 2) ^ (X[:, 8] > 0.5)).astype(np.float32)
+    base = {"objective": "binary", "verbosity": -1, "min_data_in_leaf": 5,
+            "num_leaves": 15,
+            "cegb_penalty_feature_coupled": [0.2] * X.shape[1]}
+    bst_s = lgb.train(dict(base, tree_learner="serial"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    bst_f = lgb.train(dict(base, tree_learner="feature"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    assert bst_f.boosting._feat_perm is not None, "EFB shard layout in use"
+    _struct_match(bst_s, bst_f)
+    np.testing.assert_allclose(bst_s.predict(X), bst_f.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cegb_data_parallel_matches_serial():
+    import lightgbm_tpu as lgb
+    X, y = _binary_xy()
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+            "min_data_in_leaf": 20, "cegb_penalty_split": 0.002,
+            "cegb_penalty_feature_lazy": [0.002] * X.shape[1]}
+    bst_s = lgb.train(dict(base, tree_learner="serial"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    bst_d = lgb.train(dict(base, tree_learner="data"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    _struct_match(bst_s, bst_d)
+    np.testing.assert_allclose(bst_s.predict(X), bst_d.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _forced_json(tmp_path, spec):
+    import json
+    import os
+    fn = os.path.join(str(tmp_path), "forced.json")
+    with open(fn, "w") as f:
+        json.dump(spec, f)
+    return fn
+
+
+def test_forced_splits_feature_parallel_matches_serial(tmp_path):
+    import lightgbm_tpu as lgb
+    X, y = _binary_xy()
+    fn = _forced_json(tmp_path, {
+        "feature": 3, "threshold": 0.5,
+        "left": {"feature": 1, "threshold": 0.4}})
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+            "min_data_in_leaf": 20, "enable_bundle": False,
+            "forcedsplits_filename": fn}
+    bst_s = lgb.train(dict(base, tree_learner="serial"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    bst_f = lgb.train(dict(base, tree_learner="feature"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    # forced structure honored: root split on feature 3
+    for m in bst_s.boosting.models:
+        assert int(m.split_feature[0]) == 3
+    _struct_match(bst_s, bst_f)
+    np.testing.assert_allclose(bst_s.predict(X), bst_f.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_forced_splits_voting_parallel_matches_serial(tmp_path):
+    import lightgbm_tpu as lgb
+    X, y = _binary_xy()
+    fn = _forced_json(tmp_path, {"feature": 2, "threshold": 0.6})
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+            "min_data_in_leaf": 20, "forcedsplits_filename": fn}
+    bst_s = lgb.train(dict(base, tree_learner="serial"),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    bst_v = lgb.train(dict(base, tree_learner="voting", top_k=X.shape[1]),
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    assert bst_v.boosting.grower_cfg.voting_top_k == X.shape[1]
+    for m in bst_v.boosting.models:
+        assert int(m.split_feature[0]) == 2
+    _struct_match(bst_s, bst_v)
+    np.testing.assert_allclose(bst_s.predict(X), bst_v.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cegb_voting_raises_with_rationale():
+    """CEGB x voting is a recorded design exclusion (exact CEGB needs the
+    global per-feature candidates voting exists to avoid building)."""
+    import pytest
+
+    import lightgbm_tpu as lgb
+    X, y = _binary_xy()
+    with pytest.raises(NotImplementedError, match="tree_learner=data"):
+        lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7,
+                   "tree_learner": "voting", "top_k": 3,
+                   "cegb_penalty_split": 0.01},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
