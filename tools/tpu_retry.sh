@@ -1,0 +1,14 @@
+#!/bin/sh
+# Keep attempting the single-process TPU measurement session until the
+# tunnel yields a backend (wedge cycles block ~25 min then UNAVAILABLE).
+cd /root/repo
+i=0
+while [ $i -lt 12 ]; do
+    i=$((i+1))
+    echo "[tpu_retry] attempt $i $(date -u +%H:%M:%S)"
+    python tools/tpu_measure.py /root/repo/tpu_measure_r5_att$i.json
+    rc=$?
+    echo "[tpu_retry] attempt $i exited rc=$rc"
+    if [ $rc -eq 0 ]; then break; fi
+    sleep 90
+done
