@@ -444,8 +444,10 @@ class Booster:
             data = data.values
         n_feat = (data.shape[1] if hasattr(data, "shape")
                   and len(getattr(data, "shape", ())) == 2 else None)
+        disable_check = kwargs.get("predict_disable_shape_check",
+                                   self.config.predict_disable_shape_check)
         if (n_feat is not None and n_feat != self.num_features()
-                and not kwargs.get("predict_disable_shape_check")):
+                and not disable_check):
             raise LightGBMError(
                 f"The number of features in data ({n_feat}) is not the same "
                 f"as it was in training data ({self.num_features()}).\n"

@@ -97,8 +97,84 @@ def main():
     guard("ranking",
           bench.run_ranking_bench, 12_000, 100, 100, 255, 63)
 
+    # sparse-story shapes (BASELINE.md GPU table): Epsilon-like 400k x 2000
+    # dense and Bosch-like 1.2M x 968 ~80% sparse must train without OOM
+    # on one chip; peak HBM is banked via run's device_memory_stats
+    guard("epsilon_like", _wide_dense_bench, 400_000, 2000, 30)
+    guard("bosch_like", _sparse_bench, 1_200_000, 968, 30)
+
     bank("done", total_seconds=round(time.time() - T0, 1))
     return 0
+
+
+def _wide_dense_bench(n, f, trees):
+    """Epsilon-shaped: wide dense float features (no EFB possible)."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, f).astype(np.float32)
+    w = np.random.RandomState(7).randn(f).astype(np.float32) / np.sqrt(f)
+    y = ((X @ w + 0.1 * rng.randn(n)) > 0).astype(np.float32)
+    return _train_timed(X, y, trees, max_bin=63, leaves=255)
+
+
+def _sparse_bench(n, f, trees, density=0.2):
+    """Bosch-shaped: ~80% of entries missing (NaN); EFB + NaN missing-type
+    handling carry the memory story."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    X = np.full((n, f), np.nan, np.float32)
+    # each row gets a random ~density subset of features (int32 indices and
+    # chunked label math keep transient host memory ~bounded by X itself)
+    nz = int(f * density)
+    cols = rng.randint(0, f, size=(n, nz)).astype(np.int32)
+    vals = rng.rand(n, nz).astype(np.float32)
+    np.put_along_axis(X, cols, vals, axis=1)
+    w = np.random.RandomState(7).randn(f).astype(np.float32)
+    sig = np.empty(n, np.float32)
+    step = 100_000
+    for i in range(0, n, step):
+        sig[i:i + step] = np.nansum(X[i:i + step] * w[None, :], axis=1)
+    y = (sig > np.median(sig)).astype(np.float32)
+    del cols, vals, sig
+    return _train_timed(X, y, trees, max_bin=63, leaves=255)
+
+
+def _train_timed(X, y, trees, max_bin, leaves):
+    """bench.py's timing protocol (params at Dataset creation, compile on
+    iteration 1, steady-state rescaled by T/(T-1)) on an arbitrary matrix."""
+    import bench
+    import jax
+
+    import lightgbm_tpu as lgb
+    n, f = X.shape
+    params = {"objective": "binary", "num_leaves": leaves,
+              "learning_rate": 0.1, "max_bin": max_bin,
+              "metric": "None", "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    t0 = time.perf_counter()
+    ds.construct()
+    bin_seconds = time.perf_counter() - t0
+    groups = int(ds.binned.shape[1])
+    booster = lgb.Booster(params=params, train_set=ds)
+    t0 = time.perf_counter()
+    booster.update()
+    jax.block_until_ready(booster.boosting.train_score)
+    compile_seconds = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(trees - 1):
+        booster.update()
+    jax.block_until_ready(booster.boosting.train_score)
+    elapsed = (time.perf_counter() - t0) * trees / max(trees - 1, 1)
+    out = {
+        "rows": n, "features": f, "groups_after_efb": groups,
+        "trees": trees,
+        "device_matrix_mb": round(n * groups / 1e6, 1),
+        "bin_seconds": round(bin_seconds, 2),
+        "compile_seconds": round(compile_seconds, 2),
+        "sec_per_tree": round(elapsed / trees, 4),
+    }
+    out.update(bench.device_memory_stats())
+    return out
 
 
 if __name__ == "__main__":

@@ -1,14 +1,21 @@
 #!/bin/sh
 # Keep attempting the single-process TPU measurement session until the
 # tunnel yields a backend (wedge cycles block ~25 min then UNAVAILABLE).
+# Success = the banked JSON contains the "done" stage (the process exits 0
+# even when individual stages bank errors, so the exit code alone is not a
+# success signal).
 cd /root/repo
 i=0
 while [ $i -lt 12 ]; do
     i=$((i+1))
+    out=/root/repo/tpu_measure_r5_att$i.json
     echo "[tpu_retry] attempt $i $(date -u +%H:%M:%S)"
-    python tools/tpu_measure.py /root/repo/tpu_measure_r5_att$i.json
+    python tools/tpu_measure.py "$out"
     rc=$?
     echo "[tpu_retry] attempt $i exited rc=$rc"
-    if [ $rc -eq 0 ]; then break; fi
+    if grep -q '"stage": "done"' "$out" 2>/dev/null; then
+        echo "[tpu_retry] attempt $i banked a complete session; stopping"
+        break
+    fi
     sleep 90
 done
