@@ -119,6 +119,12 @@ class LGBMModel:
     def _default_objective(self) -> str:
         return "regression"
 
+    def _default_eval_metric(self) -> str:
+        """Metric deduced from the estimator class when the objective is a
+        custom callable (reference: sklearn.py fit's original_metric
+        deduction) — keeps early stopping usable with custom objectives."""
+        return "l2"
+
     # -- fitting -------------------------------------------------------------
 
     def fit(self, X, y, sample_weight=None, init_score=None, group=None,
@@ -128,15 +134,41 @@ class LGBMModel:
             feature_name="auto", categorical_feature="auto", callbacks=None,
             init_model=None) -> "LGBMModel":
         params = self._process_params("fit")
-        if eval_metric is not None and not callable(eval_metric):
-            params["metric"] = eval_metric
+        # metric resolution (reference sklearn.py fit): start from the
+        # params metric, or — when absent — the objective name as a metric
+        # alias (the factory resolves "regression"->l2 etc.) or the class
+        # default for callable objectives; then UNION with eval_metric
+        # strings (eval_metric adds metrics, it does not replace)
+        pm = params.get("metric")
+        pm = [pm] if isinstance(pm, str) else list(pm or [])
+        if not pm:
+            if callable(self.objective):
+                pm = [self._default_eval_metric()]
+            # else: engine derives the objective's default metric itself
+        em, feval_fns = [], []
+        if eval_metric is not None:
+            em_raw = ([eval_metric] if isinstance(eval_metric, str)
+                      or callable(eval_metric) else list(eval_metric))
+            em = [m for m in em_raw if not callable(m)]
+            feval_fns = [m for m in em_raw if callable(m)]
+        if em and not pm:
+            pm = [str(params.get("objective", self._default_objective()))]
+        merged = pm + [m for m in em if m not in pm]
+        if merged:
+            params["metric"] = merged
+        if getattr(self, "_eval_at", None):
+            params["eval_at"] = list(self._eval_at)
 
-        X = _to_array(X)
+        X_orig, y_orig = X, y
+        if not _is_pandas(X):
+            X = _to_array(X)
         y = np.asarray(y).reshape(-1)
         self._n_features = X.shape[1]
         y_t = self._transform_label(y)
         if self.class_weight is not None and sample_weight is None:
             sample_weight = self._class_weights(y_t)
+        if isinstance(init_model, LGBMModel):
+            init_model = init_model.booster_
 
         train_set = Dataset(X, label=y_t, weight=sample_weight, group=group,
                             init_score=init_score,
@@ -151,17 +183,47 @@ class LGBMModel:
                 vw = eval_sample_weight[i] if eval_sample_weight else None
                 vg = eval_group[i] if eval_group else None
                 vi = eval_init_score[i] if eval_init_score else None
-                if np.asarray(vx).shape == X.shape and np.allclose(
-                        _to_array(vx)[:5], X[:5], equal_nan=True) and \
-                        len(vy) == len(y):
+                vcw = eval_class_weight[i] if eval_class_weight else None
+                if vcw is not None and vw is None:
+                    from sklearn.utils.class_weight import \
+                        compute_sample_weight
+                    # weights computed on ORIGINAL labels so dict keys
+                    # ({'5': 30} / {5: 30}) match the caller's y values
+                    vw = compute_sample_weight(vcw,
+                                               np.asarray(vy).reshape(-1))
+                vxa = vx if _is_pandas(vx) else _to_array(vx)
+                same = (vx is X_orig and vy is y_orig
+                        and vw is None and vg is None and vi is None)
+                if not same and not _is_pandas(vx) and not _is_pandas(X):
+                    try:
+                        same = (vxa.shape == X.shape
+                                and len(vy) == len(y)
+                                and vw is None and vg is None and vi is None
+                                and vcw is None
+                                and np.allclose(vxa[:5], X[:5],
+                                                equal_nan=True))
+                    except (TypeError, ValueError):
+                        same = False
+                if same:
                     valid_sets.append(train_set)
                     continue
-                valid_sets.append(Dataset(_to_array(vx),
+                valid_sets.append(Dataset(vxa,
                                           label=self._transform_label(np.asarray(vy).reshape(-1)),
                                           weight=vw, group=vg, init_score=vi,
                                           reference=train_set, params=params))
 
-        feval = _wrap_eval_metric(eval_metric, self) if callable(eval_metric) else None
+        feval = None
+        if feval_fns:
+            wrapped = [_wrap_eval_metric(f, self) for f in feval_fns]
+            if len(wrapped) == 1:
+                feval = wrapped[0]
+            else:
+                def feval(score, dataset):
+                    out = []
+                    for f in wrapped:
+                        r = f(score, dataset)
+                        out.extend(r if isinstance(r, list) else [r])
+                    return out
         fobj = _wrap_objective(self.objective) if callable(self.objective) else None
 
         self._evals_result = {}
@@ -187,13 +249,17 @@ class LGBMModel:
                 pred_leaf: bool = False, pred_contrib: bool = False, **kwargs):
         if self._Booster is None:
             raise ValueError("Estimator not fitted")
-        X = _to_array(X)
-        if X.shape[1] != self._n_features:
+        if not _is_pandas(X):
+            X = _to_array(X)
+        if (X.shape[1] != self._n_features
+                and not kwargs.get("predict_disable_shape_check")):
             raise ValueError(f"X has {X.shape[1]} features, expected {self._n_features}")
+        # kwargs ride through to Booster.predict (pred_early_stop,
+        # pred_early_stop_freq/margin, predict_disable_shape_check, ...)
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf,
-                                     pred_contrib=pred_contrib)
+                                     pred_contrib=pred_contrib, **kwargs)
 
     # -- attributes ----------------------------------------------------------
 
@@ -221,7 +287,9 @@ class LGBMModel:
 
     @property
     def evals_result_(self):
-        return self._evals_result
+        # reference semantics: None when no eval set produced results
+        # (e.g. metric="None"), not an empty dict
+        return self._evals_result or None
 
     @property
     def n_features_(self):
@@ -258,6 +326,14 @@ class LGBMClassifier(LGBMModel):
         return "binary" if (self._n_classes is not None and self._n_classes <= 2) \
             else "multiclass"
 
+    def _default_eval_metric(self):
+        return ("multi_logloss"
+                if (self._n_classes or 0) > 2 else "binary_logloss")
+
+    def score(self, X, y, sample_weight=None):
+        from sklearn.metrics import accuracy_score
+        return accuracy_score(y, self.predict(X), sample_weight=sample_weight)
+
     def fit(self, X, y, **kwargs):
         y = np.asarray(y).reshape(-1)
         self._classes, y_enc = np.unique(y, return_inverse=True)
@@ -274,8 +350,19 @@ class LGBMClassifier(LGBMModel):
         return self
 
     def _transform_label(self, y):
-        _, y_enc = np.unique(y, return_inverse=True)
-        return y_enc.astype(np.float64)
+        """Encode with the TRAIN-time class mapping (self._classes, set in
+        fit): an independent np.unique would silently misencode eval sets
+        missing one of the train classes (reference uses one fitted
+        LabelEncoder for train and eval labels alike)."""
+        y = np.asarray(y).reshape(-1)
+        if self._classes is None:
+            _, y_enc = np.unique(y, return_inverse=True)
+            return y_enc.astype(np.float64)
+        idx = np.searchsorted(self._classes, y)
+        idx_c = np.minimum(idx, len(self._classes) - 1)
+        if not np.array_equal(self._classes[idx_c], y):
+            raise ValueError("eval set contains labels unseen in training")
+        return idx_c.astype(np.float64)
 
     def predict(self, X, raw_score=False, num_iteration=None,
                 pred_leaf=False, pred_contrib=False, **kwargs):
@@ -314,10 +401,32 @@ class LGBMRanker(LGBMModel):
     def _default_objective(self):
         return "lambdarank"
 
-    def fit(self, X, y, group=None, **kwargs):
+    def _default_eval_metric(self):
+        return "ndcg"
+
+    def fit(self, X, y, group=None, eval_set=None, eval_group=None,
+            eval_at=(1, 2, 3, 4, 5), **kwargs):
         if group is None:
             raise ValueError("Should set group for ranking task")
-        return super().fit(X, y, group=group, **kwargs)
+        if eval_set is not None:
+            if eval_group is None:
+                raise ValueError(
+                    "Eval_group cannot be None when eval_set is not None")
+            n_eval = 1 if isinstance(eval_set, tuple) else len(eval_set)
+            if len(eval_group) != n_eval:
+                raise ValueError(
+                    "Length of eval_group should be equal to eval_set")
+            if any(g is None for g in eval_group):
+                raise ValueError(
+                    "Should set group for all eval datasets for ranking "
+                    "task; if you use dict, the index should start from 0")
+        self._eval_at = eval_at
+        return super().fit(X, y, group=group, eval_set=eval_set,
+                           eval_group=eval_group, **kwargs)
+
+
+def _is_pandas(X) -> bool:
+    return hasattr(X, "dtypes") and hasattr(X, "columns")
 
 
 def _to_array(X):
