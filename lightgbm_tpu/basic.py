@@ -100,7 +100,22 @@ class Booster:
         merged = dict(ds_params)
         merged.update(train_set.params)
         train_set.params = merged
+        was_constructed = train_set.constructed
         train_set.construct()
+        if (not was_constructed
+                and getattr(train_set, "_from_binary_cache", False)):
+            # the construct call resolved to a binary cache whose stored
+            # params replaced train_set.params: explicit caller params
+            # that contradict them cannot be honored (no raw data to
+            # rebuild from) — reference DatasetUpdateParamChecking
+            old = Config.from_params(train_set.params).to_dataset_params()
+            explicit = {Config.canonical_key(k) for k in self.params}
+            _ck = {"categorical_feature": "categorical_column"}
+            for k, v in ds_params.items():
+                if _ck.get(k, k) in explicit and old.get(k) != v:
+                    raise LightGBMError(
+                        f"Cannot change {k} after constructed Dataset "
+                        "handle.")
         self.train_set = train_set
         self.pandas_categorical = getattr(train_set, "pandas_categorical",
                                           None)

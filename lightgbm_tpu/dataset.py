@@ -107,6 +107,20 @@ def _sample_indices(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
     return np.sort(rng.choice(num_data, size=sample_cnt, replace=False))
 
 
+def _avoid_inf(value):
+    """reference: Common::AvoidInf (utils/common.h:697-715), applied by
+    Metadata::SetLabel/SetWeights/SetInitScore — NaN becomes 0 and
+    infinities clamp to the type's sane maximum, so downstream math never
+    sees NaN/Inf metadata."""
+    a = np.asarray(value)
+    if a.dtype.kind != "f":
+        return a
+    lim = 1e300 if a.dtype == np.float64 else np.finfo(a.dtype).max
+    if np.isnan(a).any() or np.isinf(a).any():
+        a = np.nan_to_num(a, nan=0.0, posinf=lim, neginf=-lim)
+    return a
+
+
 @dataclass
 class Metadata:
     """Labels / weights / query boundaries / init scores.
@@ -118,6 +132,13 @@ class Metadata:
     weight: Optional[np.ndarray] = None
     query_boundaries: Optional[np.ndarray] = None  # int32 [num_queries + 1]
     init_score: Optional[np.ndarray] = None
+
+    def __setattr__(self, name, value):
+        # every ingestion path (ctor, set_field, properties, binary load)
+        # funnels through attribute assignment — sanitize centrally
+        if name in ("label", "weight", "init_score") and value is not None:
+            value = _avoid_inf(value)
+        object.__setattr__(self, name, value)
 
     def set_group(self, group: Optional[Sequence[int]]) -> None:
         if group is None:
@@ -221,6 +242,42 @@ class Dataset:
             if self._categorical_feature_param in ("auto", None):
                 self._categorical_auto_resolved = cf or []
         if isinstance(data, (str, os.PathLike)):
+            # a saved binary cache routes to the binary loader, whatever
+            # the filename (reference: DatasetLoader::LoadFromFile checks
+            # the binary token first, dataset_loader.cpp:273); the sniff
+            # uses the scheme-routed opener so gs://-style caches route too
+            from .utils.file_io import open_file
+            try:
+                with open_file(str(data), "rb") as _fh:
+                    is_bin = _fh.read(len(_BINARY_MAGIC)) == _BINARY_MAGIC
+            except OSError:
+                is_bin = False
+            if is_bin:
+                pre = self.metadata
+                # file params win: the cache carries its construction
+                # params and the Booster's param-change check must see
+                # the TRUE old values
+                loaded = Dataset.load_binary(str(data), params=None)
+                keep = {"reference", "free_raw_data",
+                        "_feature_name_param", "_categorical_feature_param"}
+                for k, v in loaded.__dict__.items():
+                    if k not in keep:
+                        self.__dict__[k] = v
+                # self.params now holds the file's TRUE construction
+                # params; the flag makes the Booster's param-change check
+                # compare explicit caller params against them (reference
+                # DatasetUpdateParamChecking on binary load — binned data
+                # cannot be rebuilt from a cache)
+                self._from_binary_cache = True
+                # fields handed to the ctor override the file's sidecars
+                for f in ("label", "weight", "init_score",
+                          "query_boundaries"):
+                    v = getattr(pre, f, None)
+                    if v is not None:
+                        setattr(self.metadata, f, v)
+                self.metadata.check(self.num_data)
+                self.constructed = True
+                return self
             from .io_utils import _param_bool
             if _param_bool(self.params, "two_round"):
                 # two-pass streamed load: never holds the full float matrix

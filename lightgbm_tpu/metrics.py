@@ -64,10 +64,15 @@ class _PointwiseRegressionMetric(Metric):
 
     def eval(self, score, objective):
         if self.convert and objective is not None:
-            # custom objective (None): raw scores stand in for outputs
-            # (reference metric Eval with objective==nullptr)
-            score = np.asarray(objective.convert_output(score)
-                               if objective is not None else score)
+            # float64: convert_output may hand back a jax f32 array, and
+            # f32 pointwise math here would diverge from an feval
+            # computing the same quantity in numpy f64 (reference metrics
+            # are double end-to-end)
+            score = np.asarray(objective.convert_output(score), np.float64)
+        else:
+            # custom objective (objective None): raw scores stand in for
+            # outputs (reference metric Eval with objective==nullptr)
+            score = np.asarray(score, np.float64)
         return [(self.name, self.transform(self._avg(self.point_loss(score))), self.higher_better)]
 
 

@@ -153,7 +153,10 @@ class LGBMModel:
             feval_fns = [m for m in em_raw if callable(m)]
         if em and not pm:
             pm = [str(params.get("objective", self._default_objective()))]
-        merged = pm + [m for m in em if m not in pm]
+        # eval_metric strings PREPEND (reference order): first_metric_only
+        # early stopping keys off the first metric, which must be the
+        # caller's eval_metric when one is given
+        merged = [m for m in em if m not in pm] + pm
         if merged:
             params["metric"] = merged
         if getattr(self, "_eval_at", None):
@@ -405,7 +408,7 @@ class LGBMRanker(LGBMModel):
         return "ndcg"
 
     def fit(self, X, y, group=None, eval_set=None, eval_group=None,
-            eval_at=(1, 2, 3, 4, 5), **kwargs):
+            eval_at=None, **kwargs):
         if group is None:
             raise ValueError("Should set group for ranking task")
         if eval_set is not None:
@@ -420,6 +423,9 @@ class LGBMRanker(LGBMModel):
                 raise ValueError(
                     "Should set group for all eval datasets for ranking "
                     "task; if you use dict, the index should start from 0")
+        # a constructor/params eval_at wins unless fit() overrides it
+        # (reference _choose_param_value semantics); the engine's config
+        # default (1,2,3,4,5) applies when neither is given
         self._eval_at = eval_at
         return super().fit(X, y, group=group, eval_set=eval_set,
                            eval_group=eval_group, **kwargs)
