@@ -59,44 +59,51 @@ class Booster:
 
     # -------------------------------------------------------------- training
 
+    def _check_dataset_param_changes(self, train_set: Dataset,
+                                     ds_params: dict,
+                                     can_rebuild: bool) -> None:
+        """reference: LGBM_DatasetUpdateParamChecking — dataset-level
+        parameters cannot change once the dataset is constructed UNLESS
+        the raw data is still around to rebuild from (can_rebuild);
+        min_data_in_leaf may grow, or shrink when feature_pre_filter was
+        off (the pre-filter dropped features using the old value).  One
+        rule set for both the pre-constructed and binary-cache paths."""
+        old = Config.from_params(train_set.params).to_dataset_params()
+        explicit = {Config.canonical_key(k) for k in self.params}
+        _ck = {"categorical_feature": "categorical_column"}
+        diff = {k for k, v in ds_params.items()
+                if _ck.get(k, k) in explicit and old.get(k) != v}
+        if not diff:
+            return
+        if can_rebuild and train_set.raw_data is not None:
+            # rebuild the dataset under the new parameters (the
+            # reference re-creates the handle when raw data is kept)
+            train_set.params.update({k: ds_params[k] for k in diff})
+            train_set.constructed = False
+            train_set.binned = None
+            return
+        for k in sorted(diff):
+            if k == "min_data_in_leaf":
+                nv, ov = ds_params[k], old.get(k, 0)
+                if nv > ov or not old.get("feature_pre_filter", True):
+                    train_set.params[k] = nv
+                    continue
+                raise LightGBMError(
+                    "Reducing `min_data_in_leaf` with "
+                    "`feature_pre_filter=true` may cause unexpected "
+                    "behaviour for features that were pre-filtered by "
+                    "the larger `min_data_in_leaf`.")
+            disp = {"is_sparse": "is_enable_sparse",
+                    "forcedbins_filename": "forced bins"}.get(k, k)
+            raise LightGBMError(
+                f"Cannot change {disp} after constructed Dataset "
+                "handle.")
+
     def _init_train(self, train_set: Dataset) -> None:
         ds_params = self.config.to_dataset_params()
         if train_set.constructed:
-            # reference: LGBM_DatasetUpdateParamChecking — dataset-level
-            # parameters cannot change once the dataset is constructed
-            # UNLESS the raw data is still around to rebuild from;
-            # min_data_in_leaf may grow, or shrink when feature_pre_filter
-            # was off (the pre-filter dropped features using the old value)
-            old = Config.from_params(train_set.params).to_dataset_params()
-            explicit = {Config.canonical_key(k) for k in self.params}
-            _ck = {"categorical_feature": "categorical_column"}
-            diff = {k for k, v in ds_params.items()
-                    if _ck.get(k, k) in explicit and old.get(k) != v}
-            if diff and train_set.raw_data is not None:
-                # rebuild the dataset under the new parameters (the
-                # reference re-creates the handle when raw data is kept)
-                train_set.params.update({k: ds_params[k] for k in diff})
-                train_set.constructed = False
-                train_set.binned = None
-            else:
-                for k in sorted(diff):
-                    if k == "min_data_in_leaf":
-                        nv, ov = ds_params[k], old.get(k, 0)
-                        if nv > ov or not old.get("feature_pre_filter",
-                                                  True):
-                            train_set.params[k] = nv
-                            continue
-                        raise LightGBMError(
-                            "Reducing `min_data_in_leaf` with "
-                            "`feature_pre_filter=true` may cause "
-                            "unexpected behaviour for features that were "
-                            "pre-filtered by the larger "
-                            "`min_data_in_leaf`.")
-                    disp = {"is_sparse": "is_enable_sparse",
-                            "forcedbins_filename": "forced bins"}.get(k, k)
-                    raise LightGBMError(
-                        f"Cannot change {disp} after constructed Dataset "
-                        "handle.")
+            self._check_dataset_param_changes(train_set, ds_params,
+                                              can_rebuild=True)
         merged = dict(ds_params)
         merged.update(train_set.params)
         train_set.params = merged
@@ -107,15 +114,9 @@ class Booster:
             # the construct call resolved to a binary cache whose stored
             # params replaced train_set.params: explicit caller params
             # that contradict them cannot be honored (no raw data to
-            # rebuild from) — reference DatasetUpdateParamChecking
-            old = Config.from_params(train_set.params).to_dataset_params()
-            explicit = {Config.canonical_key(k) for k in self.params}
-            _ck = {"categorical_feature": "categorical_column"}
-            for k, v in ds_params.items():
-                if _ck.get(k, k) in explicit and old.get(k) != v:
-                    raise LightGBMError(
-                        f"Cannot change {k} after constructed Dataset "
-                        "handle.")
+            # rebuild from)
+            self._check_dataset_param_changes(train_set, ds_params,
+                                              can_rebuild=False)
         self.train_set = train_set
         self.pandas_categorical = getattr(train_set, "pandas_categorical",
                                           None)

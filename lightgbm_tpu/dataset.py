@@ -269,6 +269,26 @@ class Dataset:
                 # DatasetUpdateParamChecking on binary load — binned data
                 # cannot be rebuilt from a cache)
                 self._from_binary_cache = True
+                if self.reference is not None:
+                    # a cache used as a VALIDATION set must have been
+                    # binned identically to the training set (reference:
+                    # "Cannot add validation data, since it has different
+                    # bin mappers with training data")
+                    ref = self.reference
+                    ref.construct()
+                    aligned = (
+                        len(ref.bin_mappers) == len(self.bin_mappers)
+                        and ref.used_features == self.used_features
+                        and np.array_equal(ref.feat_group, self.feat_group)
+                        and np.array_equal(ref.feat_start, self.feat_start)
+                        and all(a.to_dict() == b.to_dict()
+                                for a, b in zip(ref.bin_mappers,
+                                                self.bin_mappers)))
+                    if not aligned:
+                        from .config import LightGBMError
+                        raise LightGBMError(
+                            "Cannot add validation data, since it has "
+                            "different bin mappers with training data")
                 # fields handed to the ctor override the file's sidecars
                 for f in ("label", "weight", "init_score",
                           "query_boundaries"):

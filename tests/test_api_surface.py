@@ -144,3 +144,17 @@ def test_booster_shuffle_models(trained):
     assert list(map(id, before)) != list(map(id, after))   # must move some
     # prediction = sum over trees, invariant under order
     np.testing.assert_allclose(bst.predict(X), pred_before, rtol=1e-6)
+
+
+def test_parameters_doc_current():
+    """docs/Parameters.rst is GENERATED from the Config dataclass (the
+    reference generates its Parameters.rst from config.h comments via
+    helpers/parameter_generator.py); drift is a test failure."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_parameters_doc.py"),
+         "--check"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr + r.stdout

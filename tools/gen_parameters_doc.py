@@ -1,0 +1,100 @@
+"""Generate docs/Parameters.rst from the Config dataclass + alias table.
+
+reference: helpers/parameter_generator.py generates config_auto.cpp AND
+docs/Parameters.rst from structured comments in config.h so the alias map
+and the user docs can never drift from the source of truth.  Here the
+source of truth is the ``Config`` dataclass and ``_ALIASES`` dict in
+``lightgbm_tpu/config.py``; this script derives the docs (and the
+section structure from the ``# section`` comments) from them.
+
+Run:  python tools/gen_parameters_doc.py          # rewrite docs/Parameters.rst
+      python tools/gen_parameters_doc.py --check  # exit 1 if docs are stale
+                                                  # (tests/test_api_surface.py
+                                                  # runs this in CI)
+"""
+import dataclasses
+import io
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.config import _ALIASES, Config  # noqa: E402
+
+OUT = os.path.join(REPO, "docs", "Parameters.rst")
+
+
+def _sections():
+    """(field name -> section title) from the explicit ``# section: <name>``
+    sentinels that structure the dataclass body — explicit, so an ordinary
+    short comment can never silently spawn a garbage doc section."""
+    src = open(os.path.join(REPO, "lightgbm_tpu", "config.py")).read()
+    body = src.split("class Config:", 1)[1]
+    section = "Core Parameters"
+    out = {}
+    for line in body.splitlines():
+        m = re.match(r"\s*#\s*section:\s*(.+?)\s*$", line)
+        if m:
+            section = m.group(1).strip().title() + " Parameters"
+            continue
+        f = re.match(r"\s{4}(\w+)\s*:\s*\w", line)
+        if f:
+            out[f.group(1)] = section
+    return out
+
+
+def generate() -> str:
+    fields = dataclasses.fields(Config)
+    sec_of = _sections()
+    aliases_of = {}
+    for alias, canon in _ALIASES.items():
+        if alias != canon:
+            aliases_of.setdefault(canon, []).append(alias)
+
+    buf = io.StringIO()
+    w = buf.write
+    w("Parameters\n==========\n\n")
+    w("Generated from ``lightgbm_tpu/config.py`` by "
+      "``tools/gen_parameters_doc.py`` — do not edit by hand.\n"
+      "The reference analogue is ``docs/Parameters.rst`` generated from "
+      "``config.h`` by ``helpers/parameter_generator.py``.\n\n")
+    current = None
+    for f in fields:
+        sec = sec_of.get(f.name, "Other Parameters")
+        if sec != current:
+            w(f"\n{sec}\n{'-' * len(sec)}\n\n")
+            current = sec
+        default = f.default
+        if default is dataclasses.MISSING:
+            default = (f.default_factory()
+                       if f.default_factory is not dataclasses.MISSING
+                       else "")
+        typename = getattr(f.type, "__name__", str(f.type))
+        w(f"- ``{f.name}``: {typename}, default ``{default!r}``")
+        al = aliases_of.get(f.name)
+        if al:
+            w(f", aliases: {', '.join('``%s``' % a for a in sorted(al))}")
+        w("\n")
+    return buf.getvalue()
+
+
+def main():
+    text = generate()
+    if "--check" in sys.argv:
+        on_disk = open(OUT).read() if os.path.exists(OUT) else ""
+        if on_disk != text:
+            print("docs/Parameters.rst is stale: regenerate with "
+                  "python tools/gen_parameters_doc.py", file=sys.stderr)
+            return 1
+        print("docs/Parameters.rst is current")
+        return 0
+    with open(OUT, "w") as fh:
+        fh.write(text)
+    print(f"wrote {OUT} ({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
