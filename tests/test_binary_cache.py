@@ -1,0 +1,104 @@
+"""Binary dataset cache semantics.
+
+reference: Dataset::SaveBinaryFile (dataset.cpp:890) / DatasetLoader::
+LoadFromBinFile (dataset_loader.cpp:273) — a file whose header carries the
+binary token routes to the binary loader whatever its name, stored
+construction params drive param-change checking, and a cache used as a
+validation set must share the training set's bin mappers.  Plus
+Common::AvoidInf metadata sanitization (utils/common.h:697).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import LightGBMError
+
+
+@pytest.fixture()
+def problem():
+    rng = np.random.RandomState(0)
+    X = rng.rand(600, 5)
+    y = (X[:, 0] * 3 + 0.01 * rng.randn(600)).astype(np.float64)
+    return X, y
+
+
+def test_construct_routes_binary_by_magic(tmp_path, problem):
+    X, y = problem
+    p = str(tmp_path / "cache.weird_extension")
+    ds = lgb.Dataset(X, y, params={"max_bin": 63})
+    ds.construct()
+    ds.save_binary(p)
+    loaded = lgb.Dataset(p)
+    loaded.construct()
+    assert loaded.num_data == len(X)
+    assert loaded.params.get("max_bin") == 63      # file params restored
+    np.testing.assert_allclose(loaded.get_label(), y.astype(np.float32))
+    # subset of a file-backed dataset (reference test_engine
+    # test_init_with_subset flow)
+    sub = lgb.Dataset(p).subset(np.arange(100))
+    sub.construct()
+    assert sub.num_data == 100
+
+
+def test_binary_cache_param_conflicts(tmp_path, problem):
+    X, y = problem
+    p = str(tmp_path / "t.bin")
+    ds = lgb.Dataset(X, y, params={"max_bin": 63, "min_data_in_leaf": 20})
+    ds.construct()
+    ds.save_binary(p)
+    # growing min_data_in_leaf is allowed (training-time constraint)
+    lgb.train({"objective": "regression", "min_data_in_leaf": 50,
+               "verbose": -1}, lgb.Dataset(p), num_boost_round=2)
+    # changing a binning param is not (no raw data to rebuild from)
+    with pytest.raises(LightGBMError, match="Cannot change max_bin"):
+        lgb.train({"objective": "regression", "max_bin": 128,
+                   "verbose": -1}, lgb.Dataset(p), num_boost_round=1)
+
+
+def test_binary_cache_valid_set_mapper_alignment(tmp_path, problem):
+    X, y = problem
+    rng = np.random.RandomState(7)
+    tr = lgb.Dataset(X, y)
+    tr.construct()
+    # aligned: valid cache binned against the training set's mappers
+    pv = str(tmp_path / "v.bin")
+    vd = lgb.Dataset(X[:200], y[:200], reference=tr)
+    vd.construct()
+    vd.save_binary(pv)
+    ev = {}
+    lgb.train({"objective": "regression", "verbose": -1}, tr,
+              num_boost_round=2, valid_sets=[lgb.Dataset(pv, reference=tr)],
+              evals_result=ev, verbose_eval=False)
+    assert "valid_0" in ev
+    # misaligned: cache binned standalone on a different distribution
+    pv2 = str(tmp_path / "v2.bin")
+    sd = lgb.Dataset(rng.rand(300, 5) * 2.0, y[:300])
+    sd.construct()
+    sd.save_binary(pv2)
+    with pytest.raises(LightGBMError, match="different bin mappers"):
+        lgb.train({"objective": "regression", "verbose": -1}, tr,
+                  num_boost_round=1,
+                  valid_sets=[lgb.Dataset(pv2, reference=tr)],
+                  verbose_eval=False)
+
+
+def test_metadata_avoid_inf(problem):
+    X, y = problem
+    seq = np.ones(len(y))
+    seq[0] = np.nan
+    seq[1] = np.inf
+    d = lgb.Dataset(X, seq, weight=seq, init_score=seq).construct()
+    assert d.label[0] == 0.0 and not np.isinf(d.label[1])
+    assert d.weight[0] == 0.0 and not np.isinf(d.weight[1])
+    assert d.init_score[0] == 0.0 and not np.isinf(d.init_score[1])
+    assert d.label[1] == d.weight[1]
+    # setters sanitize too
+    d2 = lgb.Dataset(X, y).construct()
+    d2.set_label(seq)
+    d2.set_weight(seq)
+    d2.set_init_score(seq)
+    assert not np.isnan(d2.label[0])
+    assert not np.isinf(d2.weight[1])
+    assert not np.isinf(d2.init_score[1])
