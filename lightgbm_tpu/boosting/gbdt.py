@@ -36,6 +36,27 @@ from ..utils.log import log_info, log_warning
 
 K_EPSILON = 1e-15
 
+# jitted-program cache shared ACROSS boosters: programs whose only
+# booster-specific inputs ride as runtime arguments (bin metadata, labels,
+# weights, monotone constraints) are keyed by their structural config, so
+# cv folds and repeated sklearn fits trace+compile once instead of per
+# Booster.  Bounded FIFO — entries hold compiled executables.
+_PROGRAM_CACHE: Dict[tuple, object] = {}
+_PROGRAM_CACHE_CAP = 64
+
+
+def _shared_program(key, fn=None):
+    """Get (fn is None) or insert a shared jitted program; key=None
+    disables sharing (caller keeps a private program)."""
+    if key is None:
+        return None if fn is None else fn
+    if fn is None:
+        return _PROGRAM_CACHE.get(key)
+    if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_CAP:
+        _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+    _PROGRAM_CACHE[key] = fn
+    return fn
+
 
 class GBDT:
     """reference: class GBDT (src/boosting/gbdt.h)."""
@@ -583,16 +604,26 @@ class GBDT:
         # EFB layout); trees must come back in inner feature numbering
         feat_perm_j = (jnp.asarray(self._feat_perm, jnp.int32)
                        if self._feat_perm is not None else None)
+        # hoisted to locals so iter_body never closes over `self` (RF sets
+        # these BEFORE its second _build_jit_fns call, so build-time
+        # capture is current; RF's program is cache-ineligible anyway)
+        rf_const_init = getattr(self, "_rf_renew_const_init", False)
+        init_scores_c = tuple(float(s) for s in self.init_scores)
 
         def iter_body(binned, score, row_mask, grad, hess, fmask, lr, rng,
                       label_r, weight_r, cegb_used, cegb_rows,
-                      axis_name, feature_axis_name):
+                      axis_name, feature_axis_name,
+                      mc_arr=None, meta_args=None):
             """grad/hess: [K, rows]; fmask: [K, F] col-sample masks; lr:
             traced scalar so a learning_rates schedule never recompiles;
             rng: per-iteration PRNG key for node-level randomness;
             cegb_used/cegb_rows: cross-tree CEGB state (pass-through dummies
-            when CEGB is off).  Returns (new_score, stacked trees, leaf_ids,
-            cegb_used, cegb_rows)."""
+            when CEGB is off); mc_arr/meta_args: monotone constraints and
+            per-feature bin metadata as RUNTIME inputs (shared-program
+            mode) — default to the closed-over constants otherwise.
+            Returns (new_score, stacked trees, leaf_ids, cegb_used,
+            cegb_rows)."""
+            mc_in = mc if mc_arr is None else mc_arr
             trees = []
             leaf_ids = []
             new_score = score
@@ -600,7 +631,7 @@ class GBDT:
                 if cegb_on:
                     tree, leaf_id, (cegb_used, cegb_rows) = grow_tree(
                         binned, grad[k], hess[k], row_mask, meta, cfg,
-                        feature_mask=fmask[k], monotone_constraints=mc,
+                        feature_mask=fmask[k], monotone_constraints=mc_in,
                         axis_name=axis_name,
                         feature_axis_name=feature_axis_name,
                         rng_key=jax.random.fold_in(rng, k),
@@ -608,32 +639,38 @@ class GBDT:
                         cegb_lazy_penalty=lazy_pen,
                         cegb_feat_used=cegb_used,
                         cegb_used_rows=cegb_rows,
-                        forced_plan=forced_plan)
+                        forced_plan=forced_plan,
+                        meta_arrays=meta_args)
                 elif use_rounds:
                     from ..grower_rounds import grow_tree_rounds
                     tree, leaf_id = grow_tree_rounds(
                         binned, grad[k], hess[k], row_mask, meta, cfg,
-                        feature_mask=fmask[k], monotone_constraints=mc,
+                        feature_mask=fmask[k], monotone_constraints=mc_in,
                         axis_name=axis_name,
-                        rng_key=jax.random.fold_in(rng, k))
+                        rng_key=jax.random.fold_in(rng, k),
+                        meta_arrays=meta_args)
                 else:
                     tree, leaf_id = grow_tree(binned, grad[k], hess[k],
                                               row_mask, meta, cfg,
                                               feature_mask=fmask[k],
-                                              monotone_constraints=mc,
+                                              monotone_constraints=mc_in,
                                               axis_name=axis_name,
                                               feature_axis_name=feature_axis_name,
                                               rng_key=jax.random.fold_in(rng, k),
-                                              forced_plan=forced_plan)
+                                              forced_plan=forced_plan,
+                                              meta_arrays=meta_args)
                 if feat_perm_j is not None:
                     tree = tree._replace(
                         split_feature=feat_perm_j[tree.split_feature])
                 if use_renew:
-                    if getattr(self, "_rf_renew_const_init", False):
+                    if rf_const_init:
                         # RF renews leaf outputs against the CONSTANT init
                         # score, not the running average (reference
-                        # residual_getter, rf.hpp:130-135)
-                        residual = label_r - jnp.float32(self.init_scores[k])
+                        # residual_getter, rf.hpp:130-135); captured as
+                        # locals — closing over `self` here would pin the
+                        # booster (and its device matrix) inside the
+                        # module program cache
+                        residual = label_r - jnp.float32(init_scores_c[k])
                     else:
                         residual = label_r - new_score[k]
                     w = row_mask * weight_r
@@ -666,13 +703,44 @@ class GBDT:
             # binned rides as an explicit jit argument: a closed-over
             # device array would be captured as a program CONSTANT, and at
             # HIGGS scale (11M x 28 = 308 MB) constant-embedding bloats
-            # lowering/compile
+            # lowering/compile.  Per-feature bin metadata, labels/weights
+            # and monotone constraints ride as runtime args too, so ONE
+            # traced+compiled program serves every structurally-identical
+            # booster (cv folds, repeated sklearn fits) via the module
+            # program cache below.
+            mr = meta.resolved()
+            meta_args = meta.as_runtime_arrays()
+            mc_j = mc  # device array or None (None -> different pytree)
+            cache_key = None
+            # RF's const-init renewal reads self.init_scores at TRACE time
+            # (set after build) — its program is booster-specific
+            if (not cegb_on and forced_plan is None
+                    and not (use_renew and rf_const_init)):
+                cache_key = (
+                    "one_iter", K, n_pad, self.binned.shape[1],
+                    str(self.binned.dtype), cfg, use_rounds, use_renew,
+                    renew_pct, obj is None, mc is None,
+                    mr.has_bundles, int(mr.max_group_bin),
+                    len(mr.num_bin), int(mr.num_groups),
+                    bool(mr.is_categorical.any()))
+            shared = _shared_program(cache_key)
+            if shared is None:
+                def one_iter_full(binned, score, row_mask, grad, hess,
+                                  fmask, lr, rng, cegb_used, cegb_rows,
+                                  label_r, weight_r, mc_arr, meta_a):
+                    return iter_body(binned, score, row_mask, grad, hess,
+                                     fmask, lr, rng, label_r, weight_r,
+                                     cegb_used, cegb_rows, None, None,
+                                     mc_arr=mc_arr, meta_args=meta_a)
+                shared = jax.jit(one_iter_full, donate_argnums=(1,))
+                _shared_program(cache_key, shared)
+
             def one_iter(binned, score, row_mask, grad, hess, fmask, lr,
-                         rng, cegb_used, cegb_rows):
-                return iter_body(binned, score, row_mask, grad, hess,
-                                 fmask, lr, rng, label_a, weight_a,
-                                 cegb_used, cegb_rows, None, None)
-            self._iter_fn = jax.jit(one_iter, donate_argnums=(1,))
+                         rng, cegb_used, cegb_rows, _fn=shared):
+                return _fn(binned, score, row_mask, grad, hess, fmask,
+                           lr, rng, cegb_used, cegb_rows,
+                           label_a, weight_a, mc_j, meta_args)
+            self._iter_fn = one_iter
         else:
             from jax.sharding import PartitionSpec as P
             ax_d, ax_f = self._data_axis, self._feature_axis
@@ -733,32 +801,60 @@ class GBDT:
 
         self._gradients_fn = jax.jit(gradients_fn)
 
-        def valid_update(vscore, stacked_trees, binned):
-            for k in range(K):
-                tree_k = jax.tree_util.tree_map(lambda x: x[k], stacked_trees)
-                vscore = vscore.at[k].add(
-                    predict_tree_binned(tree_k, binned, self.meta))
-            return vscore
+        # prediction-side programs share across boosters the same way:
+        # bin metadata rides as runtime args, keyed on structure only
+        mrp = self.meta.resolved()
+        pred_meta_args = self.meta.as_runtime_arrays()
+        pred_key_tail = (len(mrp.num_bin), int(mrp.num_groups),
+                         mrp.has_bundles, int(mrp.max_group_bin))
 
-        self._valid_update = jax.jit(valid_update, donate_argnums=(0,))
+        vkey = ("valid_update", K) + pred_key_tail
+        vfn = _shared_program(vkey)
+        if vfn is None:
+            def valid_update_full(vscore, stacked_trees, binned, meta_a):
+                for k in range(K):
+                    tree_k = jax.tree_util.tree_map(lambda x: x[k],
+                                                    stacked_trees)
+                    vscore = vscore.at[k].add(
+                        predict_tree_binned(tree_k, binned, None,
+                                            meta_arrays=meta_a))
+                return vscore
+            vfn = _shared_program(vkey, jax.jit(valid_update_full,
+                                                donate_argnums=(0,)))
+        self._valid_update = (
+            lambda vscore, trees, binned, _f=vfn:
+            _f(vscore, trees, binned, pred_meta_args))
+
         # the TRAIN device matrix may have permuted group columns (sharded
         # EFB layout); history-tree traversal over it needs a meta whose
         # feat_group points at the permuted column positions
         meta_train = self.meta
         if self._col_perm is not None:
             import dataclasses
-            mr = self.meta.resolved()
-            inv_col = np.zeros(mr.num_groups, np.int32)
-            valid_cols = self._col_perm < mr.num_groups
+            mr2 = self.meta.resolved()
+            inv_col = np.zeros(mr2.num_groups, np.int32)
+            valid_cols = self._col_perm < mr2.num_groups
             inv_col[self._col_perm[valid_cols]] = \
                 np.nonzero(valid_cols)[0].astype(np.int32)
             meta_train = dataclasses.replace(
-                mr, feat_group=inv_col[np.asarray(mr.feat_group)],
+                mr2, feat_group=inv_col[np.asarray(mr2.feat_group)],
                 num_groups=len(self._col_perm))
-        self._tree_pred_train_jit = jax.jit(
-            lambda tree, binned: predict_tree_binned(tree, binned, meta_train))
-        self._tree_pred_jit = jax.jit(
-            lambda tree, binned: predict_tree_binned(tree, binned, self.meta))
+
+        tkey = ("tree_pred",) + pred_key_tail
+        tfn = _shared_program(tkey)
+        if tfn is None:
+            tfn = _shared_program(tkey, jax.jit(
+                lambda tree, binned, meta_a:
+                predict_tree_binned(tree, binned, None,
+                                    meta_arrays=meta_a)))
+        self._tree_pred_jit = (lambda tree, binned, _f=tfn:
+                               _f(tree, binned, pred_meta_args))
+        if self._col_perm is not None:
+            self._tree_pred_train_jit = jax.jit(
+                lambda tree, binned: predict_tree_binned(tree, binned,
+                                                         meta_train))
+        else:
+            self._tree_pred_train_jit = self._tree_pred_jit
 
     # --------------------------------------------------------------- training
 

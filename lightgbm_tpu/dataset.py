@@ -1119,6 +1119,19 @@ class FeatureMeta:
     def resolved(self) -> "FeatureMeta":
         return self if self.num_groups else self.with_identity_groups()
 
+    def as_runtime_arrays(self) -> tuple:
+        """The per-feature metadata as DEVICE arrays in the canonical
+        (num_bin, missing_type, default_bin, is_categorical, feat_group,
+        feat_start) order that grow_tree / grow_tree_rounds /
+        predict_leaf_index_binned unpack — the single construction site
+        for the runtime-metadata tuple that lets one compiled program
+        serve every same-shaped dataset."""
+        import jax.numpy as jnp
+        m = self.resolved()
+        return tuple(jnp.asarray(a) for a in (
+            m.num_bin, m.missing_type, m.default_bin,
+            m.is_categorical, m.feat_group, m.feat_start))
+
     @staticmethod
     def from_mappers(mappers: Sequence[BinMapper],
                      feat_group=None, feat_start=None,

@@ -275,6 +275,12 @@ def grow_tree(
     forced_plan: Optional[tuple] = None,        # (leaf, feat, thr) i32 arrays
                                                 # [cfg.n_forced]; see
                                                 # GBDT._build_forced_plan
+    meta_arrays: Optional[tuple] = None,        # (num_bin, missing_type,
+                                                # default_bin, is_cat,
+                                                # feat_group, feat_start) as
+                                                # RUNTIME arrays -> the
+                                                # compiled program is shared
+                                                # across same-shaped datasets
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32).
 
@@ -321,13 +327,30 @@ def grow_tree(
             F = len(meta.num_bin) // nsh
         else:
             F = G
+    else:
+        F = len(meta.num_bin)
+    # per-feature metadata: taken from ``meta_arrays`` when the caller
+    # passes them as RUNTIME values (so one compiled program serves every
+    # same-shaped dataset — cv folds, sklearn fits; the bin layout is then
+    # data, not an HLO constant), else embedded as trace-time constants
+    if meta_arrays is not None:
+        (num_bin_g, missing_type_g, default_bin_g, is_cat_g,
+         feat_group_g, feat_start_g) = meta_arrays
+    else:
+        num_bin_g = jnp.asarray(meta.num_bin)
+        missing_type_g = jnp.asarray(meta.missing_type)
+        default_bin_g = jnp.asarray(meta.default_bin)
+        is_cat_g = jnp.asarray(meta.is_categorical)
+        feat_group_g = jnp.asarray(meta.feat_group)
+        feat_start_g = jnp.asarray(meta.feat_start)
+    if feature_axis_name is not None:
         fidx = lax.axis_index(feature_axis_name)
         def shard_slice(arr):
             return lax.dynamic_slice_in_dim(jnp.asarray(arr), fidx * F, F)
-        num_bin = shard_slice(meta.num_bin)
-        missing_type = shard_slice(meta.missing_type)
-        default_bin = shard_slice(meta.default_bin)
-        is_cat = shard_slice(meta.is_categorical)
+        num_bin = shard_slice(num_bin_g)
+        missing_type = shard_slice(missing_type_g)
+        default_bin = shard_slice(default_bin_g)
+        is_cat = shard_slice(is_cat_g)
         if feature_mask is not None:
             feature_mask = lax.dynamic_slice_in_dim(feature_mask, fidx * F, F)
         if monotone_constraints is not None:
@@ -335,20 +358,19 @@ def grow_tree(
                 jnp.asarray(monotone_constraints), fidx * F, F)
         f_offset = fidx * F
         if meta.has_bundles:
-            feat_group = shard_slice(meta.feat_group)   # shard-LOCAL groups
-            feat_start = shard_slice(meta.feat_start)
+            feat_group = shard_slice(feat_group_g)   # shard-LOCAL groups
+            feat_start = shard_slice(feat_start_g)
         else:
             feat_group = jnp.arange(F, dtype=jnp.int32)
             feat_start = jnp.ones(F, jnp.int32)
     else:
-        F = len(meta.num_bin)
-        num_bin = jnp.asarray(meta.num_bin)
-        missing_type = jnp.asarray(meta.missing_type)
-        default_bin = jnp.asarray(meta.default_bin)
-        is_cat = jnp.asarray(meta.is_categorical)
+        num_bin = num_bin_g
+        missing_type = missing_type_g
+        default_bin = default_bin_g
+        is_cat = is_cat_g
         f_offset = None
-        feat_group = jnp.asarray(meta.feat_group)
-        feat_start = jnp.asarray(meta.feat_start)
+        feat_group = feat_group_g
+        feat_start = feat_start_g
     has_cat = bool(meta.is_categorical.any())
 
     hist_fn = functools.partial(build_histogram, num_bins=Bg, method=cfg.hist_method)
@@ -1044,20 +1066,27 @@ def grow_tree(
 
 
 def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
-                              meta: FeatureMeta) -> jax.Array:
+                              meta: FeatureMeta,
+                              meta_arrays: Optional[tuple] = None) -> jax.Array:
     """Route binned rows to leaf indices by iterative traversal.
 
     reference: Tree::Predict inline traversal (include/LightGBM/tree.h:190).
     Vectorized: all rows advance one level per iteration; done when every
-    row has reached a leaf (child pointer < 0).
+    row has reached a leaf (child pointer < 0).  ``meta_arrays`` (same
+    tuple as grow_tree's) makes the bin layout a runtime input so one
+    compiled traversal serves every same-shaped dataset.
     """
-    meta = meta.resolved()
     n = binned.shape[0]
-    num_bin = jnp.asarray(meta.num_bin)
-    missing_type = jnp.asarray(meta.missing_type)
-    default_bin = jnp.asarray(meta.default_bin)
-    feat_group = jnp.asarray(meta.feat_group)
-    feat_start = jnp.asarray(meta.feat_start)
+    if meta_arrays is not None:
+        (num_bin, missing_type, default_bin, _is_cat,
+         feat_group, feat_start) = meta_arrays
+    else:
+        meta = meta.resolved()
+        num_bin = jnp.asarray(meta.num_bin)
+        missing_type = jnp.asarray(meta.missing_type)
+        default_bin = jnp.asarray(meta.default_bin)
+        feat_group = jnp.asarray(meta.feat_group)
+        feat_start = jnp.asarray(meta.feat_start)
 
     # node >= 0: internal; node < 0: leaf ~node
     def cond(state):
@@ -1086,6 +1115,7 @@ def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
 
 
 def predict_tree_binned(tree: TreeArrays, binned: jax.Array,
-                        meta: FeatureMeta) -> jax.Array:
-    leaf = predict_leaf_index_binned(tree, binned, meta)
+                        meta: FeatureMeta,
+                        meta_arrays: Optional[tuple] = None) -> jax.Array:
+    leaf = predict_leaf_index_binned(tree, binned, meta, meta_arrays)
     return tree.leaf_value[leaf]

@@ -76,6 +76,11 @@ def grow_tree_rounds(
     axis_name: Optional[str] = None,            # mesh axis sharding ROWS
     monotone_constraints: Optional[jax.Array] = None,  # [F] i32 in {-1,0,1}
     rng_key: Optional[jax.Array] = None,
+    meta_arrays: Optional[tuple] = None,    # runtime (num_bin, missing_type,
+                                            # default_bin, is_cat, feat_group,
+                                            # feat_start) — shares the
+                                            # compiled program across
+                                            # same-shaped datasets
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32)."""
     meta = meta.resolved()
@@ -87,12 +92,16 @@ def grow_tree_rounds(
     hp = cfg.hp
     F = len(meta.num_bin)
 
-    num_bin = jnp.asarray(meta.num_bin)
-    missing_type = jnp.asarray(meta.missing_type)
-    default_bin = jnp.asarray(meta.default_bin)
-    is_cat = jnp.asarray(meta.is_categorical)
-    feat_group = jnp.asarray(meta.feat_group)
-    feat_start = jnp.asarray(meta.feat_start)
+    if meta_arrays is not None:
+        (num_bin, missing_type, default_bin, is_cat,
+         feat_group, feat_start) = meta_arrays
+    else:
+        num_bin = jnp.asarray(meta.num_bin)
+        missing_type = jnp.asarray(meta.missing_type)
+        default_bin = jnp.asarray(meta.default_bin)
+        is_cat = jnp.asarray(meta.is_categorical)
+        feat_group = jnp.asarray(meta.feat_group)
+        feat_start = jnp.asarray(meta.feat_start)
     has_cat = bool(meta.is_categorical.any())
 
     hist_fn = functools.partial(build_histogram, num_bins=Bg,
