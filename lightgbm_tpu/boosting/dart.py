@@ -33,6 +33,7 @@ from .gbdt import GBDT
 
 class DART(GBDT):
     boosting_type = "dart"
+    _defer_host_ok = False   # per-iteration host drop & rescale of models
 
     def __init__(self, config, train_set, objective):
         super().__init__(config, train_set, objective)

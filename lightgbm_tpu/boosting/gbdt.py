@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -62,6 +63,9 @@ class GBDT:
     """reference: class GBDT (src/boosting/gbdt.h)."""
 
     boosting_type = "gbdt"
+    # subclasses with per-iteration host-side model logic (DART's drop &
+    # rescale, RF's averaged extension) must keep the eager finish path
+    _defer_host_ok = True
 
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[ObjectiveFunction]):
@@ -73,10 +77,17 @@ class GBDT:
                                        if objective is not None else config.num_class)
         self.iter = 0
         self.num_init_iteration = 0        # iterations loaded via init_model
-        self.models: List[HostTree] = []   # length = iter * K
+        self._models: List[HostTree] = []  # length = iter * K (drained)
         self.models_version = 0            # bumped on EVERY models mutation
         # (extend/rollback/refit/DART scale) — cache-invalidation token for
         # prediction caches keyed on the model list
+        # deferred host materialization: on the tunneled accelerator
+        # backend every device->host copy is a ~70 ms network round-trip,
+        # so _finish_iter banks the stacked DEVICE trees here and
+        # _drain_pending converts the whole backlog in one bulk transfer
+        # when the host list is actually needed (predict/save/eval/len)
+        self._pending: List[tuple] = []    # (abs_iter, stacked device trees)
+        self._defer_host: Optional[bool] = None   # resolved on first iter
         self.shrinkage_rate = config.learning_rate
 
         self.meta = self.train_set.feature_meta()
@@ -973,6 +984,92 @@ class GBDT:
     def _node_key(self):
         return jax.random.fold_in(self._node_key_base, self.iter)
 
+    @property
+    def models(self) -> List[HostTree]:
+        """Host trees; drains any deferred device trees first.  Returns the
+        live list (callers mutate it in place: rollback, DART rescale)."""
+        self._drain_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._pending = []
+        self._models = value
+
+    def _defer_enabled(self) -> bool:
+        if self._defer_host is None:
+            env = os.environ.get("LGBT_DEFER_HOST_TREES")
+            if env is not None:
+                self._defer_host = env == "1" and type(self)._defer_host_ok
+            else:
+                # the tunneled accelerator pays ~70 ms per D2H copy; local
+                # CPU copies are free and the eager path's per-iteration
+                # stop check is reference-exact there
+                self._defer_host = (type(self)._defer_host_ok
+                                    and jax.default_backend()
+                                    in ("tpu", "axon"))
+        return self._defer_host
+
+    def _drain_pending(self) -> None:
+        """Materialize deferred device trees as HostTrees in ONE bulk
+        device->host transfer (per tree field, not per tree).
+
+        reference semantics preserved at drain time: iteration-0 init-score
+        bias (GBDT::Train, gbdt.cpp:387-405 AsConstantTree) and
+        stop-on-no-splittable-leaves, which truncates the model at the
+        first all-stump iteration.  Deviation (documented): iterations that
+        ran AFTER such a stop already added their root-Newton-step outputs
+        to train_score/valid_scores before the drain noticed; the eager
+        path stops the loop instead.  Only degenerate configs (nothing
+        splittable) hit this, and only on the deferred/accelerator path.
+        """
+        if not self._pending:
+            return
+        K = self.num_tree_per_iteration
+        pend = self._pending
+        self._pending = []
+        stackeds = [st for (_it, _sr, st) in pend]
+        if len(stackeds) == 1:
+            hosts = [jax.device_get(stackeds[0])]
+        else:
+            bulk = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                          *stackeds)
+            bh = jax.device_get(bulk)
+            hosts = [jax.tree_util.tree_map(lambda x: x[t], bh)
+                     for t in range(len(stackeds))]
+        stopped_at = None
+        for (abs_it, shrink, _), th in zip(pend, hosts):
+            new_models, any_split = [], False
+            for k in range(K):
+                tree_k = jax.tree_util.tree_map(lambda x: np.asarray(x[k]),
+                                                th)
+                ht = tree_to_host(tree_k, self.train_set, shrink)
+                if ht.num_leaves > 1:
+                    any_split = True
+                if abs_it == 0 and abs(self.init_scores[k]) > K_EPSILON:
+                    ht.add_bias(self.init_scores[k])
+                new_models.append(ht)
+            if not any_split:
+                if abs_it == 0 and not self._models:
+                    for k, ht in enumerate(new_models):
+                        ht.leaf_value[:1] = self.init_scores[k]
+                    self._models.extend(new_models)
+                stopped_at = abs_it
+                break
+            self._models.extend(new_models)
+            for k in range(K):
+                self.history_scale[len(self._models) - K + k] = 1.0
+        self.models_version += 1
+        if stopped_at is not None:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            # rewind bookkeeping to the stop point; the dropped tail's
+            # history entries go with it
+            dropped = self.iter - stopped_at
+            if self._history_mode == "all" and dropped > 0:
+                del self.tree_history[len(self.tree_history) - dropped:]
+            self.iter = stopped_at
+
     def _finish_iter(self, stacked) -> bool:
         """Post-step bookkeeping shared by GBDT/GOSS/DART/RF: host copies of
         the (tiny) tree arrays, first-iteration bias folding, valid-score
@@ -983,6 +1080,28 @@ class GBDT:
 
     def _finish_iter_inner(self, stacked) -> bool:
         K = self.num_tree_per_iteration
+        if self._defer_enabled():
+            # bank the device trees; host conversion happens in bulk at
+            # _drain_pending.  Never stops eagerly — stop detection moves
+            # to the drain.
+            # shrinkage is recorded NOW: a reset_parameter learning-rate
+            # schedule changes self.shrinkage_rate between bank and drain
+            self._pending.append((self.iter, self.shrinkage_rate, stacked))
+            st = stacked
+            if self.iter == 0 and any(abs(s) > K_EPSILON
+                                      for s in self.init_scores):
+                bias = jnp.asarray(self.init_scores, jnp.float32)[:, None]
+                st = st._replace(leaf_value=st.leaf_value + bias)
+            if self._history_mode == "all":
+                self.tree_history.append(st)
+            else:
+                self.tree_history = [st]
+            self.models_version += 1
+            for i in range(len(self.valid_scores)):
+                self.valid_scores[i] = self._valid_update(
+                    self.valid_scores[i], stacked, self.valid_binned[i])
+            self.iter += 1
+            return False
         new_models = []
         should_continue = False
         for k in range(K):

@@ -24,6 +24,7 @@ from ..utils.log import log_warning
 
 class RF(GBDT):
     boosting_type = "rf"
+    _defer_host_ok = False   # custom eager finish (averaged extension)
 
     def __init__(self, config, train_set, objective):
         if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):

@@ -158,12 +158,12 @@ def _train_timed(X, y, trees, max_bin, leaves):
     booster = lgb.Booster(params=params, train_set=ds)
     t0 = time.perf_counter()
     booster.update()
-    jax.block_until_ready(booster.boosting.train_score)
+    bench.dsync(booster.boosting.train_score)
     compile_seconds = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(trees - 1):
         booster.update()
-    jax.block_until_ready(booster.boosting.train_score)
+    bench.dsync(booster.boosting.train_score)
     elapsed = (time.perf_counter() - t0) * trees / max(trees - 1, 1)
     out = {
         "rows": n, "features": f, "groups_after_efb": groups,
