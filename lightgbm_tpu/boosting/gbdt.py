@@ -29,6 +29,7 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset, FeatureMeta
+from ..ops.histogram import on_accelerator
 from ..grower import GrowerConfig, TreeArrays, grow_tree, predict_tree_binned
 from ..objectives import ObjectiveFunction
 from ..ops.renew import leaf_percentile
@@ -508,7 +509,7 @@ class GBDT:
         # GetShareStates col-vs-row timed probe, dataset.cpp:589-684);
         # CPU resolves to scatter without probing
         hist_method = self.config.tpu_hist_method
-        if hist_method == "auto" and jax.default_backend() in ("tpu", "axon"):
+        if hist_method == "auto" and on_accelerator():
             from ..ops.histogram import measured_best_method
             hist_method = measured_best_method(
                 self.num_data, self.train_set.binned.shape[1], self.num_bins)
@@ -608,7 +609,7 @@ class GBDT:
         # per-while-step overhead (2.6 s/tree); on CPU ops are cheap but
         # the rounds body's full-frontier vmapped search is real compute
         # (rounds 19.8 s/tree vs serial 2.4 s/tree there).
-        on_accel = jax.default_backend() in ("tpu", "axon")
+        on_accel = on_accelerator()
         use_rounds = growth in ("rounds", "fast") or (
             growth == "auto" and rounds_ok and on_accel)
         # padded-device feature slot -> inner used-feature index (sharded
@@ -1006,8 +1007,7 @@ class GBDT:
                 # CPU copies are free and the eager path's per-iteration
                 # stop check is reference-exact there
                 self._defer_host = (type(self)._defer_host_ok
-                                    and jax.default_backend()
-                                    in ("tpu", "axon"))
+                                    and on_accelerator())
         return self._defer_host
 
     def _drain_pending(self) -> None:

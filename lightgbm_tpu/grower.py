@@ -223,20 +223,23 @@ def _psum(x, axis_name):
 
 
 def row_goes_left(col: jax.Array, node_thr: jax.Array, node_dl: jax.Array,
-                  node_cat: jax.Array, node_bitset: jax.Array,
-                  missing_type: jax.Array, default_bin: jax.Array,
-                  num_bin: jax.Array) -> jax.Array:
+                  node_cat, node_bitset, missing_type: jax.Array,
+                  default_bin: jax.Array, num_bin: jax.Array) -> jax.Array:
     """Decision rule in bin space for one node over a column of rows.
 
     reference: DenseBin::SplitInner (src/io/dense_bin.hpp) — missing rows
     follow default_left, others compare bin <= threshold; categorical rows
-    test bitset membership.
+    test bitset membership.  ``node_bitset=None`` (with ``node_cat=None``)
+    is the numeric-only fast path: it skips the per-row bitset-word gather,
+    which matters inside the rounds grower's candidate scan.
     """
     from .binning import MissingType
     col = col.astype(jnp.int32)
     is_missing = ((missing_type == MissingType.NAN) & (col == num_bin - 1)) | \
                  ((missing_type == MissingType.ZERO) & (col == default_bin))
     num_left = jnp.where(is_missing, node_dl, col <= node_thr)
+    if node_bitset is None:
+        return num_left
     word = (col // 32).astype(jnp.int32)
     bit = (col % 32).astype(jnp.uint32)
     if node_bitset.ndim == 2:  # per-row bitsets (traversal path)

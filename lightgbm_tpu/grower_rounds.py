@@ -50,7 +50,8 @@ from jax import lax
 from .dataset import FeatureMeta
 from .grower import GrowerConfig, TreeArrays, _LeafBest, _psum, row_goes_left
 from .ops.histogram import (build_histogram, capacity_schedule,
-                            compacted_segment_histogram)
+                            compacted_segment_histogram,
+                            resolve_hist_method)
 from .ops.split import (MAX_CAT_WORDS, SplitResult, best_split_for_leaf,
                         leaf_output)
 
@@ -107,6 +108,13 @@ def grow_tree_rounds(
     hist_fn = functools.partial(build_histogram, num_bins=Bg,
                                 method=cfg.hist_method)
     caps = capacity_schedule(n) if cfg.compact else [n]
+    # feature-major copy for the candidate scan: one transpose per tree
+    # (streams at HBM rate) buys contiguous per-candidate column reads
+    binned_t = binned.T                                 # [G, n]
+    # segment-histogram precision follows the resolved histogram method so
+    # parent - smaller-child subtraction stays consistent: only the bf16
+    # one-hot matmul is inexact; every other kernel accumulates f32-exact
+    seg_f32 = resolve_hist_method(cfg.hist_method) != "matmul"
 
     if meta.has_bundles:
         b_idx = jnp.arange(B, dtype=jnp.int32)
@@ -222,10 +230,12 @@ def grow_tree_rounds(
     def cond(c: Carry):
         return (c.split_idx < L - 1) & (jnp.max(active_gains(c)) > 0.0)
 
-    def apply_round(c: Carry, sel, rank, k, gl, seg):
+    def apply_round(c: Carry, sel, rank, k, gl, seg, crank):
         """Commit the splits of the ``sel`` leaves (rank = application
-        order within the round); returns the updated carry WITHOUT a
-        refreshed best cache (the caller searches afterwards)."""
+        order within the round; ``crank`` = per-row candidate rank from the
+        candidate scan, KCAP for rows not in a candidate leaf); returns the
+        updated carry WITHOUT a refreshed best cache (the caller searches
+        afterwards)."""
         b = c.best
         node_of = c.split_idx + rank                  # [L] new node ids
         newleaf_of = c.tree.num_leaves + rank         # [L] right-child leaves
@@ -271,10 +281,13 @@ def grow_tree_rounds(
             jnp.where(sel, 0, c.leaf_parent_side),
             newleaf_of, jnp.ones(L, jnp.int32), sel)
 
-        # -- rows: those in a selected leaf that go right get the new leaf
-        lof = c.leaf_id
-        selr = sel[lof]
-        new_leaf_id = jnp.where(selr & ~gl, newleaf_of[lof], c.leaf_id)
+        # -- rows: those in a selected leaf that go right get the new leaf.
+        # The right-child leaf of the rank-r candidate is num_leaves + r,
+        # so the update is pure arithmetic on the per-row candidate rank —
+        # no [n]-sized gather from a leaf table (measured ~130 ms per
+        # gathered pass at 11M rows on v5e, tpu_probe_r5.json).
+        new_leaf_id = jnp.where((crank < k) & ~gl,
+                                c.tree.num_leaves + crank, c.leaf_id)
 
         # -- leaf stats (left child keeps the leaf index: elementwise)
         leaf_sg = _pad_scatter(jnp.where(sel, lg, c.leaf_sg),
@@ -345,27 +358,54 @@ def grow_tree_rounds(
         # picks (reference: SerialTreeLearner::Train loop :175-193)
         order = jnp.argsort(-gains, stable=True)
         rank = jnp.zeros(L, jnp.int32).at[order].set(iota_L)
-        sel_b = pos & (rank < k)
 
-        # -- shared heavy work, computed once for the whole batch --------
+        # -- candidate scan: per-row goes-left bit, candidate rank, and
+        # smaller-child membership for the whole batch.  One scan step per
+        # candidate reads its split feature as a CONTIGUOUS column of the
+        # transposed matrix and broadcasts scalar split params — replacing
+        # the per-row take_along_axis + [n]-from-leaf-table gathers, which
+        # are serialized-gather territory on TPU (measured ~130 ms per
+        # pass at 11M rows, tpu_probe_r5.json).
         b = c.best
-        lof = c.leaf_id
-        fr = jnp.clip(b.feature[lof], 0, F - 1)        # per-row split feature
-        g_col = jnp.take_along_axis(
-            binned, feat_group[fr][:, None], axis=1)[:, 0].astype(jnp.int32)
-        dec = g_col - feat_start[fr] + 1
-        binf = jnp.where((dec >= 1) & (dec < num_bin[fr]), dec, 0)
-        gl = row_goes_left(binf, b.threshold[lof], b.default_left[lof],
-                           b.is_categorical[lof], b.cat_bitset[lof],
-                           missing_type[fr], default_bin[fr], num_bin[fr])
-        # smaller-child segment histograms: one compacted pass for the
+        idl = jnp.clip(order[:KCAP], 0, L - 1)          # candidate leaves
+
+        def cstep(carry, kk):
+            def live(carry):
+                gl_a, crank_a, small_a = carry
+                leaf = idl[kk]
+                feat = jnp.clip(b.feature[leaf], 0, F - 1)
+                col = lax.dynamic_index_in_dim(binned_t, feat_group[feat], 0,
+                                               keepdims=False)       # [n]
+                nb = num_bin[feat]
+                dec = col.astype(jnp.int32) - feat_start[feat] + 1
+                binf = jnp.where((dec >= 1) & (dec < nb), dec, 0)
+                glk = row_goes_left(
+                    binf, b.threshold[leaf], b.default_left[leaf],
+                    b.is_categorical[leaf] if has_cat else None,
+                    b.cat_bitset[leaf] if has_cat else None,
+                    missing_type[feat], default_bin[feat], nb)
+                mk = c.leaf_id == leaf
+                sl = b.left_count[leaf] <= b.right_count[leaf]
+                return (jnp.where(mk, glk, gl_a),
+                        jnp.where(mk, kk, crank_a),
+                        jnp.where(mk, glk == sl, small_a))
+            # skip the O(n) column read + masking for dead candidate lanes
+            # (late-tree rounds often have k of 1-2 out of KCAP steps)
+            return lax.cond(kk < k, live, lambda c_: c_, carry), None
+
+        (gl, crank, row_small), _ = lax.scan(
+            cstep,
+            (jnp.zeros(n, jnp.bool_), jnp.full(n, KCAP, jnp.int32),
+             jnp.zeros(n, jnp.bool_)),
+            jnp.arange(KCAP, dtype=jnp.int32))
+
+        # smaller-child segment histograms: one sorted-arena pass for the
         # whole candidate batch (slot r = the round's r-th candidate)
         small_left = b.left_count <= b.right_count
-        selr = sel_b[lof]
-        row_small = selr & (gl == small_left[lof])
-        slot = jnp.where(row_small, rank[lof], KCAP)
+        slot = jnp.where(row_small, crank, KCAP)
         seg = _psum(compacted_segment_histogram(
-            binned, grad, hess, row_mask, slot, KCAP, Bg, caps), axis_name)
+            binned, grad, hess, row_mask, slot, KCAP, Bg, caps,
+            f32_vals=seg_f32), axis_name)
 
         # -- candidate children's best splits, BEFORE committing anything:
         # per-leaf candidates are independent, so lane i's results are
@@ -422,7 +462,7 @@ def grow_tree_rounds(
                 follow.astype(jnp.int32)).sum().astype(jnp.int32))
 
         sel_m = pos & (rank < m)
-        cm = apply_round(c, sel_m, rank, m, gl, seg)
+        cm = apply_round(c, sel_m, rank, m, gl, seg, crank)
         idc = jnp.concatenate([idl, jnp.clip(c.tree.num_leaves + iota_K,
                                              0, L - 1)])
         valid_m = jnp.concatenate([iota_K < m, iota_K < m])

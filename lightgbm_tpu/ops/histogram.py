@@ -30,6 +30,26 @@ from jax import lax
 # rows per block of the one-hot matmul; 8 sublanes * 128 lanes friendly
 _DEFAULT_BLOCK_ROWS = 4096
 
+# backends where the MXU/one-hot formulations win; everywhere this set is
+# consulted it must stay in sync with the bf16/f32 precision pairing
+ACCEL_BACKENDS = ("tpu", "axon")
+
+
+def on_accelerator() -> bool:
+    return jax.default_backend() in ACCEL_BACKENDS
+
+
+def resolve_hist_method(method: str) -> str:
+    """The concrete kernel ``method='auto'`` resolves to on this backend.
+
+    Kept in ONE place so the grower's segment-histogram precision choice
+    (bf16 one-hot vs f32-exact) can never disagree with the parent
+    histogram kernel it subtracts from.
+    """
+    if method == "auto":
+        return "matmul" if on_accelerator() else "scatter"
+    return method
+
 
 def _pad_rows(n: int, block: int) -> int:
     return (n + block - 1) // block * block
@@ -128,7 +148,7 @@ def histogram_pallas(
     C = block_rows
     Ft = min(feat_tile, F)
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = not on_accelerator()
 
     n_pad = _pad_rows(n, C)
     F_pad = _pad_rows(F, Ft)
@@ -210,9 +230,7 @@ def build_histogram(
     by zeroing non-member rows.
     """
     vals = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1) * mask[:, None]
-    if method == "auto":
-        platform = jax.default_backend()
-        method = "matmul" if platform in ("tpu", "axon") else "scatter"
+    method = resolve_hist_method(method)
     if method == "matmul":
         return histogram_matmul(binned, vals, num_bins, block_rows)
     if method == "matmul_f32":
@@ -242,7 +260,7 @@ def measured_best_method(n: int, num_features: int, num_bins: int,
     import time
 
     backend = jax.default_backend()
-    if backend not in ("tpu", "axon"):
+    if backend not in ACCEL_BACKENDS:
         return "scatter"
     n_probe = int(min(n, 1_000_000))
     key = (backend, num_features, num_bins, n_probe)
@@ -385,6 +403,126 @@ def segment_histogram(
     return hist.reshape(S + 1, F, B, 3)[:S]
 
 
+def segment_histogram_sorted(
+    binned: jax.Array,       # [n, F] uint8/16
+    grad: jax.Array,         # [n]
+    hess: jax.Array,         # [n]
+    weights: jax.Array,      # [n] f32 bagging/GOSS weights
+    slot: jax.Array,         # [n] i32 in [0, num_slots]; num_slots = dropped
+    num_slots: int,
+    num_bins: int,
+    block_rows: int = 1024,
+    f32_vals: bool = False,
+    caps: Optional[list] = None,   # static descending arena capacities
+) -> jax.Array:
+    """TPU-native segment histogram: sort-by-slot + block-aligned matmuls.
+
+    The scatter formulation (``segment_histogram``) serializes on TPU and
+    materializes an [n*F, 3] update buffer that XLA lane-pads to 128 (157 GB
+    at HIGGS scale) — so here the problem is reshaped for the MXU instead:
+
+      1. stable-sort row ids by slot (small-range i32 keys; measured ~25 ms
+         at 11M rows — rows with the dummy slot sort last and are dropped);
+      2. per-slot counts/starts come free from the sorted keys via
+         ``searchsorted`` (a scatter-free bincount);
+      3. lay the sorted rows into a block-aligned arena where every slot's
+         segment starts on a ``block_rows`` boundary — so each C-row block
+         belongs to exactly ONE slot.  The destination->source map is
+         elementwise (no inverse permutation / scatter needed): destination
+         q in block j holds the (q - C*blk_start[s])-th sorted row of slot
+         s = blk_slot[j].  The arena size is the ladder's smallest static
+         capacity that fits the slotted-row count (``lax.switch`` over
+         ``caps``), so the gather+matmul cost tracks the live frontier,
+         not n;
+      4. one-hot matmul per block ([3, C] @ [C, F*B], the histogram_matmul
+         body) producing per-block partials;
+      5. reduce partials into slots with a tiny [S, NB] one-hot matmul
+         (blocks of a slot are contiguous by construction).
+
+    Every step is a gather, sort, or matmul — nothing scatters.  Returns
+    [S, F, B, 3] f32.  reference analogue: ordered-gradient per-leaf
+    histograms (src/io/dataset.cpp:1318-1333) built from a DataPartition
+    that keeps leaves contiguous (src/treelearner/data_partition.hpp).
+    """
+    n, F = binned.shape
+    B = num_bins
+    S = num_slots
+    if caps is None:
+        caps = [n]
+
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    sorted_slot, order = lax.sort((slot, row_ids), is_stable=True, num_keys=1)
+    # counts without a scatter: positions of slot boundaries in sorted keys
+    bounds = jnp.searchsorted(sorted_slot,
+                              jnp.arange(S + 1, dtype=slot.dtype))
+    row_start = bounds[:S].astype(jnp.int32)
+    counts = (bounds[1:] - bounds[:S]).astype(jnp.int32)
+
+    iota = jnp.arange(B, dtype=binned.dtype)
+    acc_t = jnp.float32 if f32_vals else jnp.bfloat16
+    prec = lax.Precision.HIGHEST if f32_vals else lax.Precision.DEFAULT
+
+    def arena(cap: int):
+        """Histogram over a cap-row block-aligned arena.
+
+        The block size shrinks with the capacity rung so the worst-case
+        per-slot padding (S partial blocks) stays a small multiple of the
+        live rows instead of a fixed S*block_rows floor."""
+        C = max(128, min(block_rows,
+                         1 << max(0, (max(cap, 1) // (4 * max(S, 1))
+                                      ).bit_length() - 1)))
+        NB = _pad_rows(max(cap, 1), C) // C + S     # every slot may pad
+
+        def run():
+            nblk = (counts + C - 1) // C            # blocks per slot
+            blk_end = jnp.cumsum(nblk)
+            blk_start = (blk_end - nblk).astype(jnp.int32)
+            # block j -> slot: first slot whose block range extends past j
+            j_idx = jnp.arange(NB, dtype=blk_end.dtype)
+            blk_slot = jnp.searchsorted(blk_end, j_idx,
+                                        side="right").astype(jnp.int32)
+            blk_slot = jnp.minimum(blk_slot, S)     # beyond last: dummy
+
+            # destination -> source (elementwise over the arena)
+            q = jnp.arange(NB * C, dtype=jnp.int32)
+            s_of = blk_slot[q // C]
+            s_c = jnp.minimum(s_of, S - 1)
+            o = q - blk_start[s_c] * C
+            valid = (s_of < S) & (o < counts[s_c])
+            src_sorted = jnp.minimum(row_start[s_c] + o, n - 1)
+            src = order[src_sorted]
+
+            rows = jnp.take(binned, src, axis=0).reshape(NB, C, F)
+            w = jnp.where(valid, jnp.take(weights, src), 0.0)
+            g = jnp.take(grad, src)
+            h = jnp.take(hess, src)
+            vals = (jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+                    * w[:, None]).reshape(NB, C, 3)
+
+            def body(_, blk):
+                b, v = blk
+                onehot2d = (b[:, :, None] == iota).astype(acc_t).reshape(
+                    C, F * B)
+                part = lax.dot(v.astype(acc_t).T, onehot2d, precision=prec,
+                               preferred_element_type=jnp.float32)
+                return _, part
+
+            _, parts = lax.scan(body, None, (rows, vals))   # [NB, 3, F*B]
+            slot_onehot = (jnp.arange(S, dtype=jnp.int32)[:, None]
+                           == blk_slot[None, :]).astype(jnp.float32)
+            hist = lax.dot(slot_onehot, parts.reshape(NB, 3 * F * B),
+                           precision=lax.Precision.HIGHEST)
+            return hist.reshape(S, 3, F, B).transpose(0, 2, 3, 1)
+        return run
+
+    if len(caps) == 1:
+        return arena(caps[0])()
+    total = bounds[S].astype(jnp.int32)             # slotted-row count
+    caps_arr = jnp.asarray(caps, jnp.int32)
+    bucket = jnp.sum(caps_arr >= total) - 1
+    return lax.switch(bucket, [arena(c) for c in caps])
+
+
 def compacted_segment_histogram(
     binned: jax.Array,       # [n, F]
     grad: jax.Array,
@@ -394,11 +532,31 @@ def compacted_segment_histogram(
     num_slots: int,
     num_bins: int,
     caps: list,              # static descending capacities
+    f32_vals: bool = False,
 ) -> jax.Array:
-    """``segment_histogram`` over only the rows with a real slot, gather-
-    compacted into the smallest static capacity that fits (see
-    ``compacted_histogram``).  Returns [S, F, B, 3] f32."""
+    """Segment histogram over only the rows with a real slot, with the
+    work bounded by the smallest static capacity that fits (see
+    ``compacted_histogram``).  Returns [S, F, B, 3] f32.
+
+    Backend dispatch: sorted block-matmul arena on accelerators (the
+    scatter formulation both OOMs — its [n*F, 3] update buffer lane-pads
+    to 128 — and serializes there); XLA scatter with nonzero-compaction
+    on CPU (measured fastest there every round, BENCH_r0*.json).
+    ``LGBM_TPU_SEGHIST=sorted|scatter`` overrides (testing hook).
+    """
+    import os
     n, F = binned.shape
+    forced = os.environ.get("LGBM_TPU_SEGHIST")
+    use_sorted = (on_accelerator()
+                  if forced not in ("sorted", "scatter")
+                  else forced == "sorted")
+    if use_sorted:
+        # zero-weight rows are dropped by reslotting (cheaper than compact)
+        slot_w = jnp.where(weights > 0, slot, num_slots)
+        return segment_histogram_sorted(binned, grad, hess, weights, slot_w,
+                                        num_slots, num_bins,
+                                        f32_vals=f32_vals, caps=caps)
+
     member = (slot < num_slots) & (weights > 0)
     count = jnp.sum(member)
 

@@ -135,3 +135,36 @@ def test_measured_best_method_cpu_short_circuits():
     every round); on accelerators it times the variants and caches."""
     from lightgbm_tpu.ops.histogram import measured_best_method
     assert measured_best_method(10_000, 8, 64) == "scatter"
+
+
+def test_segment_histogram_sorted_matches_scatter():
+    """The TPU sorted-arena segment histogram must agree with the scatter
+    formulation for arbitrary slot assignments, weights, and ladders."""
+    from lightgbm_tpu.ops.histogram import (capacity_schedule,
+                                            segment_histogram,
+                                            segment_histogram_sorted)
+    rng = np.random.RandomState(11)
+    for n, F, S, B in [(10_000, 28, 128, 64), (5_000, 7, 16, 32),
+                       (777, 3, 4, 8), (1000, 5, 1, 8)]:
+        binned = jnp.asarray(rng.randint(0, B - 1, (n, F)).astype(np.uint8))
+        g = jnp.asarray(rng.randn(n).astype(np.float32))
+        h = jnp.abs(g) + 0.1
+        w = jnp.asarray((rng.rand(n) > 0.3).astype(np.float32) * 1.5)
+        slot = jnp.asarray(rng.randint(0, S + 1, n).astype(np.int32))
+        ref = np.asarray(segment_histogram(binned, g, h, w, slot, S, B))
+        for caps in (None, capacity_schedule(n, min_cap=512)):
+            got = np.asarray(segment_histogram_sorted(
+                binned, g, h, w, slot, S, B, f32_vals=True, caps=caps))
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_segment_histogram_sorted_all_dropped():
+    from lightgbm_tpu.ops.histogram import segment_histogram_sorted
+    rng = np.random.RandomState(1)
+    n = 1000
+    binned = jnp.asarray(rng.randint(0, 7, (n, 5)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    out = segment_histogram_sorted(binned, g, g + 2.0, jnp.ones(n), 
+                                   jnp.full(n, 4, jnp.int32), 4, 8,
+                                   f32_vals=True)
+    assert float(jnp.abs(out).sum()) == 0.0

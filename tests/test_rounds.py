@@ -300,3 +300,39 @@ def test_rounds_equals_serial_categorical():
     assert structures(dumps["serial"]) == structures(dumps["rounds"])
     np.testing.assert_allclose(preds["serial"], preds["rounds"],
                                rtol=2e-4, atol=2e-6)
+
+
+def test_rounds_equals_serial_sorted_seghist(problem, monkeypatch):
+    """The sorted-arena segment histogram (the TPU path) must leave the
+    rounds grower structurally identical to the serial grower; forced on
+    CPU via the LGBM_TPU_SEGHIST testing hook."""
+    monkeypatch.setenv("LGBM_TPU_SEGHIST", "sorted")
+    binned, grad, hess, B, F = problem
+    mask = np.ones(len(grad), np.float32)
+    meta = _meta(B, F)
+    for leaves in (7, 31, 64):
+        cfg = GrowerConfig(num_leaves=leaves, num_bins=B,
+                           hp=SplitHyperparams(), hist_method="scatter")
+        t_s, lid_s = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+                               jnp.asarray(hess), jnp.asarray(mask),
+                               meta, cfg)
+        t_r, lid_r = grow_tree_rounds(jnp.asarray(binned), jnp.asarray(grad),
+                                      jnp.asarray(hess), jnp.asarray(mask),
+                                      meta, cfg)
+        # structure must be identical; floats only to accumulation order
+        # (the sorted arena reduces via block partials — one more stage of
+        # f32 reordering than the scatter path, hence the looser rtol)
+        nl = int(t_s.num_leaves)
+        assert nl == int(t_r.num_leaves)
+        nn = max(nl - 1, 1)
+        for name in ("split_feature", "threshold_bin", "default_left",
+                     "left_child", "right_child"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(t_s, name))[:nn],
+                np.asarray(getattr(t_r, name))[:nn], err_msg=name)
+        np.testing.assert_array_equal(np.asarray(lid_s), np.asarray(lid_r))
+        for name in ("leaf_value", "split_gain"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(t_s, name))[:nn],
+                np.asarray(getattr(t_r, name))[:nn], rtol=2e-4, atol=1e-5,
+                err_msg=name)

@@ -1,0 +1,214 @@
+"""Primitive-op probe on the live backend, with REAL synchronization.
+
+Times the ops the grower redesign hinges on (sort, segmented cumsum,
+scatter variants, row gather, while-step overhead, histogram kernels) and
+banks results to JSON after every stage.  One process, one backend claim
+(docs/PERFORMANCE.md single-tenant doctrine).
+
+Run ALONE:  python tools/tpu_probe.py out.json
+"""
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.utils.platform import _cache_dir  # noqa: E402
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else os.path.join(REPO, "tpu_probe.json")
+T0 = time.time()
+DATA = {"started_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "stages": []}
+
+
+def bank(stage, **kw):
+    kw["stage"] = stage
+    kw["t_elapsed"] = round(time.time() - T0, 1)
+    DATA["stages"].append(kw)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(DATA, f, indent=1, default=str)
+    os.replace(tmp, OUT)
+    print(f"[probe] {stage}: {json.dumps(kw, default=str)[:400]}", flush=True)
+
+
+def main():
+    t = time.time()
+    try:
+        import jax
+        devs = jax.devices()
+        import jax.numpy as jnp
+        jnp.ones((8, 8)).sum().block_until_ready()
+    except Exception as e:
+        bank("init", error=str(e)[-600:])
+        return 3
+    import numpy as np
+    d = devs[0]
+    bank("init", seconds=round(time.time() - t, 1), platform=d.platform,
+         kind=getattr(d, "device_kind", ""))
+    if d.platform == "cpu" and os.environ.get("TM_ALLOW_CPU") != "1":
+        bank("abort", reason="backend resolved to cpu")
+        return 3
+
+    from bench import dsync
+
+    def timeit(name, fn, *args, reps=5):
+        """Compile, then time reps with real sync; bank ms/call."""
+        try:
+            t0 = time.time()
+            dsync(fn(*args))
+            compile_s = time.time() - t0
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                dsync(fn(*args))
+            ms = (time.perf_counter() - t0) / reps * 1e3
+            bank(name, ms=round(ms, 3), compile_s=round(compile_s, 1))
+            return ms
+        except Exception as e:
+            bank(name, error=str(e)[-400:],
+                 tb=traceback.format_exc()[-600:])
+            return None
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.RandomState(0)
+
+    # ---- sync overhead itself (floor for every timing here)
+    one = jnp.ones((8,), jnp.float32)
+    timeit("dsync_floor", jax.jit(lambda x: x + 1), one, reps=20)
+
+    for n in (1_000_000, 5_000_000, 11_000_000):
+        tag = f"{n//1_000_000}m"
+        keys = jnp.asarray(rng.randint(0, 128, n).astype(np.int32))
+        f32 = jnp.asarray(rng.rand(n).astype(np.float32))
+        perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+
+        # sort: argsort of small-range i32 keys (segment-hist by sort)
+        timeit(f"argsort_i32_{tag}", jax.jit(lambda k: jnp.argsort(k)), keys,
+               reps=3)
+        # sort with payload (lax.sort two operands, stable)
+        timeit(f"sort_kv_{tag}",
+               jax.jit(lambda k, v: lax.sort((k, v), is_stable=True,
+                                             num_keys=1)[1]),
+               keys, jnp.arange(n, dtype=jnp.int32), reps=3)
+        # cumsum f32 (repartition building block)
+        timeit(f"cumsum_f32_{tag}", jax.jit(lambda x: jnp.cumsum(x)), f32,
+               reps=3)
+        # cumsum i32
+        timeit(f"cumsum_i32_{tag}", jax.jit(lambda x: jnp.cumsum(x)),
+               keys, reps=3)
+        # unique-scatter a permutation (inverse-permutation build)
+        timeit(f"scatter_unique_perm_{tag}",
+               jax.jit(lambda p: jnp.zeros(n, jnp.int32).at[p].set(
+                   jnp.arange(n, dtype=jnp.int32), unique_indices=True,
+                   mode="drop")), perm, reps=3)
+        # scatter-add n updates into 128*64 bins (1-D, non-unique)
+        timeit(f"scatter_add_flat_{tag}",
+               jax.jit(lambda k, v: jnp.zeros(128 * 64, jnp.float32)
+                       .at[k * 64].add(v)), keys, f32, reps=3)
+        # segment_sum into 128 segments
+        timeit(f"segment_sum128_{tag}",
+               jax.jit(lambda k, v: jax.ops.segment_sum(
+                   v, k, num_segments=128)), keys, f32, reps=3)
+        del keys, f32, perm
+
+    # ---- row gather: permute an [n, 28] u8 matrix (partition maintenance)
+    for n in (1_000_000, 11_000_000):
+        tag = f"{n//1_000_000}m"
+        mat = jnp.asarray(rng.randint(0, 64, (n, 28)).astype(np.uint8))
+        perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+        timeit(f"gather_rows_u8x28_{tag}",
+               jax.jit(lambda m, p: jnp.take(m, p, axis=0)), mat, perm,
+               reps=3)
+        # scatter rows (inverse move): unique row scatter
+        timeit(f"scatter_rows_u8x28_{tag}",
+               jax.jit(lambda m, p: jnp.zeros_like(m).at[p].set(
+                   m, unique_indices=True, mode="drop")), mat, perm, reps=3)
+        # gather of one element per row (column pick, gl computation)
+        col = jnp.asarray(rng.randint(0, 28, n).astype(np.int32))
+        timeit(f"take_along_axis_{tag}",
+               jax.jit(lambda m, c: jnp.take_along_axis(
+                   m, c[:, None], axis=1)[:, 0]), mat, col, reps=3)
+        del mat, perm, col
+
+    # ---- histogram kernels, REAL sync, 1M and 8M rows
+    from lightgbm_tpu.ops.histogram import build_histogram
+    for n in (1_000_000, 8_000_000):
+        tag = f"{n//1_000_000}m"
+        binned = jnp.asarray(rng.randint(0, 63, (n, 28)).astype(np.uint8))
+        g = jnp.asarray(rng.randn(n).astype(np.float32))
+        h = jnp.abs(g) + 0.1
+        m = jnp.ones((n,), jnp.float32)
+        for method in ("matmul", "pallas", "scatter"):
+            timeit(f"hist_{method}_{tag}",
+                   jax.jit(lambda b, gg, hh, mm, _m=method: build_histogram(
+                       b, gg, hh, mm, 64, method=_m)), binned, g, h, m,
+                   reps=3)
+        del binned, g, h, m
+
+    # ---- segment histogram (current scatter impl) at 1M x 28, 128 slots
+    from lightgbm_tpu.ops.histogram import segment_histogram
+    n = 1_000_000
+    binned = jnp.asarray(rng.randint(0, 63, (n, 28)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.abs(g) + 0.1
+    w = jnp.ones((n,), jnp.float32)
+    slot = jnp.asarray(rng.randint(0, 129, n).astype(np.int32))
+    timeit("seghist_scatter_1m",
+           jax.jit(lambda b, gg, hh, ww, s: segment_histogram(
+               b, gg, hh, ww, s, 128, 64)), binned, g, h, w, slot, reps=3)
+    del binned, g, h, w, slot
+
+    # ---- while_loop per-step overhead: tiny body, 1000 steps
+    def loop_tiny(x):
+        def body(c):
+            i, v = c
+            return i + 1, v * 1.000001 + 1e-9
+        return lax.while_loop(lambda c: c[0] < 1000, body,
+                              (jnp.int32(0), x))[1]
+    timeit("while_1000_tiny_steps", jax.jit(loop_tiny),
+           jnp.float32(1.0), reps=3)
+
+    # medium body: ~64 elementwise ops on [255] vectors + a [255,28,64]
+    # reduce per step, 100 steps (round-body overhead scale model)
+    def loop_med(x):
+        def body(c):
+            i, v, hmat = c
+            for _ in range(16):
+                v = v * 1.0001 + jnp.roll(v, 1) * 1e-6
+            s = hmat.sum(axis=(1, 2))
+            return i + 1, v + s * 1e-9, hmat * 0.9999
+        return lax.while_loop(lambda c: c[0] < 100, body,
+                              (jnp.int32(0), x,
+                               jnp.ones((255, 28, 64), jnp.float32)))[1]
+    timeit("while_100_medium_steps", jax.jit(loop_med),
+           jnp.ones((255,), jnp.float32), reps=3)
+
+    # ---- dynamic_update_slice accumulator inside scan (block seg-hist)
+    def scan_dus(parts, slots):
+        def body(acc, xs):
+            p, s = xs
+            return lax.dynamic_update_slice(
+                acc, (lax.dynamic_slice(acc, (s, 0), (1, 5376)) + p[None, :]),
+                (s, 0)), None
+        return lax.scan(body, jnp.zeros((129, 5376), jnp.float32),
+                        (parts, slots))[0]
+    nb = 2688
+    parts = jnp.asarray(rng.rand(nb, 5376).astype(np.float32))
+    slots = jnp.asarray(rng.randint(0, 128, nb).astype(np.int32))
+    timeit("scan_dus_accum_2688blocks", jax.jit(scan_dus), parts, slots,
+           reps=3)
+
+    bank("done", total_seconds=round(time.time() - T0, 1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
