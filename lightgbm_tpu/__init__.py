@@ -34,9 +34,10 @@ except ImportError:  # pragma: no cover
     pass
 
 try:
-    from .plotting import (plot_importance, plot_metric,  # noqa: F401
+    from .plotting import (create_tree_digraph,  # noqa: F401
+                           plot_importance, plot_metric,
                            plot_split_value_histogram, plot_tree)
-    __all__ += ["plot_importance", "plot_metric", "plot_tree",
-                "plot_split_value_histogram"]
+    __all__ += ["create_tree_digraph", "plot_importance", "plot_metric",
+                "plot_tree", "plot_split_value_histogram"]
 except ImportError:  # pragma: no cover
     pass

@@ -523,6 +523,7 @@ class GBDT:
             num_bins=self.num_bins,
             learning_rate=self.config.learning_rate,
             compact=self.config.tpu_compact_hist,
+            round_width=self.config.tpu_round_width,
             voting_top_k=vote_k,
             num_machines=nmach,
             bynode_feature_cnt=bynode_cnt,

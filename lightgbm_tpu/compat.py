@@ -41,3 +41,30 @@ try:
     SKLEARN_INSTALLED = True
 except ImportError:
     SKLEARN_INSTALLED = False
+
+
+if SKLEARN_INSTALLED:
+    from sklearn.base import (BaseEstimator as _LGBMModelBase,          # noqa: F401
+                              ClassifierMixin as _LGBMClassifierBase,
+                              RegressorMixin as _LGBMRegressorBase)
+    from sklearn.exceptions import NotFittedError as _SKNotFittedError
+
+    class LGBMNotFittedError(_SKNotFittedError):
+        """Raised when predicting with an unfitted estimator (reference
+        compat.py LGBMNotFittedError; subclasses sklearn's NotFittedError
+        so sklearn's estimator checks recognize it)."""
+else:
+    class _LGBMModelBase:                          # noqa: D401
+        """Dummy base when scikit-learn is absent."""
+
+    class _LGBMClassifierBase:
+        pass
+
+    class _LGBMRegressorBase:
+        pass
+
+    class LGBMNotFittedError(ValueError, AttributeError):
+        """Raised when predicting with an unfitted estimator.
+
+        Also an AttributeError so hasattr(est, "n_features_in_") is False
+        before fit (matching sklearn's NotFittedError MRO)."""

@@ -204,6 +204,9 @@ class GrowerConfig(NamedTuple):
     rounds_relaxed: bool = False   # rounds grower: skip the best-first
                                    # exactness fallback (tpu_tree_growth=
                                    # "fast"; see grower_rounds.py)
+    round_width: int = 128         # rounds grower: max splits per round
+                                   # (candidate-scan length / segment-slot
+                                   # count; tpu_round_width)
     cegb_tradeoff: float = 1.0     # CEGB (reference cost_effective_
     cegb_penalty_split: float = 0.0  # gradient_boosting.hpp:50 DetlaGain)
     cegb_coupled: bool = False     # static: coupled-penalty array passed

@@ -137,7 +137,7 @@ def grow_tree_rounds(
     # round applies a PREFIX of the best-first order and the validation
     # check still guards interleaving); it bounds the changed-slot search
     # width and the segment-histogram slot axis.
-    KCAP = min(Lm1, 128)
+    KCAP = min(Lm1, max(1, cfg.round_width))
 
     use_mc = monotone_constraints is not None
     mc_j = jnp.asarray(monotone_constraints) if use_mc else None
@@ -411,7 +411,6 @@ def grow_tree_rounds(
         # per-leaf candidates are independent, so lane i's results are
         # valid under any commit that includes candidate i.  Left children
         # keep the parent's leaf slot; stats come from the cache.
-        idl = jnp.clip(order[:KCAP], 0, L - 1)          # candidate leaves
         ph = c.hist[idl]                                # [K, G, Bg, 3]
         sl = small_left[idl][:, None, None, None]
         h_left = jnp.where(sl, seg, ph - seg)

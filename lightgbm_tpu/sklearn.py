@@ -13,13 +13,53 @@ import numpy as np
 
 from .basic import Booster
 from .callback import EarlyStopException
+from .compat import (LGBMNotFittedError, _LGBMClassifierBase,
+                     _LGBMModelBase, _LGBMRegressorBase)
 from .config import Config
 from .dataset import Dataset
 from .engine import train as train_fn
 
 
-class LGBMModel:
-    """Base sklearn-style estimator (reference: sklearn.py:169)."""
+def _ensure_1d_y(y):
+    """Flatten y, warning on a column vector (sklearn protocol)."""
+    y = np.asarray(y)
+    if y.ndim == 2 and y.shape[1] == 1:
+        import warnings
+        try:
+            from sklearn.exceptions import DataConversionWarning
+        except ImportError:
+            DataConversionWarning = UserWarning
+        warnings.warn(
+            "A column-vector y was passed when a 1d array was expected. "
+            "Please change the shape of y to (n_samples, ), for example "
+            "using ravel().", DataConversionWarning, stacklevel=2)
+    return y.reshape(-1)
+
+
+def _sample_weight_from_class_weight(class_weight, y):
+    """Per-row weights from a class_weight spec.
+
+    A dict may name only SOME classes; absent classes weigh 1.0 — the
+    semantics the reference inherited from older scikit-learn (modern
+    compute_sample_weight raises on a partial dict instead).
+    """
+    y = np.asarray(y).reshape(-1)
+    if isinstance(class_weight, dict):
+        u, inv = np.unique(y, return_inverse=True)
+        per_class = np.array([float(class_weight.get(v, 1.0)) for v in u],
+                             np.float64)
+        return per_class[inv]
+    from sklearn.utils.class_weight import compute_sample_weight
+    return compute_sample_weight(class_weight, y)
+
+
+class LGBMModel(_LGBMModelBase):
+    """Base sklearn-style estimator (reference: sklearn.py:169).
+
+    Inherits scikit-learn's BaseEstimator (the reference's _LGBMModelBase,
+    compat.py) so meta-estimators (GridSearchCV, clone, modern
+    __sklearn_tags__ introspection) treat it as a first-class estimator.
+    """
 
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
                  max_depth: int = -1, learning_rate: float = 0.1,
@@ -90,12 +130,18 @@ class LGBMModel:
         params.pop("importance_type", None)
         params.pop("n_estimators", None)
         params.pop("class_weight", None)
-        if callable(self.objective):
+        obj = getattr(self, "_objective_resolved", None) or self.objective
+        if callable(obj):
             params["objective"] = "none"
-        elif self.objective is None:
+        elif obj is None:
             params["objective"] = self._default_objective()
-        self._objective = (self.objective if callable(self.objective)
-                           else params.get("objective", self.objective))
+        else:
+            params["objective"] = obj
+        nc = getattr(self, "_num_class_fit", 0)
+        if nc > 1:
+            params.setdefault("num_class", nc)
+        self._objective = (obj if callable(obj)
+                           else params.get("objective", obj))
         if self.random_state is not None:
             params["seed"] = (self.random_state if isinstance(self.random_state, int)
                               else 0)
@@ -115,6 +161,17 @@ class LGBMModel:
         if not params.get("verbosity") and self.silent:
             params["verbosity"] = -1
         return params
+
+    def __sklearn_tags__(self):
+        tags = super().__sklearn_tags__()
+        tags.input_tags.sparse = True      # scipy CSR/CSC bin host-side
+        tags.input_tags.allow_nan = True   # NaN is a first-class missing value
+        return tags
+
+    def __sklearn_is_fitted__(self) -> bool:
+        # modern check_is_fitted protocol: our fitted state lives behind
+        # properties, not trailing-underscore instance attributes
+        return self._Booster is not None
 
     def _default_objective(self) -> str:
         return "regression"
@@ -138,38 +195,60 @@ class LGBMModel:
         # params metric, or — when absent — the objective name as a metric
         # alias (the factory resolves "regression"->l2 etc.) or the class
         # default for callable objectives; then UNION with eval_metric
-        # strings (eval_metric adds metrics, it does not replace)
-        pm = params.get("metric")
-        pm = [pm] if isinstance(pm, str) else list(pm or [])
-        if not pm:
-            if callable(self.objective):
-                pm = [self._default_eval_metric()]
-            # else: engine derives the objective's default metric itself
+        # strings (eval_metric adds metrics, it does not replace).
+        # A BARE-callable eval_metric skips this whole block (reference
+        # sklearn.py:520-524: `if callable(eval_metric): feval = ...` with
+        # the deduction in the else branch), so a custom objective + custom
+        # metric trains with no built-in metric at all.
         em, feval_fns = [], []
         if eval_metric is not None:
             em_raw = ([eval_metric] if isinstance(eval_metric, str)
                       or callable(eval_metric) else list(eval_metric))
             em = [m for m in em_raw if not callable(m)]
             feval_fns = [m for m in em_raw if callable(m)]
-        if em and not pm:
-            pm = [str(params.get("objective", self._default_objective()))]
-        # eval_metric strings PREPEND (reference order): first_metric_only
-        # early stopping keys off the first metric, which must be the
-        # caller's eval_metric when one is given
-        merged = [m for m in em if m not in pm] + pm
-        if merged:
-            params["metric"] = merged
+        if not callable(eval_metric):
+            pm = params.get("metric")
+            if isinstance(pm, (set, frozenset)):
+                pm = sorted(pm, key=str)    # deterministic (config._coerce)
+            pm = [pm] if isinstance(pm, str) else list(pm or [])
+            if not pm:
+                if callable(self.objective):
+                    pm = [self._default_eval_metric()]
+                # else: engine derives the objective's default metric itself
+            if em and not pm:
+                pm = [str(params.get("objective", self._default_objective()))]
+            # eval_metric strings PREPEND (reference order): first_metric_only
+            # early stopping keys off the first metric, which must be the
+            # caller's eval_metric when one is given
+            merged = [m for m in em if m not in pm] + pm
+            if merged:
+                params["metric"] = merged
         if getattr(self, "_eval_at", None):
             params["eval_at"] = list(self._eval_at)
 
         X_orig, y_orig = X, y
         if not _is_pandas(X):
             X = _to_array(X)
-        y = np.asarray(y).reshape(-1)
+        y = _ensure_1d_y(y)
+        if getattr(X, "ndim", 2) == 1:
+            raise ValueError(
+                "Expected 2D array, got 1D array instead. Reshape your "
+                "data either using array.reshape(-1, 1) if your data has "
+                "a single feature or array.reshape(1, -1) if it contains "
+                "a single sample.")
+        if X.shape[0] == 0:
+            raise ValueError(
+                f"Found array with 0 sample(s) (shape={X.shape}) while a "
+                "minimum of 1 is required.")
+        if X.ndim == 2 and X.shape[1] == 0:
+            raise ValueError(
+                f"Found array with 0 feature(s) (shape={X.shape}) while a "
+                "minimum of 1 is required.")
         self._n_features = X.shape[1]
         y_t = self._transform_label(y)
         if self.class_weight is not None and sample_weight is None:
-            sample_weight = self._class_weights(y_t)
+            # computed on ORIGINAL labels so dict keys match caller values
+            sample_weight = self._class_weights(y)
         if isinstance(init_model, LGBMModel):
             init_model = init_model.booster_
 
@@ -188,12 +267,10 @@ class LGBMModel:
                 vi = eval_init_score[i] if eval_init_score else None
                 vcw = eval_class_weight[i] if eval_class_weight else None
                 if vcw is not None and vw is None:
-                    from sklearn.utils.class_weight import \
-                        compute_sample_weight
                     # weights computed on ORIGINAL labels so dict keys
                     # ({'5': 30} / {5: 30}) match the caller's y values
-                    vw = compute_sample_weight(vcw,
-                                               np.asarray(vy).reshape(-1))
+                    vw = _sample_weight_from_class_weight(
+                        vcw, np.asarray(vy).reshape(-1))
                 vxa = vx if _is_pandas(vx) else _to_array(vx)
                 same = (vx is X_orig and vy is y_orig
                         and vw is None and vg is None and vi is None)
@@ -245,18 +322,26 @@ class LGBMModel:
         return y.astype(np.float64)
 
     def _class_weights(self, y):
-        from sklearn.utils.class_weight import compute_sample_weight
-        return compute_sample_weight(self.class_weight, y)
+        return _sample_weight_from_class_weight(self.class_weight, y)
 
     def predict(self, X, raw_score: bool = False, num_iteration=None,
                 pred_leaf: bool = False, pred_contrib: bool = False, **kwargs):
         if self._Booster is None:
-            raise ValueError("Estimator not fitted")
+            raise LGBMNotFittedError("Estimator not fitted; call fit first")
         if not _is_pandas(X):
             X = _to_array(X)
+        if getattr(X, "ndim", 2) == 1:
+            raise ValueError(
+                "Expected 2D array, got 1D array instead. Reshape your "
+                "data either using array.reshape(-1, 1) if your data has "
+                "a single feature or array.reshape(1, -1) if it contains "
+                "a single sample.")
         if (X.shape[1] != self._n_features
                 and not kwargs.get("predict_disable_shape_check")):
-            raise ValueError(f"X has {X.shape[1]} features, expected {self._n_features}")
+            raise ValueError(
+                f"X has {X.shape[1]} features, but "
+                f"{type(self).__name__} is expecting "
+                f"{self._n_features} features as input")
         # kwargs ride through to Booster.predict (pred_early_stop,
         # pred_early_stop_freq/margin, predict_disable_shape_check, ...)
         return self._Booster.predict(X, raw_score=raw_score,
@@ -269,7 +354,7 @@ class LGBMModel:
     @property
     def booster_(self) -> Booster:
         if self._Booster is None:
-            raise ValueError("No booster found; call fit first")
+            raise LGBMNotFittedError("No booster found; call fit first")
         return self._Booster
 
     @property
@@ -277,7 +362,7 @@ class LGBMModel:
         """The concrete objective used while fitting (reference:
         sklearn.py:703)."""
         if self._Booster is None:
-            raise ValueError("No objective found; call fit first")
+            raise LGBMNotFittedError("No objective found; call fit first")
         return self._objective
 
     @property
@@ -300,6 +385,12 @@ class LGBMModel:
 
     @property
     def n_features_in_(self):
+        if self._Booster is None:
+            # NotFittedError subclasses AttributeError, so hasattr() is
+            # False before fit — the modern sklearn check_n_features_in
+            # contract
+            raise LGBMNotFittedError(
+                "No fit performed; call fit before n_features_in_")
         return self._n_features
 
     @property
@@ -311,7 +402,7 @@ class LGBMModel:
         return self.booster_.feature_name()
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_LGBMRegressorBase, LGBMModel):
     """reference: sklearn.py:744."""
 
     def _default_objective(self):
@@ -322,7 +413,7 @@ class LGBMRegressor(LGBMModel):
         return r2_score(y, self.predict(X), sample_weight=sample_weight)
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
     """reference: sklearn.py:771."""
 
     def _default_objective(self):
@@ -338,17 +429,53 @@ class LGBMClassifier(LGBMModel):
         return accuracy_score(y, self.predict(X), sample_weight=sample_weight)
 
     def fit(self, X, y, **kwargs):
-        y = np.asarray(y).reshape(-1)
-        self._classes, y_enc = np.unique(y, return_inverse=True)
+        if y is None:
+            raise ValueError(
+                "This estimator requires y to be passed, but the target "
+                "y is None")
+        y = _ensure_1d_y(y)
+        try:
+            from sklearn.utils.multiclass import check_classification_targets
+            check_classification_targets(y)
+        except ImportError:
+            pass
+        self._classes = np.unique(y)
         self._n_classes = len(self._classes)
-        self._y_encoded = y_enc
+        # resolve the fit-time objective WITHOUT mutating self.objective
+        # (clone/get_params must keep reconstructing the constructor args):
+        # >2 classes forces a multiclass objective — any non-ova string,
+        # including an unknown one, becomes "multiclass" (reference
+        # sklearn.py:794-797 "Switch to using a multiclass objective")
         params_obj = self.objective
-        if params_obj is None:
-            if self._n_classes > 2:
-                self._other_params.setdefault("num_class", self._n_classes)
-                self.objective = "multiclass"
-            else:
-                self.objective = "binary"
+        ova_aliases = {"multiclassova", "multiclass_ova", "ova", "ovr"}
+        if callable(params_obj):
+            resolved = params_obj
+        elif self._n_classes > 2:
+            resolved = (params_obj if params_obj in ova_aliases
+                        else "multiclass")
+        else:
+            resolved = params_obj if params_obj is not None else "binary"
+        self._objective_resolved = resolved
+        self._num_class_fit = (self._n_classes if self._n_classes > 2
+                               and "num_class" not in self._other_params
+                               else 0)
+        # an eval_metric of the wrong arity is swapped for its alternative
+        # (reference sklearn.py:797-805) so binary_error on a 3-class fit
+        # means multi_error instead of a config conflict
+        if self._n_classes > 2:
+            remap = {"logloss": "multi_logloss", "binary_logloss":
+                     "multi_logloss", "error": "multi_error",
+                     "binary_error": "multi_error"}
+        else:
+            remap = {"logloss": "binary_logloss", "multi_logloss":
+                     "binary_logloss", "error": "binary_error",
+                     "multi_error": "binary_error"}
+        em = kwargs.get("eval_metric")
+        if isinstance(em, str):
+            kwargs["eval_metric"] = remap.get(em, em)
+        elif isinstance(em, (list, tuple)):
+            kwargs["eval_metric"] = [
+                remap.get(m, m) if isinstance(m, str) else m for m in em]
         super().fit(X, y, **kwargs)
         return self
 
@@ -371,7 +498,11 @@ class LGBMClassifier(LGBMModel):
                 pred_leaf=False, pred_contrib=False, **kwargs):
         result = self.predict_proba(X, raw_score, num_iteration, pred_leaf,
                                     pred_contrib, **kwargs)
-        if raw_score or pred_leaf or pred_contrib:
+        if (callable(self.objective) or raw_score or pred_leaf
+                or pred_contrib):
+            # custom objective: outputs are raw scores, not probabilities —
+            # thresholding them would mislabel (reference sklearn.py
+            # predict returns the raw result for callable objectives)
             return result
         if result.ndim == 1:  # binary probabilities
             idx = (result > 0.5).astype(int)
@@ -383,6 +514,15 @@ class LGBMClassifier(LGBMModel):
                       pred_leaf=False, pred_contrib=False, **kwargs):
         res = super().predict(X, raw_score, num_iteration, pred_leaf,
                               pred_contrib, **kwargs)
+        if callable(self.objective) and not (raw_score or pred_leaf
+                                             or pred_contrib):
+            # reference sklearn.py predict_proba: a custom objective means
+            # the model's outputs are untransformable raw scores
+            import warnings
+            warnings.warn("Cannot compute class probabilities or labels "
+                          "due to the usage of customized objective "
+                          "function.\nReturning raw scores instead.")
+            return res
         if raw_score or pred_leaf or pred_contrib:
             return res
         if res.ndim == 1:
@@ -436,11 +576,16 @@ def _is_pandas(X) -> bool:
 
 
 def _to_array(X):
-    if hasattr(X, "values"):
-        return np.ascontiguousarray(X.values, dtype=np.float64)
-    if hasattr(X, "toarray"):
-        return np.ascontiguousarray(X.toarray(), dtype=np.float64)
-    return np.ascontiguousarray(np.asarray(X), dtype=np.float64)
+    if hasattr(X, "toarray"):          # scipy sparse (any format) FIRST:
+        X = X.toarray()                # dok has a dict-style .values METHOD
+    elif hasattr(X, "values") and not callable(X.values):
+        X = X.values                   # pandas
+    elif hasattr(X, "values"):
+        X = X.values()
+    X = np.asarray(X)
+    if np.iscomplexobj(X):
+        raise ValueError("Complex data not supported")
+    return np.ascontiguousarray(X, dtype=np.float64)
 
 
 def _wrap_objective(func: Callable):

@@ -2,7 +2,7 @@
 # Run the REFERENCE package's own python test suite against lightgbm_tpu
 # via a module shim (import lightgbm -> lightgbm_tpu).
 #
-# Status on this image (2026-07-30, end of round 4):
+# Status on this image (2026-07-31, round 5):
 #   test_basic.py   7 passed; 3 failures are modern-sklearn API breaks in
 #                   the OLD tests (load_breast_cancer(True) positional)
 #   test_engine.py  ~45/50 passing.  Remaining failures and why:
@@ -14,8 +14,27 @@
 #     - test_auc_mu: asserts 2-class multiclass AUC trajectory == binary
 #       AUC trajectory exactly; ours agree to ~4e-5 (rank-equivalence of
 #       softmax-2 vs sigmoid training differs at float level)
-#   test_sklearn.py / test_plotting.py cannot even import on modern
-#   sklearn (from sklearn.datasets import load_boston at module top).
+#   test_sklearn.py  25/29 passing (estimator-check shim below).  The 4
+#   remaining failures, each justified:
+#     - test_dart / test_first_metric_only: thresholds / early-stop
+#       iteration counts hardcoded from REAL boston; on the synthetic
+#       stand-in the REFERENCE ITSELF scores R2 0.32-0.67 vs the asserted
+#       0.8 (verified against the locally built reference lib; our dart
+#       averages the same quality over seeds)
+#     - test_inf_handle: the reference diverges to l2=inf on 1e30-scale
+#       labels x 1e10 weights (double-score overflow artifact, asserted
+#       as the expected output); we fit the weighted mean exactly at f32
+#       resolution and report l2=0 — a deliberate, saner deviation
+#     - test_sklearn_integration: runs MODERN sklearn's full check suite
+#       (which the reference's own wrapper predates and would fail far
+#       earlier).  We pass tags/clone/NotFittedError/validation checks;
+#       the first remaining check (all-zero sample_weight must raise)
+#       CONTRADICTS reference semantics asserted by test_nan_handle
+#       (trains with all-zero weights, expects nan metrics), so it is not
+#       satisfiable while staying reference-faithful.
+#   test_plotting.py 3/5 passing; the 2 failures call graph.render(),
+#       which needs the graphviz `dot` binary this image doesn't ship
+#       (the reference package fails identically here).
 #
 # Re-run after any API-surface change.
 set -e
@@ -69,8 +88,78 @@ def _positional_ok(orig, argnames):
 for _n, _sig in _OLD_SIGS.items():
     if hasattr(_skd, _n):
         setattr(_skd, _n, _positional_ok(getattr(_skd, _n), _sig))
+
+# sklearn >= 1.x renamed the estimator-check internals the OLD
+# test_sklearn.py imports: _yield_all_checks(name, est) became
+# _yield_all_checks(est, legacy) yielding single-arg checks.  Adapt both
+# directions so "for check in _yield_all_checks(name, est): check(name,
+# est)" keeps working and check.__name__ still names the check.
+# (NOTE: this file is written through an unquoted heredoc - no backticks
+# or dollar signs in comments.)
+import sklearn.utils.estimator_checks as _est_checks
+import inspect as _inspect
+
+_sig = None
+try:
+    _sig = _inspect.signature(_est_checks._yield_all_checks)
+except AttributeError:
+    pass
+if _sig is None or "legacy" in _sig.parameters:
+    _modern_yield = getattr(_est_checks, "_yield_all_checks", None)
+
+    class _CheckAdapter:
+        def __init__(self, chk):
+            inner = getattr(chk, "func", chk)
+            self.__name__ = getattr(inner, "__name__", "check")
+            self._chk = chk
+            # decide the calling convention UP FRONT from the signature
+            # (a try/except TypeError retry would mask genuine TypeErrors
+            # raised by the estimator code under test)
+            try:
+                n_free = len(_inspect.signature(chk).parameters)
+            except (TypeError, ValueError):
+                n_free = 1
+            self._wants_name = n_free >= 2
+        def __call__(self, name, est):
+            from unittest import SkipTest as _ST
+            try:
+                if self._wants_name:
+                    return self._chk(name, est)
+                return self._chk(est)
+            except _ST:
+                # the OLD test forwards SkipTest to warnings.warn but never
+                # imports warnings (latent bug: old checks never skipped);
+                # treat an environment-skip as a no-op here instead
+                return None
+
+    def _yield_all_checks(name, estimator):
+        if _modern_yield is None:
+            return
+        for chk in _modern_yield(estimator, legacy=True):
+            yield _CheckAdapter(chk)
+
+    _est_checks._yield_all_checks = _yield_all_checks
+if not hasattr(_est_checks, "SkipTest"):
+    from sklearn.exceptions import SkipTestWarning as _stw  # noqa: F401
+    from unittest import SkipTest as _SkipTest
+    _est_checks.SkipTest = _SkipTest
+
+# old sklearn accepted an estimator CLASS here; modern clone() requires an
+# instance
+_orig_cpdc = _est_checks.check_parameters_default_constructible
+
+def check_parameters_default_constructible(name, estimator):
+    if isinstance(estimator, type):
+        estimator = estimator()
+    return _orig_cpdc(name, estimator)
+
+_est_checks.check_parameters_default_constructible = (
+    check_parameters_default_constructible)
 EOF
+FILES="${REF_SUITE:-test_basic.py test_engine.py test_sklearn.py test_plotting.py}"
+PATHS=""
+for f in $FILES; do
+    PATHS="$PATHS /root/reference/tests/python_package_test/$f"
+done
 PYTHONPATH="$SHIM_DIR" python -m pytest -p refshim \
-    /root/reference/tests/python_package_test/test_basic.py \
-    /root/reference/tests/python_package_test/test_engine.py \
-    -q -o cache_dir="$SHIM_DIR/.pc" "$@"
+    $PATHS -q -o cache_dir="$SHIM_DIR/.pc" "$@"

@@ -1,0 +1,141 @@
+"""Grower-parameter sweep on the live chip: one process, shared dataset.
+
+Times tpu_tree_growth x tpu_round_width at 1M x 28 (HIGGS shape) plus
+chained-primitive costs, banking results per stage (single-tenant tunnel
+doctrine, docs/PERFORMANCE.md).
+
+Run ALONE:  python tools/tpu_sweep.py out.json
+"""
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.utils.platform import _cache_dir  # noqa: E402
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else os.path.join(REPO, "tpu_sweep.json")
+T0 = time.time()
+DATA = {"started_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "stages": []}
+N = int(os.environ.get("SWEEP_ROWS", 1_000_000))
+TREES = int(os.environ.get("SWEEP_TREES", 12))
+
+
+def bank(stage, **kw):
+    kw["stage"] = stage
+    kw["t_elapsed"] = round(time.time() - T0, 1)
+    DATA["stages"].append(kw)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(DATA, f, indent=1, default=str)
+    os.replace(tmp, OUT)
+    print(f"[sweep] {stage}: {json.dumps(kw, default=str)[:400]}", flush=True)
+
+
+def main():
+    t = time.time()
+    try:
+        import jax
+        d = jax.devices()[0]
+        import jax.numpy as jnp
+        jnp.ones((8, 8)).sum().block_until_ready()
+    except Exception as e:
+        bank("init", error=str(e)[-600:])
+        return 3
+    bank("init", seconds=round(time.time() - t, 1), platform=d.platform)
+    if d.platform == "cpu":
+        bank("abort", reason="cpu backend")
+        return 3
+
+    import numpy as np
+
+    import bench
+    import lightgbm_tpu as lgb
+    from bench import dsync
+
+    X, y = bench.make_higgs_like(N, 28)
+    base = {"objective": "binary", "num_leaves": 255, "learning_rate": 0.1,
+            "max_bin": 63, "metric": "None", "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=base)
+    t1 = time.time()
+    ds.construct()
+    bank("binning", seconds=round(time.time() - t1, 1))
+    del X
+
+    configs = [
+        ("strict_128", {"tpu_tree_growth": "rounds", "tpu_round_width": 128}),
+        ("fast_128", {"tpu_tree_growth": "fast", "tpu_round_width": 128}),
+        ("fast_64", {"tpu_tree_growth": "fast", "tpu_round_width": 64}),
+        ("fast_32", {"tpu_tree_growth": "fast", "tpu_round_width": 32}),
+        ("strict_64", {"tpu_tree_growth": "rounds", "tpu_round_width": 64}),
+    ]
+    for name, extra in configs:
+        if os.environ.get(f"SWEEP_SKIP_{name.upper()}") == "1":
+            bank(name, skipped=True)
+            continue
+        try:
+            params = dict(base, **extra)
+            bst = lgb.Booster(params=params, train_set=ds)
+            t1 = time.perf_counter()
+            bst.update()
+            dsync(bst.boosting.train_score)
+            compile_s = time.perf_counter() - t1
+            t1 = time.perf_counter()
+            for _ in range(TREES - 1):
+                bst.update()
+            dsync(bst.boosting.train_score)
+            spt = (time.perf_counter() - t1) / max(TREES - 1, 1)
+            auc = bench.holdout_auc(bst, 28)
+            bank(name, sec_per_tree=round(spt, 4),
+                 compile_seconds=round(compile_s, 1),
+                 holdout_auc=round(float(auc), 5))
+        except Exception as e:
+            bank(name, error=str(e)[-400:], tb=traceback.format_exc()[-800:])
+
+    # chained primitives at half-HIGGS scale: pipeline reps, one sync
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def chain(name, fn, x, reps=10):
+        try:
+            t1 = time.perf_counter()
+            y = fn(x)
+            dsync(y)
+            compile_s = time.perf_counter() - t1
+            t1 = time.perf_counter()
+            y = x
+            for _ in range(reps):
+                y = fn(y)
+            dsync(y)
+            total = time.perf_counter() - t1
+            bank(name, ms=round((total - 0.075) / reps * 1e3, 2),
+                 compile_s=round(compile_s, 1))
+        except Exception as e:
+            bank(name, error=str(e)[-300:])
+
+    rng = np.random.RandomState(0)
+    m = 5_500_000
+    keys = jnp.asarray(rng.randint(0, 129, m).astype(np.int32))
+    chain("sort_kv_5p5m",
+          jax.jit(lambda k: lax.sort(
+              (k, jnp.arange(m, dtype=jnp.int32)), is_stable=True,
+              num_keys=1)[1] % 129), keys)
+    mat = jnp.asarray(rng.randint(0, 63, (m, 28)).astype(np.uint8))
+    perm = jnp.asarray(rng.permutation(m).astype(np.int32))
+    chain("gather_rows_5p5m",
+          jax.jit(lambda p: (jnp.take(mat, p, axis=0).sum(axis=1)
+                             .astype(jnp.int32) + p) % m), perm)
+    bank("done", total_seconds=round(time.time() - T0, 1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
