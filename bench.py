@@ -673,25 +673,50 @@ def main():
 
     # runs until the worker exits (even after "full" lands — the ranking
     # stage follows it) or the budget floor is hit
+    stall_timeout = float(os.environ.get("BENCH_STALL_TIMEOUT", 2400))
+    last_progress = time.time()
     while try_tpu and remaining_budget() > 120:
         if proc is None:
-            # alternate env variants: odd attempts drop the remote-compile
-            # service that killed the round-2 run
-            variant = "default" if attempt % 2 == 0 else "no-remote-compile"
+            # variant order: local compile FIRST — the remote-compile
+            # service (PALLAS_AXON_REMOTE_COMPILE) hung >100 min compiling
+            # the HIGGS-scale program in round 5 (and killed the round-2
+            # run); retries alternate back in case local compile breaks
+            variant = "no-remote-compile" if attempt % 2 == 0 else "default"
             attempt += 1
             log(f"tpu worker attempt {attempt} (variant={variant}, "
-                f"budget left={int(remaining_budget())}s); the worker is "
-                "never killed on a timer (single-tenant tunnel: a blocked "
-                "init means a lingering claim that will expire; killing "
-                "would start a fresh wedge)")
+                f"budget left={int(remaining_budget())}s); a worker blocked "
+                "in INIT is never killed (single-tenant tunnel: the "
+                "lingering claim expires on its own; killing starts a "
+                "fresh ~25 min wedge), but a worker that has inited and "
+                f"then goes {int(stall_timeout)}s without a stage line is "
+                "assumed hung in compile and is restarted on the other "
+                "variant")
             proc, reader = launch_tpu_worker(variant)
             seen_lines = 0
+            last_progress = time.time()
         # drain worker stage lines AS THEY ARRIVE: a smoke result banked
         # mid-run becomes the driver-visible line even if we die later
         new = reader.lines[seen_lines:]
         if new:
             tpu_stages.extend(new)
             seen_lines += len(new)
+            last_progress = time.time()
+        inited = any(s.get("stage") == "init" and s.get("ok")
+                     for s in reader.lines)
+        if (inited and time.time() - last_progress > stall_timeout
+                and remaining_budget() > 600):
+            log(f"worker stalled {int(time.time() - last_progress)}s "
+                "post-init (hung compile); killing and switching variant")
+            proc.kill()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+            reader.join(timeout=10)
+            tpu_stages.extend(reader.lines[seen_lines:])
+            proc, reader = None, None
+            refresh_emission()
+            continue
         rc = proc.poll()
         if rc is not None:
             reader.join(timeout=10)   # let the drain thread parse the tail

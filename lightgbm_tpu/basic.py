@@ -137,7 +137,11 @@ class Booster:
         from .config import _METRIC_ALIASES, _OBJECTIVE_ALIASES
         obj = _OBJECTIVE_ALIASES.get(self.config.objective,
                                      self.config.objective)
-        is_multi_obj = obj in ("multiclass", "multiclassova")
+        # objective "none" (custom fobj) with num_class>1 counts as a
+        # multiclass objective for conflict checking (reference
+        # config.cpp:246 CheckParamConflict "custom" handling)
+        is_multi_obj = (obj in ("multiclass", "multiclassova")
+                        or (obj == "none" and self.config.num_class > 1))
         if is_multi_obj and self.config.num_class <= 1:
             raise LightGBMError(
                 "Number of classes should be specified and greater than 1 "
@@ -380,7 +384,11 @@ class Booster:
     def _custom_eval(self, feval, name, score, dataset):
         if feval is None:
             return []
-        s = np.asarray(score)
+        # float64: the reference's scores are double end-to-end, and the
+        # builtin metrics here compute in f64 — a custom feval computing
+        # the same quantity must see the same precision or the two drift
+        # at the ~1e-7 the reference suite asserts against
+        s = np.asarray(score, np.float64)
         if self.boosting.num_tree_per_iteration == 1:
             s = s[0]
         ret = feval(s, dataset)

@@ -260,6 +260,10 @@ class Dataset:
                 loaded = Dataset.load_binary(str(data), params=None)
                 keep = {"reference", "free_raw_data",
                         "_feature_name_param", "_categorical_feature_param"}
+                if not self.free_raw_data:
+                    # get_data() on a kept binary-file dataset returns the
+                    # PATH (reference basic.py get_data semantics)
+                    keep.add("raw_data")
                 for k, v in loaded.__dict__.items():
                     if k not in keep:
                         self.__dict__[k] = v
@@ -918,10 +922,17 @@ class Dataset:
         # a kept-raw parent hands its subset the raw rows too (reference:
         # subsets re-materialize from the parent's data — needed for
         # fpreproc / continued training on subsets)
-        if self.raw_data is not None and not isinstance(self.raw_data, str):
+        import os as _os
+        if self.raw_data is not None and not isinstance(
+                self.raw_data, (str, _os.PathLike)):
             sub.raw_data = (self.raw_data.iloc[idx]
                             if hasattr(self.raw_data, "iloc")
                             else self.raw_data[idx])
+            sub.free_raw_data = self.free_raw_data
+        elif isinstance(self.raw_data, (str, _os.PathLike)):
+            # file-backed parent: subsets report the same path from
+            # get_data() (reference test_init_with_subset asserts this)
+            sub.raw_data = self.raw_data
             sub.free_raw_data = self.free_raw_data
         else:
             sub.raw_data = None

@@ -405,7 +405,7 @@ def grow_tree_rounds(
         slot = jnp.where(row_small, crank, KCAP)
         seg = _psum(compacted_segment_histogram(
             binned, grad, hess, row_mask, slot, KCAP, Bg, caps,
-            f32_vals=seg_f32), axis_name)
+            f32_vals=seg_f32, num_live=k), axis_name)
 
         # -- candidate children's best splits, BEFORE committing anything:
         # per-leaf candidates are independent, so lane i's results are

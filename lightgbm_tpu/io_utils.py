@@ -117,6 +117,18 @@ def load_prediction_file(path: str, n_model_features: int,
     has its label column dropped (src/application/predictor.hpp parser
     setup).  LibSVM files always carry the label first.
     """
+    from .dataset import _BINARY_MAGIC
+    from .utils.file_io import open_file
+    try:
+        with open_file(path, "rb") as fh:
+            is_bin = fh.read(len(_BINARY_MAGIC)) == _BINARY_MAGIC
+    except OSError:
+        is_bin = False
+    if is_bin:
+        # a binned cache carries no raw features to predict from
+        # (reference: the Predictor's parser rejects it with this message)
+        from .config import LightGBMError
+        raise LightGBMError("Unknown format of training data")
     fmt, has_header = detect_format(path)
     if params.get("header", None) is not None:
         has_header = _param_bool(params, "header")

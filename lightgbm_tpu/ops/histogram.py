@@ -523,6 +523,9 @@ def segment_histogram_sorted(
     return lax.switch(bucket, [arena(c) for c in caps])
 
 
+_SMALL_ROUND_SLOTS = 4
+
+
 def compacted_segment_histogram(
     binned: jax.Array,       # [n, F]
     grad: jax.Array,
@@ -533,6 +536,7 @@ def compacted_segment_histogram(
     num_bins: int,
     caps: list,              # static descending capacities
     f32_vals: bool = False,
+    num_live: Optional[jax.Array] = None,   # traced count of live slots
 ) -> jax.Array:
     """Segment histogram over only the rows with a real slot, with the
     work bounded by the smallest static capacity that fits (see
@@ -542,6 +546,10 @@ def compacted_segment_histogram(
     scatter formulation both OOMs — its [n*F, 3] update buffer lane-pads
     to 128 — and serializes there); XLA scatter with nonzero-compaction
     on CPU (measured fastest there every round, BENCH_r0*.json).
+    When ``num_live`` (the round's live-slot count) is given and small,
+    accelerators take a masked full-pass per slot instead: a streamed
+    matmul pass costs ~17 ms at 11M rows vs ~90 ms for sort+gather+arena
+    (tpu_probe_r5.json), so up to ``_SMALL_ROUND_SLOTS`` passes win.
     ``LGBM_TPU_SEGHIST=sorted|scatter`` overrides (testing hook).
     """
     import os
@@ -553,9 +561,35 @@ def compacted_segment_histogram(
     if use_sorted:
         # zero-weight rows are dropped by reslotting (cheaper than compact)
         slot_w = jnp.where(weights > 0, slot, num_slots)
-        return segment_histogram_sorted(binned, grad, hess, weights, slot_w,
-                                        num_slots, num_bins,
-                                        f32_vals=f32_vals, caps=caps)
+
+        def arena_path(_):
+            return segment_histogram_sorted(
+                binned, grad, hess, weights, slot_w, num_slots, num_bins,
+                f32_vals=f32_vals, caps=caps)
+
+        if num_live is None or num_slots <= _SMALL_ROUND_SLOTS:
+            return arena_path(None)
+
+        method = "matmul" if not f32_vals else "matmul_f32"
+
+        def small_path(_):
+            def one(kk):
+                def live(_):
+                    return build_histogram(
+                        binned, grad, hess,
+                        weights * (slot_w == kk), num_bins, method=method)
+                return lax.cond(
+                    kk < num_live, live,
+                    lambda _: jnp.zeros((F, num_bins, 3), jnp.float32),
+                    None)
+            small = lax.map(one, jnp.arange(_SMALL_ROUND_SLOTS,
+                                            dtype=jnp.int32))
+            pad = jnp.zeros((num_slots - _SMALL_ROUND_SLOTS, F, num_bins, 3),
+                            jnp.float32)
+            return jnp.concatenate([small, pad], axis=0)
+
+        return lax.cond(num_live <= _SMALL_ROUND_SLOTS,
+                        small_path, arena_path, None)
 
     member = (slot < num_slots) & (weights > 0)
     count = jnp.sum(member)

@@ -498,8 +498,8 @@ class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
                 pred_leaf=False, pred_contrib=False, **kwargs):
         result = self.predict_proba(X, raw_score, num_iteration, pred_leaf,
                                     pred_contrib, **kwargs)
-        if (callable(self.objective) or raw_score or pred_leaf
-                or pred_contrib):
+        if (callable(getattr(self, "_objective", self.objective))
+                or raw_score or pred_leaf or pred_contrib):
             # custom objective: outputs are raw scores, not probabilities —
             # thresholding them would mislabel (reference sklearn.py
             # predict returns the raw result for callable objectives)
@@ -514,8 +514,8 @@ class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
                       pred_leaf=False, pred_contrib=False, **kwargs):
         res = super().predict(X, raw_score, num_iteration, pred_leaf,
                               pred_contrib, **kwargs)
-        if callable(self.objective) and not (raw_score or pred_leaf
-                                             or pred_contrib):
+        if callable(getattr(self, "_objective", self.objective)) \
+                and not (raw_score or pred_leaf or pred_contrib):
             # reference sklearn.py predict_proba: a custom objective means
             # the model's outputs are untransformable raw scores
             import warnings

@@ -168,3 +168,31 @@ def test_segment_histogram_sorted_all_dropped():
                                    jnp.full(n, 4, jnp.int32), 4, 8,
                                    f32_vals=True)
     assert float(jnp.abs(out).sum()) == 0.0
+
+
+def test_segment_histogram_small_round_path(monkeypatch):
+    """The small-round masked-pass branch (num_live <= 4 on the sorted
+    dispatch) must agree with the arena path and the scatter reference."""
+    import jax.numpy as jnp_
+    from lightgbm_tpu.ops.histogram import (capacity_schedule,
+                                            compacted_segment_histogram,
+                                            segment_histogram)
+    monkeypatch.setenv("LGBM_TPU_SEGHIST", "sorted")
+    rng = np.random.RandomState(5)
+    n, F, S, B = 6_000, 9, 64, 32
+    binned = jnp.asarray(rng.randint(0, B - 1, (n, F)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.abs(g) + 0.1
+    w = jnp.asarray((rng.rand(n) > 0.2).astype(np.float32))
+    caps = capacity_schedule(n, min_cap=512)
+    for live in (1, 3, 4, 5, 17):
+        # slots >= live are dropped lanes (as the grower produces)
+        slot = jnp.asarray(
+            np.where(rng.rand(n) < 0.7, rng.randint(0, live, n), S)
+            .astype(np.int32))
+        ref = np.asarray(segment_histogram(binned, g, h, w, slot, S, B))
+        got = np.asarray(compacted_segment_histogram(
+            binned, g, h, w, slot, S, B, caps, f32_vals=True,
+            num_live=jnp_.int32(live)))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"live={live}")
