@@ -50,8 +50,8 @@ from jax import lax
 from .dataset import FeatureMeta
 from .grower import GrowerConfig, TreeArrays, _LeafBest, _psum, row_goes_left
 from .ops.histogram import (build_histogram, capacity_schedule,
-                            compacted_segment_histogram,
-                            resolve_hist_method)
+                            compacted_segment_histogram, pack_rows_u32,
+                            resolve_hist_method, use_sorted_seghist)
 from .ops.split import (MAX_CAT_WORDS, SplitResult, best_split_for_leaf,
                         leaf_output)
 
@@ -111,6 +111,10 @@ def grow_tree_rounds(
     # feature-major copy for the candidate scan: one transpose per tree
     # (streams at HBM rate) buys contiguous per-candidate column reads
     binned_t = binned.T                                 # [G, n]
+    # fused u32 row records for the arena's single gather (sorted-path
+    # only: gather cost scales with element count — pack_rows_u32)
+    packed = (pack_rows_u32(binned, grad, hess, row_mask)
+              if use_sorted_seghist() else None)
     # segment-histogram precision follows the resolved histogram method so
     # parent - smaller-child subtraction stays consistent: only the bf16
     # one-hot matmul is inexact; every other kernel accumulates f32-exact
@@ -405,7 +409,7 @@ def grow_tree_rounds(
         slot = jnp.where(row_small, crank, KCAP)
         seg = _psum(compacted_segment_histogram(
             binned, grad, hess, row_mask, slot, KCAP, Bg, caps,
-            f32_vals=seg_f32, num_live=k), axis_name)
+            f32_vals=seg_f32, num_live=k, packed=packed), axis_name)
 
         # -- candidate children's best splits, BEFORE committing anything:
         # per-leaf candidates are independent, so lane i's results are

@@ -39,6 +39,17 @@ def on_accelerator() -> bool:
     return jax.default_backend() in ACCEL_BACKENDS
 
 
+def use_sorted_seghist() -> bool:
+    """Whether the segment histogram takes the sorted-arena path (ONE
+    shared predicate for the kernel dispatch and the grower's decision to
+    pre-pack row records).  LGBM_TPU_SEGHIST=sorted|scatter overrides."""
+    import os
+    forced = os.environ.get("LGBM_TPU_SEGHIST")
+    if forced in ("sorted", "scatter"):
+        return forced == "sorted"
+    return on_accelerator()
+
+
 def resolve_hist_method(method: str) -> str:
     """The concrete kernel ``method='auto'`` resolves to on this backend.
 
@@ -247,7 +258,7 @@ _probe_cache: dict = {}
 
 def measured_best_method(n: int, num_features: int, num_bins: int,
                          candidates=("matmul", "scatter", "pallas"),
-                         reps: int = 2) -> str:
+                         reps: int = 8) -> str:
     """Pick the histogram kernel by TIMING it on the live backend.
 
     reference: Dataset::GetShareStates times col-wise vs row-wise histogram
@@ -275,15 +286,25 @@ def measured_best_method(n: int, num_features: int, num_bins: int,
     grad = jnp.asarray(rng.randn(n_probe), jnp.float32)
     hess = jnp.abs(grad) + 0.1
     mask = jnp.ones((n_probe,), jnp.float32)
+    def _sync(x):
+        # block_until_ready is a NO-OP on the tunneled axon backend
+        # (docs/PERFORMANCE.md round-5 correction); a device->host copy of
+        # a dependent reduction is the only trustworthy barrier
+        return float(np.asarray(jnp.sum(x.astype(jnp.float32))))
+
     timings = {}
     for method in candidates:
         fn = jax.jit(functools.partial(build_histogram, num_bins=num_bins,
                                        method=method))
         try:
-            fn(binned, grad, hess, mask).block_until_ready()   # compile
+            _sync(fn(binned, grad, hess, mask))   # compile
+            # pipeline all reps, sync once: the sync round-trip itself is
+            # ~75 ms on the tunnel, far above a single pass
             t0 = time.perf_counter()
+            out = None
             for _ in range(reps):
-                fn(binned, grad, hess, mask).block_until_ready()
+                out = fn(binned, grad, hess, mask)
+            _sync(out)
             timings[method] = (time.perf_counter() - t0) / reps
         except Exception:       # a variant may not lower on this backend
             continue
@@ -403,6 +424,31 @@ def segment_histogram(
     return hist.reshape(S + 1, F, B, 3)[:S]
 
 
+def pack_rows_u32(binned: jax.Array, grad: jax.Array, hess: jax.Array,
+                  weights: jax.Array):
+    """Fuse a u8 binned matrix and the (g, h, 1)*w value triple into ONE
+    u32 word-matrix [n, ceil(F/4) + 3].
+
+    Motivation (tpu_probe_r5.json): XLA gather cost on this backend scales
+    with gathered ELEMENT count — a [11M, 28] u8 row gather is ~124 ms.
+    Packing 4 bins per u32 word and fusing the three f32 value columns
+    into the same row record turns the arena's four gathers into one with
+    ~3x fewer elements.  Returns (words, Wb) with Wb = bin words.
+    """
+    n, F = binned.shape
+    if binned.dtype != jnp.uint8:
+        return None, 0          # u16 bins (max_bin > 256): no packing
+    Wb = (F + 3) // 4
+    pad = Wb * 4 - F
+    b = jnp.pad(binned, ((0, 0), (0, pad))) if pad else binned
+    bin_words = lax.bitcast_convert_type(
+        b.reshape(n, Wb, 4), jnp.uint32).reshape(n, Wb)
+    vals = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1) \
+        * weights[:, None]                              # [n, 3] f32
+    val_words = lax.bitcast_convert_type(vals, jnp.uint32)
+    return jnp.concatenate([bin_words, val_words], axis=1), Wb
+
+
 def segment_histogram_sorted(
     binned: jax.Array,       # [n, F] uint8/16
     grad: jax.Array,         # [n]
@@ -414,6 +460,8 @@ def segment_histogram_sorted(
     block_rows: int = 1024,
     f32_vals: bool = False,
     caps: Optional[list] = None,   # static descending arena capacities
+    packed: Optional[tuple] = None,   # (words [n, Wb+3] u32, Wb) from
+                                      # pack_rows_u32 — hoisted per tree
 ) -> jax.Array:
     """TPU-native segment histogram: sort-by-slot + block-aligned matmuls.
 
@@ -492,12 +540,24 @@ def segment_histogram_sorted(
             src_sorted = jnp.minimum(row_start[s_c] + o, n - 1)
             src = order[src_sorted]
 
-            rows = jnp.take(binned, src, axis=0).reshape(NB, C, F)
-            w = jnp.where(valid, jnp.take(weights, src), 0.0)
-            g = jnp.take(grad, src)
-            h = jnp.take(hess, src)
-            vals = (jnp.stack([g, h, jnp.ones_like(g)], axis=1)
-                    * w[:, None]).reshape(NB, C, 3)
+            if packed is not None and packed[0] is not None:
+                # ONE fused word gather (~3x fewer elements; see
+                # pack_rows_u32) then bitcast the record back apart
+                words, Wb = packed
+                rec = jnp.take(words, src, axis=0)      # [NBC, Wb+3] u32
+                bins8 = lax.bitcast_convert_type(
+                    rec[:, :Wb], jnp.uint8).reshape(NB * C, Wb * 4)
+                rows = bins8[:, :F].reshape(NB, C, F)
+                vals = lax.bitcast_convert_type(rec[:, Wb:], jnp.float32)
+                vals = jnp.where(valid[:, None], vals, 0.0).reshape(
+                    NB, C, 3)
+            else:
+                rows = jnp.take(binned, src, axis=0).reshape(NB, C, F)
+                w = jnp.where(valid, jnp.take(weights, src), 0.0)
+                g = jnp.take(grad, src)
+                h = jnp.take(hess, src)
+                vals = (jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+                        * w[:, None]).reshape(NB, C, 3)
 
             def body(_, blk):
                 b, v = blk
@@ -537,6 +597,7 @@ def compacted_segment_histogram(
     caps: list,              # static descending capacities
     f32_vals: bool = False,
     num_live: Optional[jax.Array] = None,   # traced count of live slots
+    packed: Optional[tuple] = None,         # pack_rows_u32 output, hoisted
 ) -> jax.Array:
     """Segment histogram over only the rows with a real slot, with the
     work bounded by the smallest static capacity that fits (see
@@ -552,20 +613,15 @@ def compacted_segment_histogram(
     (tpu_probe_r5.json), so up to ``_SMALL_ROUND_SLOTS`` passes win.
     ``LGBM_TPU_SEGHIST=sorted|scatter`` overrides (testing hook).
     """
-    import os
     n, F = binned.shape
-    forced = os.environ.get("LGBM_TPU_SEGHIST")
-    use_sorted = (on_accelerator()
-                  if forced not in ("sorted", "scatter")
-                  else forced == "sorted")
-    if use_sorted:
+    if use_sorted_seghist():
         # zero-weight rows are dropped by reslotting (cheaper than compact)
         slot_w = jnp.where(weights > 0, slot, num_slots)
 
         def arena_path(_):
             return segment_histogram_sorted(
                 binned, grad, hess, weights, slot_w, num_slots, num_bins,
-                f32_vals=f32_vals, caps=caps)
+                f32_vals=f32_vals, caps=caps, packed=packed)
 
         if num_live is None or num_slots <= _SMALL_ROUND_SLOTS:
             return arena_path(None)

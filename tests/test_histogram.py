@@ -152,10 +152,14 @@ def test_segment_histogram_sorted_matches_scatter():
         w = jnp.asarray((rng.rand(n) > 0.3).astype(np.float32) * 1.5)
         slot = jnp.asarray(rng.randint(0, S + 1, n).astype(np.int32))
         ref = np.asarray(segment_histogram(binned, g, h, w, slot, S, B))
+        from lightgbm_tpu.ops.histogram import pack_rows_u32
+        packed = pack_rows_u32(binned, g, h, w)
         for caps in (None, capacity_schedule(n, min_cap=512)):
-            got = np.asarray(segment_histogram_sorted(
-                binned, g, h, w, slot, S, B, f32_vals=True, caps=caps))
-            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+            for pk in (None, packed):   # fused u32 record path too
+                got = np.asarray(segment_histogram_sorted(
+                    binned, g, h, w, slot, S, B, f32_vals=True, caps=caps,
+                    packed=pk))
+                np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
 
 def test_segment_histogram_sorted_all_dropped():
