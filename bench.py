@@ -326,6 +326,11 @@ def run_bench(n, trees, leaves, max_bin, tag=""):
         "metric": "None",
         "verbosity": -1,
     }
+    # measurement experiments: BENCH_EXTRA_PARAMS='{"tpu_tree_growth":
+    # "fast", ...}' merges into the training params
+    extra = os.environ.get("BENCH_EXTRA_PARAMS")
+    if extra:
+        params.update(json.loads(extra))
     train_set = lgb.Dataset(X, label=y, params=params)
     t_bin0 = time.perf_counter()
     train_set.construct()          # binning happens here, outside the clock
