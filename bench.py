@@ -279,8 +279,8 @@ def kernel_probe(n_rows=1_000_000, f=F, max_bin=MAX_BIN, reps=3):
     from lightgbm_tpu.ops import histogram as H
 
     rng = np.random.RandomState(0)
-    binned = jnp.asarray(rng.randint(0, max_bin, (n_rows, f), dtype=np.int64),
-                         jnp.uint8)
+    binned = jnp.asarray(rng.randint(0, max_bin, (f, n_rows), dtype=np.int64),
+                         jnp.uint8)          # feature-major [F, n]
     grad = jnp.asarray(rng.randn(n_rows), jnp.float32)
     hess = jnp.abs(grad) + 0.1
     mask = jnp.ones((n_rows,), jnp.float32)
@@ -316,8 +316,17 @@ def mfu_estimate(n, f, max_bin, leaves, sec_per_tree, peak):
     return flops_per_tree / max(sec_per_tree, 1e-9) / peak
 
 
-def run_bench(n, trees, leaves, max_bin, tag=""):
-    """Train in-process on whatever backend is active; return result dict."""
+def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
+              compile_done=None):
+    """Train in-process on whatever backend is active; return result dict.
+
+    ``cancel`` (threading.Event): checked right after the compile sync —
+    an abandoned hung-compile attempt (tools/tpu_measure.py guard_ladder)
+    whose compile eventually unblocks must NOT proceed to the timed run,
+    which would race the ladder's replacement attempt on the single-tenant
+    chip.  ``compile_done`` (threading.Event): set right after the compile
+    sync so the ladder's hung-compile patience can watch the COMPILE alone
+    (the timed run may legitimately exceed any compile patience)."""
     import jax
 
     import lightgbm_tpu as lgb
@@ -356,6 +365,11 @@ def run_bench(n, trees, leaves, max_bin, tag=""):
     booster.update()               # iteration 1: triggers XLA compile
     dsync(booster.boosting.train_score)
     compile_seconds = time.perf_counter() - t_c0
+    if compile_done is not None:
+        compile_done.set()
+    if cancel is not None and cancel.is_set():
+        return {"cancelled_after_compile": True,
+                "compile_seconds": round(compile_seconds, 2)}
 
     profile = os.environ.get("BENCH_PROFILE") == "1"
     if profile:

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset, FeatureMeta
-from ..ops.histogram import on_accelerator
+from ..ops.histogram import on_accelerator, take_from_table
 from ..grower import GrowerConfig, TreeArrays, grow_tree, predict_tree_binned
 from ..objectives import ObjectiveFunction
 from ..ops.renew import leaf_percentile
@@ -114,8 +114,12 @@ class GBDT:
                     )[self._row_perm]
                 else:
                     b = np.pad(src, ((0, n_pad - n), (0, 0)))
+                # feature-major device residency (ops/histogram.py LAYOUT
+                # DOCTRINE): minor dim n stays unpadded in the (8,128)/
+                # (32,128) tiles; [n, 28] u8 row-major would pad 4.6x
                 self.binned = jax.device_put(
-                    b, NamedSharding(self._mesh, P(self._data_axis, None)))
+                    np.ascontiguousarray(b.T),
+                    NamedSharding(self._mesh, P(None, self._data_axis)))
             else:
                 src = self.train_set.binned
                 if self._col_perm is not None:
@@ -126,9 +130,11 @@ class GBDT:
                 else:
                     b = np.pad(src, ((0, 0), (0, self._f_pad - F)))
                 self.binned = jax.device_put(
-                    b, NamedSharding(self._mesh, P(None, self._feature_axis)))
+                    np.ascontiguousarray(b.T),
+                    NamedSharding(self._mesh, P(self._feature_axis, None)))
         else:
-            self.binned = jnp.asarray(self.train_set.binned)
+            self.binned = jnp.asarray(
+                np.ascontiguousarray(self.train_set.binned.T))
         self._row_valid = jnp.asarray(self._pad_rows_np(np.ones(n, np.float32)))
         if objective is not None:
             objective.init(self.train_set.metadata, self.num_data)
@@ -415,7 +421,8 @@ class GBDT:
         valid_set.construct()
         self.valid_sets.append(valid_set)
         self.valid_names.append(name)
-        self.valid_binned.append(jnp.asarray(valid_set.binned))
+        self.valid_binned.append(jnp.asarray(
+            np.ascontiguousarray(valid_set.binned.T)))
         K = self.num_tree_per_iteration
         vs = jnp.zeros((K, valid_set.num_data), jnp.float32)
         if valid_set.metadata.init_score is not None:
@@ -706,7 +713,8 @@ class GBDT:
                     leaf_value=tree.leaf_value * lr,
                     internal_value=tree.internal_value * lr,
                 )
-                new_score = new_score.at[k].add(tree.leaf_value[leaf_id])
+                new_score = new_score.at[k].add(
+                    take_from_table(tree.leaf_value, leaf_id))
                 trees.append(tree)
                 leaf_ids.append(leaf_id)
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
@@ -730,7 +738,7 @@ class GBDT:
             if (not cegb_on and forced_plan is None
                     and not (use_renew and rf_const_init)):
                 cache_key = (
-                    "one_iter", K, n_pad, self.binned.shape[1],
+                    "one_iter", K, n_pad, self.binned.shape,
                     str(self.binned.dtype), cfg, use_rounds, use_renew,
                     renew_pct, obj is None, mc is None,
                     mr.has_bundles, int(mr.max_group_bin),
@@ -770,7 +778,7 @@ class GBDT:
             rows_spec = krow if (cegb_on and cfg.cegb_lazy) else P()
             sharded = jax.shard_map(
                 core, mesh=self._mesh,
-                in_specs=(P(ax_d, ax_f), krow, row, krow, krow, P(), P(),
+                in_specs=(P(ax_f, ax_d), krow, row, krow, krow, P(), P(),
                           P(), row, row, P(), rows_spec),
                 out_specs=(krow, P(), krow, P(), rows_spec),
                 check_vma=False)
@@ -1219,8 +1227,8 @@ class GBDT:
             return out * jnp.float32(scale) if scale != 1.0 else out
         p = self.models[model_idx].predict_binned_np(
             dataset.binned, dataset.feat_group, dataset.feat_start)
-        if binned.shape[0] > len(p):
-            p = np.pad(p, (0, binned.shape[0] - len(p)))
+        if binned.shape[1] > len(p):
+            p = np.pad(p, (0, binned.shape[1] - len(p)))
         return jnp.asarray(p, jnp.float32)
 
     def rollback_one_iter(self) -> None:

@@ -35,7 +35,7 @@ from jax import lax
 
 from .dataset import FeatureMeta
 from .ops.histogram import (build_histogram, capacity_schedule,
-                            compacted_histogram)
+                            compacted_histogram, take_from_table)
 from .ops.split import (K_EPSILON, MAX_CAT_WORDS, PerFeatureBest,
                         SplitHyperparams, SplitResult, best_split_for_leaf,
                         feature_best_splits, leaf_gain, leaf_output)
@@ -254,7 +254,9 @@ def row_goes_left(col: jax.Array, node_thr: jax.Array, node_dl: jax.Array,
 
 
 def grow_tree(
-    binned: jax.Array,          # [n, F] uint8/16 (n, F possibly per-shard)
+    binned_t: jax.Array,        # [F, n] uint8/16 feature-major (F, n
+                                #   possibly per-shard; see ops/histogram.py
+                                #   LAYOUT DOCTRINE)
     grad: jax.Array,            # [n] f32
     hess: jax.Array,            # [n] f32
     row_mask: jax.Array,        # [n] f32 bagging/GOSS weights (0 = excluded)
@@ -304,7 +306,7 @@ def grow_tree(
     Both can be combined (2-D mesh).
     """
     meta = meta.resolved()
-    n, G = binned.shape
+    G, n = binned_t.shape
     L = cfg.num_leaves
     B = cfg.num_bins
     Bg = meta.max_group_bin if meta.has_bundles else B
@@ -388,7 +390,7 @@ def grow_tree(
         b_idx = jnp.arange(B, dtype=jnp.int32)
 
         def expand_hist(ghist, sg, sh, cnt):
-            """[G, Bg, 3] group histogram -> [F, B, 3] per-feature histogram.
+            """[3, G, Bg] group histogram -> [3, F, B] per-feature histogram.
 
             Feature bins b>=1 gather from merged bins feat_start+b-1; bin 0
             (the shared default) is reconstructed from the leaf totals
@@ -396,11 +398,11 @@ def grow_tree(
             """
             gather_bins = jnp.clip(feat_start[:, None] + b_idx[None, :] - 1,
                                    0, Bg - 1)                       # [F, B]
-            taken = ghist[feat_group[:, None], gather_bins]         # [F, B, 3]
+            taken = ghist[:, feat_group[:, None], gather_bins]      # [3, F, B]
             valid = (b_idx[None, :] >= 1) & (b_idx[None, :] < num_bin[:, None])
-            h = jnp.where(valid[:, :, None], taken, 0.0)
+            h = jnp.where(valid[None, :, :], taken, 0.0)
             totals = jnp.stack([sg, sh, cnt])                       # [3]
-            return h.at[:, 0, :].set(totals[None, :] - h.sum(axis=1))
+            return h.at[:, :, 0].set(totals[:, None] - h.sum(axis=2))
     else:
         def expand_hist(ghist, sg, sh, cnt):
             return ghist   # identity groups: group hist IS the feature hist
@@ -538,9 +540,9 @@ def grow_tree(
         hp_local = hp._replace(
             min_data_in_leaf=max(1, hp.min_data_in_leaf // ndev),
             min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / ndev)
-        loc = ghist_local[0].sum(axis=0)      # local (sg, sh, cnt): every
-        # row lands in exactly one bin of column 0, so its totals are the
-        # local leaf totals
+        loc = ghist_local[:, 0, :].sum(axis=1)   # local (sg, sh, cnt):
+        # every row lands in exactly one bin of group 0, so its totals are
+        # the local leaf totals
         hist_loc = expand_hist(ghist_local, loc[0], loc[1], loc[2])
         pf = feature_best_splits(
             hist_loc, loc[0], loc[1], loc[2], num_bin, missing_type,
@@ -561,8 +563,8 @@ def grow_tree(
         votes = jnp.full(F, -jnp.inf, jnp.float32).at[all_i].max(
             jnp.where(jnp.isfinite(all_g), all_g, -jnp.inf))
         _, elected = lax.top_k(votes, k)
-        sub = lax.psum(hist_loc[elected], axis_name)   # [k, B, 3]: the only
-        # O(bins) collective — k*B*3 words vs data-parallel's F*B*3
+        sub = lax.psum(hist_loc[:, elected], axis_name)  # [3, k, B]: the
+        # only O(bins) collective — k*B*3 words vs data-parallel's F*B*3
         r = best_split_for_leaf(
             sub, sg, sh, cnt, num_bin[elected], missing_type[elected],
             default_bin[elected], is_cat[elected], hp,
@@ -629,13 +631,13 @@ def grow_tree(
     # only elected features are ever psum'd (inside leaf_best_voting).
     # Scalars stay global either way.
     hist_sync = (lambda h: h) if voting else (lambda h: _psum(h, axis_name))
-    root_hist = hist_sync(hist_fn(binned, grad, hess, row_mask))
+    root_hist = hist_sync(hist_fn(binned_t, grad, hess, row_mask))
     root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
     root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
     root_cnt = _psum(jnp.sum(row_mask), axis_name)
 
     tree = TreeArrays.empty(L)
-    hist_cache = jnp.zeros((L, G, Bg, 3), jnp.float32).at[0].set(root_hist)
+    hist_cache = jnp.zeros((L, 3, G, Bg), jnp.float32).at[0].set(root_hist)
     leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
     leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
     leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
@@ -774,7 +776,7 @@ def grow_tree(
             else:
                 owns = jnp.bool_(True)
                 lf = feat
-            hist_f = expand_hist(h_leaf, sg, sh, cnt)[lf]   # [B, 3]
+            hist_f = expand_hist(h_leaf, sg, sh, cnt)[:, lf]  # [3, B]
             b = jnp.arange(B, dtype=jnp.int32)
             nb = num_bin[lf]
             mt = missing_type[lf]
@@ -796,7 +798,7 @@ def grow_tree(
                 sel_num = valid & ((b <= thr) | (b == miss_bin))
             sel_cat = valid & (b == thr)   # one-hot categorical forced split
             sel = jnp.where(cat, sel_cat, sel_num)
-            lsum = jnp.sum(jnp.where(sel[:, None], hist_f, 0.0), axis=0)
+            lsum = jnp.sum(jnp.where(sel[None, :], hist_f, 0.0), axis=1)
             if feature_axis_name is not None:
                 # owner shard broadcasts its numbers (and the categorical
                 # flag, which downstream bitset/default_left logic needs)
@@ -891,7 +893,7 @@ def grow_tree(
             local_f = feat - f_offset
             owned = (local_f >= 0) & (local_f < F)
             lf = jnp.clip(local_f, 0, F - 1)
-            col_l = jnp.take(binned, feat_group[lf], axis=1).astype(jnp.int32)
+            col_l = jnp.take(binned_t, feat_group[lf], axis=0).astype(jnp.int32)
             dec_l = col_l - feat_start[lf] + 1
             binf_l = jnp.where((dec_l >= 1) & (dec_l < num_bin[lf]), dec_l, 0)
             gl_local = row_goes_left(binf_l, thr, dl, ncat, nbits,
@@ -904,7 +906,7 @@ def grow_tree(
             # decode the feature's bin from its (possibly bundled) column
             g = feat_group[feat]
             st = feat_start[feat]
-            col = jnp.take(binned, g, axis=1).astype(jnp.int32)
+            col = jnp.take(binned_t, g, axis=0).astype(jnp.int32)
             dec = col - st + 1
             binf = jnp.where((dec >= 1) & (dec < num_bin[feat]), dec, 0)
             goes_left = row_goes_left(binf, thr, dl, ncat, nbits,
@@ -936,11 +938,12 @@ def grow_tree(
         small_member = leaf_id == small_leaf
         if cfg.compact and len(caps) > 1:
             small_hist = hist_sync(
-                compacted_histogram(binned, grad, hess, row_mask, small_member,
-                                    B, caps, method=cfg.hist_method))
+                compacted_histogram(binned_t, grad, hess, row_mask,
+                                    small_member, Bg, caps,
+                                    method=cfg.hist_method))
         else:
             small_hist = hist_sync(
-                hist_fn(binned, grad, hess, row_mask * small_member))
+                hist_fn(binned_t, grad, hess, row_mask * small_member))
         large_hist = parent_hist - small_hist
         hist_l = jnp.where(left_smaller, small_hist, large_hist)
         hist_r = jnp.where(left_smaller, large_hist, small_hist)
@@ -1071,10 +1074,11 @@ def grow_tree(
     return tree, out.leaf_id
 
 
-def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
+def predict_leaf_index_binned(tree: TreeArrays, binned_t: jax.Array,
                               meta: FeatureMeta,
                               meta_arrays: Optional[tuple] = None) -> jax.Array:
-    """Route binned rows to leaf indices by iterative traversal.
+    """Route binned rows ([F, n] feature-major) to leaf indices by
+    iterative traversal.
 
     reference: Tree::Predict inline traversal (include/LightGBM/tree.h:190).
     Vectorized: all rows advance one level per iteration; done when every
@@ -1082,7 +1086,7 @@ def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
     tuple as grow_tree's) makes the bin layout a runtime input so one
     compiled traversal serves every same-shaped dataset.
     """
-    n = binned.shape[0]
+    n = binned_t.shape[1]
     if meta_arrays is not None:
         (num_bin, missing_type, default_bin, _is_cat,
          feat_group, feat_start) = meta_arrays
@@ -1103,7 +1107,7 @@ def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
         node, it = state
         nd = jnp.maximum(node, 0)
         feat = tree.split_feature[nd]
-        col = binned[jnp.arange(n), feat_group[feat]].astype(jnp.int32)
+        col = binned_t[feat_group[feat], jnp.arange(n)].astype(jnp.int32)
         dec = col - feat_start[feat] + 1
         binf = jnp.where((dec >= 1) & (dec < num_bin[feat]), dec, 0)
         gl = row_goes_left(binf, tree.threshold_bin[nd], tree.default_left[nd],
@@ -1120,8 +1124,8 @@ def predict_leaf_index_binned(tree: TreeArrays, binned: jax.Array,
     return ~node  # leaf index
 
 
-def predict_tree_binned(tree: TreeArrays, binned: jax.Array,
+def predict_tree_binned(tree: TreeArrays, binned_t: jax.Array,
                         meta: FeatureMeta,
                         meta_arrays: Optional[tuple] = None) -> jax.Array:
-    leaf = predict_leaf_index_binned(tree, binned, meta, meta_arrays)
-    return tree.leaf_value[leaf]
+    leaf = predict_leaf_index_binned(tree, binned_t, meta, meta_arrays)
+    return take_from_table(tree.leaf_value, leaf)

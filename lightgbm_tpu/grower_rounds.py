@@ -41,6 +41,7 @@ serial grower (GBDT dispatches automatically; see _build_jit_fns).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -50,7 +51,7 @@ from jax import lax
 from .dataset import FeatureMeta
 from .grower import GrowerConfig, TreeArrays, _LeafBest, _psum, row_goes_left
 from .ops.histogram import (build_histogram, capacity_schedule,
-                            compacted_segment_histogram, pack_rows_u32,
+                            compacted_segment_histogram, pack_cols_u32,
                             resolve_hist_method, use_sorted_seghist)
 from .ops.split import (MAX_CAT_WORDS, SplitResult, best_split_for_leaf,
                         leaf_output)
@@ -67,7 +68,8 @@ def _pad_scatter(arr: jax.Array, idx: jax.Array, val: jax.Array,
 
 
 def grow_tree_rounds(
-    binned: jax.Array,          # [n, G] uint8/16 (rows possibly per-shard)
+    binned_t: jax.Array,        # [G, n] uint8/16 feature-major (rows
+                                #   possibly per-shard)
     grad: jax.Array,            # [n] f32
     hess: jax.Array,            # [n] f32
     row_mask: jax.Array,        # [n] f32 bagging/GOSS weights (0 = excluded)
@@ -85,7 +87,7 @@ def grow_tree_rounds(
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32)."""
     meta = meta.resolved()
-    n, G = binned.shape
+    G, n = binned_t.shape
     L = cfg.num_leaves
     Lm1 = max(L - 1, 1)
     B = cfg.num_bins
@@ -108,13 +110,14 @@ def grow_tree_rounds(
     hist_fn = functools.partial(build_histogram, num_bins=Bg,
                                 method=cfg.hist_method)
     caps = capacity_schedule(n) if cfg.compact else [n]
-    # feature-major copy for the candidate scan: one transpose per tree
-    # (streams at HBM rate) buys contiguous per-candidate column reads
-    binned_t = binned.T                                 # [G, n]
-    # fused u32 row records for the arena's single gather (sorted-path
-    # only: gather cost scales with element count — pack_rows_u32)
-    packed = (pack_rows_u32(binned, grad, hess, row_mask)
-              if use_sorted_seghist() else None)
+    # fused u32 column records for the arena's single gather (sorted-path
+    # only: gather cost scales with element count — pack_cols_u32).
+    # LGBM_TPU_PACK=0 falls back to the four separate gathers
+    # (compile-cost bisect hook)
+    use_pack = (use_sorted_seghist()
+                and os.environ.get("LGBM_TPU_PACK") != "0")
+    packed = (pack_cols_u32(binned_t, grad, hess, row_mask)
+              if use_pack else None)
     # segment-histogram precision follows the resolved histogram method so
     # parent - smaller-child subtraction stays consistent: only the bf16
     # one-hot matmul is inexact; every other kernel accumulates f32-exact
@@ -124,15 +127,15 @@ def grow_tree_rounds(
         b_idx = jnp.arange(B, dtype=jnp.int32)
 
         def expand_hist(ghist, sg, sh, cnt):
-            """[G, Bg, 3] group hist -> [F, B, 3] (FixHistogram bin-0
+            """[3, G, Bg] group hist -> [3, F, B] (FixHistogram bin-0
             reconstruction; see grower.py)."""
             gather_bins = jnp.clip(feat_start[:, None] + b_idx[None, :] - 1,
                                    0, Bg - 1)
-            taken = ghist[feat_group[:, None], gather_bins]
+            taken = ghist[:, feat_group[:, None], gather_bins]
             valid = (b_idx[None, :] >= 1) & (b_idx[None, :] < num_bin[:, None])
-            h = jnp.where(valid[:, :, None], taken, 0.0)
+            h = jnp.where(valid[None, :, :], taken, 0.0)
             totals = jnp.stack([sg, sh, cnt])
-            return h.at[:, 0, :].set(totals[None, :] - h.sum(axis=1))
+            return h.at[:, :, 0].set(totals[:, None] - h.sum(axis=2))
     else:
         def expand_hist(ghist, sg, sh, cnt):
             return ghist
@@ -193,13 +196,13 @@ def grow_tree_rounds(
             is_categorical=sr.is_categorical, cat_bitset=sr.cat_bitset)
 
     # ---- root ----------------------------------------------------------
-    root_hist = _psum(hist_fn(binned, grad, hess, row_mask), axis_name)
+    root_hist = _psum(hist_fn(binned_t, grad, hess, row_mask), axis_name)
     root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
     root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
     root_cnt = _psum(jnp.sum(row_mask), axis_name)
 
     tree = TreeArrays.empty(L)
-    hist_cache = jnp.zeros((L, G, Bg, 3), jnp.float32).at[0].set(root_hist)
+    hist_cache = jnp.zeros((L, 3, G, Bg), jnp.float32).at[0].set(root_hist)
     leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
     leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
     leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
@@ -303,7 +306,7 @@ def grow_tree_rounds(
 
         # -- histograms: seg holds the SMALLER child of each selected leaf
         small_left = lc <= rc
-        small = seg[jnp.clip(rank, 0, KCAP - 1)]       # [L, G, Bg, 3]
+        small = seg[jnp.clip(rank, 0, KCAP - 1)]       # [L, 3, G, Bg]
         hist_left = jnp.where(small_left[:, None, None, None],
                               small, c.hist - small)
         hist_right = c.hist - hist_left
@@ -408,14 +411,14 @@ def grow_tree_rounds(
         small_left = b.left_count <= b.right_count
         slot = jnp.where(row_small, crank, KCAP)
         seg = _psum(compacted_segment_histogram(
-            binned, grad, hess, row_mask, slot, KCAP, Bg, caps,
+            binned_t, grad, hess, row_mask, slot, KCAP, Bg, caps,
             f32_vals=seg_f32, num_live=k, packed=packed), axis_name)
 
         # -- candidate children's best splits, BEFORE committing anything:
         # per-leaf candidates are independent, so lane i's results are
         # valid under any commit that includes candidate i.  Left children
         # keep the parent's leaf slot; stats come from the cache.
-        ph = c.hist[idl]                                # [K, G, Bg, 3]
+        ph = c.hist[idl]                                # [K, 3, G, Bg]
         sl = small_left[idl][:, None, None, None]
         h_left = jnp.where(sl, seg, ph - seg)
         h_right = ph - h_left

@@ -7,12 +7,23 @@ src/treelearner/ocl/histogram256.cl:317 — GPU atomic scatter).
 Design inversion for the MXU: instead of scatter-add (random-access, serializes
 on TPU), the histogram is a **one-hot matmul**: for a block of rows build the
 0/1 matrix ``onehot[C, F*B]`` (row r has a 1 at column f*B + bin(r, f)) in
-bfloat16 (exact for 0/1) and compute ``vals.T @ onehot`` with
-``vals = mask * [grad, hess, 1]`` — a [4, C] x [C, F*B] matmul accumulated in
+bfloat16 (exact for 0/1) and compute ``vals @ onehot`` with
+``vals = mask * [grad, hess, 1]`` — a [3, C] x [C, F*B] matmul accumulated in
 float32 over row blocks.  This keeps the hot loop on the systolic array at
 ~100% HBM streaming rate instead of scalar scatter.  Leaf membership is folded
 into ``mask``, which replaces the reference's ordered-gradient gather
 (src/io/dataset.cpp:1318-1333) with a branch-free masked pass.
+
+LAYOUT DOCTRINE (round 5, measured): TPU tiles the two minor-most dims to
+(8, 128) — f32 [n, 3] pads 42x, u8 [n, 28] pads 4.6x, u32 [n, 13] pads 10x
+(the OOM at 11M rows was exactly a lane-padded [n*F, 3]).  Therefore:
+
+- the binned matrix lives on device FEATURE-MAJOR: ``binned_t`` [F, n]
+  (minor dim n — unpadded), and every kernel here consumes that layout;
+- histograms are ``[3, F, B]`` / ``[S, 3, F, B]`` with the tiny component
+  axis LEADING (minor dims (F, B) pad ~2x instead of 128/3 = 42x);
+- per-row values ride as separate [n] vectors or [3, n] / [W, n] blocks,
+  never as [n, small] matrices.
 
 A scatter-based variant is kept for CPU testing / tiny inputs; `auto` probes
 are selected at trace time by platform.
@@ -21,6 +32,7 @@ are selected at trace time by platform.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -42,8 +54,7 @@ def on_accelerator() -> bool:
 def use_sorted_seghist() -> bool:
     """Whether the segment histogram takes the sorted-arena path (ONE
     shared predicate for the kernel dispatch and the grower's decision to
-    pre-pack row records).  LGBM_TPU_SEGHIST=sorted|scatter overrides."""
-    import os
+    pre-pack column records).  LGBM_TPU_SEGHIST=sorted|scatter overrides."""
     forced = os.environ.get("LGBM_TPU_SEGHIST")
     if forced in ("sorted", "scatter"):
         return forced == "sorted"
@@ -66,71 +77,57 @@ def _pad_rows(n: int, block: int) -> int:
     return (n + block - 1) // block * block
 
 
+def _vals_t(grad, hess, mask):
+    """[3, n] f32 value block (g, h, 1) * mask — minor dim n, unpadded."""
+    return jnp.stack([grad, hess, jnp.ones_like(grad)]) * mask[None, :]
+
+
 def histogram_matmul(
-    binned: jax.Array,   # [n, F] uint8/uint16/int32
-    vals: jax.Array,     # [n, 3] f32 rows already masked: (g, h, 1)*mask
-    num_bins: int,       # padded bin axis B (static)
+    binned_t: jax.Array,  # [F, n] uint8/uint16/int32 (feature-major)
+    vals_t: jax.Array,    # [3, n] f32 rows already masked: (g, h, 1)*mask
+    num_bins: int,        # padded bin axis B (static)
     block_rows: int = _DEFAULT_BLOCK_ROWS,
+    onehot_dtype=jnp.bfloat16,
 ) -> jax.Array:
-    """Histogram via one-hot matmul over row blocks. Returns [F, B, 3] f32."""
-    n, F = binned.shape
+    """Histogram via one-hot matmul over row blocks. Returns [3, F, B] f32."""
+    F, n = binned_t.shape
     B = num_bins
     nb = max(1, _pad_rows(n, block_rows) // block_rows)
     n_pad = nb * block_rows
     if n_pad != n:
-        binned = jnp.pad(binned, ((0, n_pad - n), (0, 0)))
-        vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
-    binned_blocks = binned.reshape(nb, block_rows, F)
-    vals_blocks = vals.reshape(nb, block_rows, 3)
-    iota = jnp.arange(B, dtype=binned.dtype)
+        binned_t = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+        vals_t = jnp.pad(vals_t, ((0, 0), (0, n_pad - n)))
+    iota = jnp.arange(B, dtype=binned_t.dtype)
+    C = block_rows
+    prec = (lax.Precision.HIGHEST if onehot_dtype == jnp.float32
+            else lax.Precision.DEFAULT)
 
-    def body(acc, blk):
-        b, v = blk
-        onehot = (b[:, :, None] == iota).astype(jnp.bfloat16)  # [C, F, B]
-        onehot2d = onehot.reshape(block_rows, F * B)
-        # [3, C] @ [C, F*B] -> [3, F*B], f32 accumulate
-        part = jax.lax.dot(
-            v.astype(jnp.bfloat16).T, onehot2d,
-            precision=lax.Precision.DEFAULT,
-            preferred_element_type=jnp.float32,
-        )
+    def body(acc, i):
+        b = lax.dynamic_slice(binned_t, (0, i * C), (F, C))   # [F, C]
+        v = lax.dynamic_slice(vals_t, (0, i * C), (3, C))     # [3, C]
+        onehot = (b.T[:, :, None] == iota).astype(onehot_dtype)
+        onehot2d = onehot.reshape(C, F * B)
+        part = lax.dot(v.astype(onehot_dtype), onehot2d, precision=prec,
+                       preferred_element_type=jnp.float32)
         return acc + part, None
 
     init = jnp.zeros((3, F * B), dtype=jnp.float32)
-    acc, _ = lax.scan(body, init, (binned_blocks, vals_blocks))
-    return acc.reshape(3, F, B).transpose(1, 2, 0)
+    acc, _ = lax.scan(body, init, jnp.arange(nb, dtype=jnp.int32))
+    return acc.reshape(3, F, B)
 
 
 def histogram_matmul_f32(
-    binned: jax.Array, vals: jax.Array, num_bins: int,
+    binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
     block_rows: int = _DEFAULT_BLOCK_ROWS,
 ) -> jax.Array:
     """Like histogram_matmul but f32 one-hot (exact grads; ~2x slower MXU)."""
-    n, F = binned.shape
-    B = num_bins
-    nb = max(1, _pad_rows(n, block_rows) // block_rows)
-    n_pad = nb * block_rows
-    if n_pad != n:
-        binned = jnp.pad(binned, ((0, n_pad - n), (0, 0)))
-        vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
-    binned_blocks = binned.reshape(nb, block_rows, F)
-    vals_blocks = vals.reshape(nb, block_rows, 3)
-    iota = jnp.arange(B, dtype=binned.dtype)
-
-    def body(acc, blk):
-        b, v = blk
-        onehot = (b[:, :, None] == iota).astype(jnp.float32).reshape(block_rows, F * B)
-        part = jax.lax.dot(v.T, onehot, preferred_element_type=jnp.float32)
-        return acc + part, None
-
-    init = jnp.zeros((3, F * B), dtype=jnp.float32)
-    acc, _ = lax.scan(body, init, (binned_blocks, vals_blocks))
-    return acc.reshape(3, F, B).transpose(1, 2, 0)
+    return histogram_matmul(binned_t, vals_t, num_bins, block_rows,
+                            onehot_dtype=jnp.float32)
 
 
 def histogram_pallas(
-    binned: jax.Array,   # [n, F] uint8/uint16
-    vals: jax.Array,     # [n, 3] f32 rows already masked: (g, h, 1)*mask
+    binned_t: jax.Array,  # [F, n] uint8/uint16 (feature-major)
+    vals_t: jax.Array,    # [3, n] f32 rows already masked: (g, h, 1)*mask
     num_bins: int,
     block_rows: int = 512,
     feat_tile: int = 8,
@@ -141,10 +138,10 @@ def histogram_pallas(
     Why not the MXU: the one-hot matmul formulation has M=3 output rows
     (grad/hess/count), so the 128x128 systolic array runs at <3% utilization
     AND materializes a [rows, F*B] one-hot intermediate in HBM.  This kernel
-    instead streams `binned` once (transposed, [F, n]) and does the
-    compare-select-accumulate on the VPU with the [F, B, 3] accumulator
+    instead streams `binned_t` once ([F, n] — its resident layout) and does
+    the compare-select-accumulate on the VPU with the [3, F, B] accumulator
     resident in VMEM across row blocks — HBM traffic is exactly one read of
-    the binned matrix + the vals vector per pass, the memory-optimal floor.
+    the binned matrix + the vals block per pass, the memory-optimal floor.
 
     reference analogue: dense_bin.hpp:97 ConstructHistogramInner (CPU
     scatter) / ocl/histogram256.cl:317 (GPU atomic scatter); this is the
@@ -154,7 +151,7 @@ def histogram_pallas(
     """
     from jax.experimental import pallas as pl
 
-    n, F = binned.shape
+    F, n = binned_t.shape
     B = num_bins
     C = block_rows
     Ft = min(feat_tile, F)
@@ -163,13 +160,13 @@ def histogram_pallas(
 
     n_pad = _pad_rows(n, C)
     F_pad = _pad_rows(F, Ft)
-    bt = binned.T                                       # [F, n], uint8/16 —
+    bt = binned_t
     # widened to i32 PER BLOCK inside the kernel so the HBM copy stays at
     # the narrow dtype (a .astype here would materialize a 4x intermediate)
     if n_pad != n or F_pad != F:
         # padded features get bin 0 with weight 0 (vals rows padded to 0)
         bt = jnp.pad(bt, ((0, F_pad - F), (0, n_pad - n)))
-    vt = vals.astype(jnp.float32).T                     # [3, n]
+    vt = vals_t.astype(jnp.float32)
     if n_pad != n:
         vt = jnp.pad(vt, ((0, 0), (0, n_pad - n)))
 
@@ -208,26 +205,29 @@ def histogram_pallas(
         out_shape=jax.ShapeDtypeStruct((F_pad, 3, B), jnp.float32),
         interpret=interpret,
     )(bt, vt)
-    return out[:F].transpose(0, 2, 1)                   # [F, B, 3]
+    return out[:F].transpose(1, 0, 2)                   # [3, F, B]
 
 
 def histogram_scatter(
-    binned: jax.Array, vals: jax.Array, num_bins: int,
+    binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
 ) -> jax.Array:
-    """Scatter-add histogram (XLA scatter). Reference semantics check path."""
-    n, F = binned.shape
+    """Scatter-add histogram (XLA scatter). Reference semantics check path
+    (CPU-oriented: the [n, F, 3] update buffer lane-pads on TPU)."""
+    F, n = binned_t.shape
     B = num_bins
+    binned = binned_t.T                                    # [n, F]
+    vals = vals_t.T                                        # [n, 3]
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
     flat_idx = binned.astype(jnp.int32) + offsets          # [n, F]
     hist = jnp.zeros((F * B, 3), dtype=jnp.float32)
     # vals broadcast across features: updates [n, F, 3]
     updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
     hist = hist.at[flat_idx.reshape(-1)].add(updates.reshape(-1, 3))
-    return hist.reshape(F, B, 3)
+    return hist.reshape(F, B, 3).transpose(2, 0, 1)        # [3, F, B]
 
 
 def build_histogram(
-    binned: jax.Array,
+    binned_t: jax.Array,   # [F, n] feature-major
     grad: jax.Array,
     hess: jax.Array,
     mask: jax.Array,
@@ -235,21 +235,21 @@ def build_histogram(
     method: str = "auto",
     block_rows: int = _DEFAULT_BLOCK_ROWS,
 ) -> jax.Array:
-    """Masked histogram [F, B, 3] = sum over rows with mask of (g, h, 1).
+    """Masked histogram [3, F, B] = sum over rows with mask of (g, h, 1).
 
     ``mask`` is f32 and may carry bagging weights; leaf membership is encoded
     by zeroing non-member rows.
     """
-    vals = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1) * mask[:, None]
+    vals_t = _vals_t(grad, hess, mask)
     method = resolve_hist_method(method)
     if method == "matmul":
-        return histogram_matmul(binned, vals, num_bins, block_rows)
+        return histogram_matmul(binned_t, vals_t, num_bins, block_rows)
     if method == "matmul_f32":
-        return histogram_matmul_f32(binned, vals, num_bins, block_rows)
+        return histogram_matmul_f32(binned_t, vals_t, num_bins, block_rows)
     if method == "scatter":
-        return histogram_scatter(binned, vals, num_bins)
+        return histogram_scatter(binned_t, vals_t, num_bins)
     if method == "pallas":
-        return histogram_pallas(binned, vals, num_bins)
+        return histogram_pallas(binned_t, vals_t, num_bins)
     raise ValueError(f"unknown histogram method {method!r}")
 
 
@@ -280,12 +280,13 @@ def measured_best_method(n: int, num_features: int, num_bins: int,
     import numpy as np
     rng = np.random.RandomState(0)
     host_dtype = np.uint8 if num_bins <= 256 else np.uint16
-    binned = jnp.asarray(rng.randint(0, max(num_bins - 1, 1),
-                                     (n_probe, num_features),
-                                     dtype=host_dtype))
+    binned_t = jnp.asarray(rng.randint(0, max(num_bins - 1, 1),
+                                       (num_features, n_probe),
+                                       dtype=host_dtype))
     grad = jnp.asarray(rng.randn(n_probe), jnp.float32)
     hess = jnp.abs(grad) + 0.1
     mask = jnp.ones((n_probe,), jnp.float32)
+
     def _sync(x):
         # block_until_ready is a NO-OP on the tunneled axon backend
         # (docs/PERFORMANCE.md round-5 correction); a device->host copy of
@@ -297,13 +298,13 @@ def measured_best_method(n: int, num_features: int, num_bins: int,
         fn = jax.jit(functools.partial(build_histogram, num_bins=num_bins,
                                        method=method))
         try:
-            _sync(fn(binned, grad, hess, mask))   # compile
+            _sync(fn(binned_t, grad, hess, mask))   # compile
             # pipeline all reps, sync once: the sync round-trip itself is
             # ~75 ms on the tunnel, far above a single pass
             t0 = time.perf_counter()
             out = None
             for _ in range(reps):
-                out = fn(binned, grad, hess, mask)
+                out = fn(binned_t, grad, hess, mask)
             _sync(out)
             timings[method] = (time.perf_counter() - t0) / reps
         except Exception:       # a variant may not lower on this backend
@@ -349,7 +350,7 @@ def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS) -> list:
 
 
 def compacted_histogram(
-    binned: jax.Array,       # [n, F]
+    binned_t: jax.Array,     # [F, n] feature-major
     grad: jax.Array,         # [n]
     hess: jax.Array,         # [n]
     weights: jax.Array,      # [n] f32 bagging/GOSS weights
@@ -362,9 +363,9 @@ def compacted_histogram(
 
     The member row-ids are compacted into the smallest static capacity that
     fits (lax.switch over precompiled bucket sizes); the histogram kernel
-    then runs over `cap` rows instead of n.  Returns [F, B, 3] f32.
+    then runs over `cap` rows instead of n.  Returns [3, F, B] f32.
     """
-    n, F = binned.shape
+    F, n = binned_t.shape
     # zero-weight rows (bagged-out / GOSS-dropped) contribute nothing, so
     # exclude them from compaction too — same result, tighter capacity
     member = member & (weights > 0)
@@ -375,15 +376,15 @@ def compacted_histogram(
             idx = jnp.nonzero(member, size=cap, fill_value=n)[0]
             valid = idx < n
             idxc = jnp.minimum(idx, n - 1)
-            rows = jnp.take(binned, idxc, axis=0)
+            cols = jnp.take(binned_t, idxc, axis=1)        # [F, cap]
             w = jnp.where(valid, jnp.take(weights, idxc), 0.0)
             g = jnp.take(grad, idxc)
             h = jnp.take(hess, idxc)
-            return build_histogram(rows, g, h, w, num_bins, method=method)
+            return build_histogram(cols, g, h, w, num_bins, method=method)
         return run
 
     if len(caps) == 1:
-        return build_histogram(binned, grad, hess,
+        return build_histogram(binned_t, grad, hess,
                                weights * member, num_bins, method=method)
     caps_arr = jnp.asarray(caps, jnp.int32)
     # smallest capacity >= count (caps[0] >= n covers everything)
@@ -392,7 +393,7 @@ def compacted_histogram(
 
 
 def segment_histogram(
-    binned: jax.Array,       # [n, F] uint8/16
+    binned_t: jax.Array,     # [F, n] feature-major
     grad: jax.Array,         # [n]
     hess: jax.Array,         # [n]
     weights: jax.Array,      # [n] f32 bagging/GOSS weights
@@ -400,7 +401,7 @@ def segment_histogram(
     num_slots: int,
     num_bins: int,
 ) -> jax.Array:
-    """Per-slot masked histogram: [S, F, B, 3] where row r contributes its
+    """Per-slot masked histogram: [S, 3, F, B] where row r contributes its
     (g, h, 1)*w to slot[r]'s histogram.  Rows with slot == num_slots are
     dropped (the dummy slot).
 
@@ -408,49 +409,104 @@ def segment_histogram(
     pass over the data builds the histograms of EVERY smaller child of a
     round's splits (reference equivalent: one ConstructHistograms call per
     leaf, serial_tree_learner.cpp:380-388 — here a whole frontier per call).
-    Scatter-add formulation: the work is O(n*F) independent of S, unlike a
-    one-hot matmul over (slot, bin) which would cost O(n*F*B*S).
+    Scatter-add formulation (CPU semantics-reference path): the work is
+    O(n*F) independent of S, unlike a one-hot matmul over (slot, bin) which
+    would cost O(n*F*B*S).
     """
-    n, F = binned.shape
+    F, n = binned_t.shape
     B = num_bins
     S = num_slots
-    vals = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1) * weights[:, None]
+    binned = binned_t.T
+    vals = _vals_t(grad, hess, weights).T                  # [n, 3]
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
     flat = (slot[:, None].astype(jnp.int32) * (F * B)
             + binned.astype(jnp.int32) + offsets)          # [n, F]
     hist = jnp.zeros(((S + 1) * F * B, 3), dtype=jnp.float32)
     updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
     hist = hist.at[flat.reshape(-1)].add(updates.reshape(-1, 3))
-    return hist.reshape(S + 1, F, B, 3)[:S]
+    return hist.reshape(S + 1, F, B, 3)[:S].transpose(0, 3, 1, 2)
 
 
-def pack_rows_u32(binned: jax.Array, grad: jax.Array, hess: jax.Array,
+def take_from_table(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """``table[idx]`` for a SMALL table and a huge ``idx`` vector.
+
+    On this TPU backend an [n]-sized gather from even a tiny table lowers
+    to serialized-gather territory (~130 ms at 11M rows, tpu_probe_r5);
+    reformulated as a one-hot matmul it rides the MXU instead.  The
+    one-hot has exactly one nonzero per row, so each output is a single
+    product — numerically EXACT in f32 under precision=HIGHEST (XLA's
+    bf16x3 expansion round-trips f32 multiplicands exactly; there is no
+    accumulation ordering to worry about).
+
+    ``table`` may be [L] or [L, k]; returns idx.shape (+ [k]) in
+    table.dtype.  Falls back to a plain gather off-accelerator or when
+    ``LGBM_TPU_TABLE_MATMUL=0``.
+    """
+    if (not on_accelerator()
+            or os.environ.get("LGBM_TPU_TABLE_MATMUL") == "0"
+            or not jnp.issubdtype(table.dtype, jnp.floating)):
+        return table[idx]
+    L = table.shape[0]
+    squeeze = table.ndim == 1
+    t2 = (table[:, None] if squeeze else table).astype(jnp.float32)
+    flat = idx.reshape(-1)
+    n = flat.shape[0]
+    iota_L = jnp.arange(L, dtype=flat.dtype)
+    # blocked like histogram_matmul's body: a single [n, L] f32 one-hot
+    # would materialize ~11 GB at the 11M-row x 255-leaf headline shape
+    # (dot operands are not producer-fused) — exactly the lane-padded-HBM
+    # class of failure this module's layout doctrine exists to avoid
+    k = t2.shape[1]
+    C = 65536
+    if n <= C:
+        # [k, L] @ [L, n] keeps every intermediate k-leading (minor dim n)
+        oh = (iota_L[:, None] == flat[None, :]).astype(jnp.float32)
+        out_t = lax.dot(t2.T, oh, precision=lax.Precision.HIGHEST)  # [k, n]
+    else:
+        nb = _pad_rows(n, C) // C
+        fpad = jnp.pad(flat, (0, nb * C - n), constant_values=-1)
+
+        def body(_, blk):
+            oh = (iota_L[:, None] == blk[None, :]).astype(jnp.float32)
+            return _, lax.dot(t2.T, oh,
+                              precision=lax.Precision.HIGHEST)   # [k, C]
+
+        _, chunks = lax.scan(body, None, fpad.reshape(nb, C))
+        out_t = jnp.moveaxis(chunks, 1, 0).reshape(k, nb * C)[:, :n]
+    out_t = out_t.astype(table.dtype)
+    if squeeze:
+        return out_t[0].reshape(idx.shape)
+    return out_t.T.reshape(idx.shape + (k,))
+
+
+def pack_cols_u32(binned_t: jax.Array, grad: jax.Array, hess: jax.Array,
                   weights: jax.Array):
-    """Fuse a u8 binned matrix and the (g, h, 1)*w value triple into ONE
-    u32 word-matrix [n, ceil(F/4) + 3].
+    """Fuse a u8 feature-major matrix and the (g, h, 1)*w value triple into
+    ONE u32 word-matrix [Wb + 3, n] (minor dim n — unpadded).
 
     Motivation (tpu_probe_r5.json): XLA gather cost on this backend scales
-    with gathered ELEMENT count — a [11M, 28] u8 row gather is ~124 ms.
-    Packing 4 bins per u32 word and fusing the three f32 value columns
-    into the same row record turns the arena's four gathers into one with
-    ~3x fewer elements.  Returns (words, Wb) with Wb = bin words.
+    with gathered ELEMENT count — packing 4 bins per u32 word and fusing
+    the three f32 value rows into the same record turns the arena's four
+    gathers into one with ~3x fewer elements.  Words are built
+    arithmetically (b0 | b1<<8 | ...) so no [.., 4]-minor bitcast
+    intermediate ever exists.  Returns (words_t, Wb) with Wb = bin words.
     """
-    n, F = binned.shape
-    if binned.dtype != jnp.uint8:
+    F, n = binned_t.shape
+    if binned_t.dtype != jnp.uint8:
         return None, 0          # u16 bins (max_bin > 256): no packing
     Wb = (F + 3) // 4
     pad = Wb * 4 - F
-    b = jnp.pad(binned, ((0, 0), (0, pad))) if pad else binned
-    bin_words = lax.bitcast_convert_type(
-        b.reshape(n, Wb, 4), jnp.uint32).reshape(n, Wb)
-    vals = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1) \
-        * weights[:, None]                              # [n, 3] f32
-    val_words = lax.bitcast_convert_type(vals, jnp.uint32)
-    return jnp.concatenate([bin_words, val_words], axis=1), Wb
+    bt = jnp.pad(binned_t, ((0, pad), (0, 0))) if pad else binned_t
+    b32 = bt.astype(jnp.uint32).reshape(Wb, 4, n)
+    bin_words = (b32[:, 0] | (b32[:, 1] << 8)
+                 | (b32[:, 2] << 16) | (b32[:, 3] << 24))   # [Wb, n]
+    vals_t = _vals_t(grad, hess, weights)                   # [3, n] f32
+    val_words = lax.bitcast_convert_type(vals_t, jnp.uint32)
+    return jnp.concatenate([bin_words, val_words], axis=0), Wb
 
 
 def segment_histogram_sorted(
-    binned: jax.Array,       # [n, F] uint8/16
+    binned_t: jax.Array,     # [F, n] uint8/16 feature-major
     grad: jax.Array,         # [n]
     hess: jax.Array,         # [n]
     weights: jax.Array,      # [n] f32 bagging/GOSS weights
@@ -460,8 +516,8 @@ def segment_histogram_sorted(
     block_rows: int = 1024,
     f32_vals: bool = False,
     caps: Optional[list] = None,   # static descending arena capacities
-    packed: Optional[tuple] = None,   # (words [n, Wb+3] u32, Wb) from
-                                      # pack_rows_u32 — hoisted per tree
+    packed: Optional[tuple] = None,   # (words_t [Wb+3, n] u32, Wb) from
+                                      # pack_cols_u32 — hoisted per tree
 ) -> jax.Array:
     """TPU-native segment histogram: sort-by-slot + block-aligned matmuls.
 
@@ -469,8 +525,9 @@ def segment_histogram_sorted(
     materializes an [n*F, 3] update buffer that XLA lane-pads to 128 (157 GB
     at HIGGS scale) — so here the problem is reshaped for the MXU instead:
 
-      1. stable-sort row ids by slot (small-range i32 keys; measured ~25 ms
-         at 11M rows — rows with the dummy slot sort last and are dropped);
+      1. sort row ids by slot via ONE u32 combined key
+         ``slot << 24 | row_id`` (stable by construction; falls back to a
+         two-array stable sort when n >= 2^24);
       2. per-slot counts/starts come free from the sorted keys via
          ``searchsorted`` (a scatter-free bincount);
       3. lay the sorted rows into a block-aligned arena where every slot's
@@ -481,32 +538,45 @@ def segment_histogram_sorted(
          s = blk_slot[j].  The arena size is the ladder's smallest static
          capacity that fits the slotted-row count (``lax.switch`` over
          ``caps``), so the gather+matmul cost tracks the live frontier,
-         not n;
+         not n.  All gathers run in the TRANSPOSED layout ([W, n] ->
+         [W, arena]: minor dim = arena, unpadded);
       4. one-hot matmul per block ([3, C] @ [C, F*B], the histogram_matmul
          body) producing per-block partials;
       5. reduce partials into slots with a tiny [S, NB] one-hot matmul
          (blocks of a slot are contiguous by construction).
 
     Every step is a gather, sort, or matmul — nothing scatters.  Returns
-    [S, F, B, 3] f32.  reference analogue: ordered-gradient per-leaf
+    [S, 3, F, B] f32.  reference analogue: ordered-gradient per-leaf
     histograms (src/io/dataset.cpp:1318-1333) built from a DataPartition
     that keeps leaves contiguous (src/treelearner/data_partition.hpp).
     """
-    n, F = binned.shape
+    F, n = binned_t.shape
     B = num_bins
     S = num_slots
     if caps is None:
         caps = [n]
 
-    row_ids = jnp.arange(n, dtype=jnp.int32)
-    sorted_slot, order = lax.sort((slot, row_ids), is_stable=True, num_keys=1)
+    if n < (1 << 24) and num_slots < 256:
+        # single-array sort: the combined UNSIGNED key carries the payload
+        # (u32 so slot values up to 255 — including the dummy num_slots —
+        # never touch the sign bit; an i32 key would wrap for slot >= 128
+        # and silently drop those slots' mass)
+        key = ((slot.astype(jnp.uint32) << 24)
+               | jnp.arange(n, dtype=jnp.uint32))
+        skey = lax.sort(key)
+        sorted_slot = (skey >> 24).astype(jnp.int32)
+        order = (skey & jnp.uint32(0x00FFFFFF)).astype(jnp.int32)
+    else:
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+        sorted_slot, order = lax.sort((slot, row_ids), is_stable=True,
+                                      num_keys=1)
     # counts without a scatter: positions of slot boundaries in sorted keys
     bounds = jnp.searchsorted(sorted_slot,
-                              jnp.arange(S + 1, dtype=slot.dtype))
+                              jnp.arange(S + 1, dtype=sorted_slot.dtype))
     row_start = bounds[:S].astype(jnp.int32)
     counts = (bounds[1:] - bounds[:S]).astype(jnp.int32)
 
-    iota = jnp.arange(B, dtype=binned.dtype)
+    iota = jnp.arange(B, dtype=binned_t.dtype)
     acc_t = jnp.float32 if f32_vals else jnp.bfloat16
     prec = lax.Precision.HIGHEST if f32_vals else lax.Precision.DEFAULT
 
@@ -540,39 +610,58 @@ def segment_histogram_sorted(
             src_sorted = jnp.minimum(row_start[s_c] + o, n - 1)
             src = order[src_sorted]
 
+            def block_partial(rows, vals):
+                """Shared per-block one-hot matmul: [F, C] bins x [3, C]
+                vals -> [3, F*B] partial (both gather branches feed this
+                one body so dtype/precision tweaks can never diverge)."""
+                onehot2d = (rows.T[:, :, None] == iota.astype(rows.dtype)
+                            ).astype(acc_t).reshape(C, F * B)
+                return lax.dot(vals.astype(acc_t), onehot2d,
+                               precision=prec,
+                               preferred_element_type=jnp.float32)
+
             if packed is not None and packed[0] is not None:
                 # ONE fused word gather (~3x fewer elements; see
-                # pack_rows_u32) then bitcast the record back apart
-                words, Wb = packed
-                rec = jnp.take(words, src, axis=0)      # [NBC, Wb+3] u32
-                bins8 = lax.bitcast_convert_type(
-                    rec[:, :Wb], jnp.uint8).reshape(NB * C, Wb * 4)
-                rows = bins8[:, :F].reshape(NB, C, F)
-                vals = lax.bitcast_convert_type(rec[:, Wb:], jnp.float32)
-                vals = jnp.where(valid[:, None], vals, 0.0).reshape(
-                    NB, C, 3)
+                # pack_cols_u32) then split the record back apart
+                words_t, Wb = packed
+                rec = jnp.take(words_t, src, axis=1)    # [Wb+3, NBC] u32
+                recb = rec.reshape(Wb + 3, NB, C).transpose(1, 0, 2)
+                vmask = valid.reshape(NB, 1, C)
+
+                def body(_, xs):
+                    blk_rec, vm = xs
+                    bw = blk_rec[:Wb]                   # [Wb, C] u32
+                    rows = jnp.concatenate(
+                        [((bw >> (8 * j)) & 0xFF) for j in range(4)],
+                        axis=0).reshape(4, Wb, C).transpose(
+                            1, 0, 2).reshape(Wb * 4, C)[:F]   # [F, C]
+                    vals = lax.bitcast_convert_type(blk_rec[Wb:],
+                                                    jnp.float32)
+                    vals = jnp.where(vm, vals, 0.0)     # [3, C]
+                    return _, block_partial(rows.astype(jnp.int32), vals)
+
+                _, parts = lax.scan(body, None, (recb, vmask))
             else:
-                rows = jnp.take(binned, src, axis=0).reshape(NB, C, F)
+                cols = jnp.take(binned_t, src, axis=1)  # [F, NBC]
                 w = jnp.where(valid, jnp.take(weights, src), 0.0)
                 g = jnp.take(grad, src)
                 h = jnp.take(hess, src)
-                vals = (jnp.stack([g, h, jnp.ones_like(g)], axis=1)
-                        * w[:, None]).reshape(NB, C, 3)
+                vt = (jnp.stack([g, h, jnp.ones_like(g)]) * w[None, :])
+                colsb = cols.reshape(F, NB, C).transpose(1, 0, 2)
+                vtb = vt.reshape(3, NB, C).transpose(1, 0, 2)
 
-            def body(_, blk):
-                b, v = blk
-                onehot2d = (b[:, :, None] == iota).astype(acc_t).reshape(
-                    C, F * B)
-                part = lax.dot(v.astype(acc_t).T, onehot2d, precision=prec,
-                               preferred_element_type=jnp.float32)
-                return _, part
+                def body(_, xs):
+                    b, v = xs
+                    return _, block_partial(b, v)
 
-            _, parts = lax.scan(body, None, (rows, vals))   # [NB, 3, F*B]
+                _, parts = lax.scan(body, None, (colsb, vtb))
+
+            # [NB, 3, F*B] -> fold blocks into slots
             slot_onehot = (jnp.arange(S, dtype=jnp.int32)[:, None]
                            == blk_slot[None, :]).astype(jnp.float32)
             hist = lax.dot(slot_onehot, parts.reshape(NB, 3 * F * B),
                            precision=lax.Precision.HIGHEST)
-            return hist.reshape(S, 3, F, B).transpose(0, 2, 3, 1)
+            return hist.reshape(S, 3, F, B)
         return run
 
     if len(caps) == 1:
@@ -587,7 +676,7 @@ _SMALL_ROUND_SLOTS = 4
 
 
 def compacted_segment_histogram(
-    binned: jax.Array,       # [n, F]
+    binned_t: jax.Array,     # [F, n] feature-major
     grad: jax.Array,
     hess: jax.Array,
     weights: jax.Array,      # [n] f32
@@ -597,11 +686,11 @@ def compacted_segment_histogram(
     caps: list,              # static descending capacities
     f32_vals: bool = False,
     num_live: Optional[jax.Array] = None,   # traced count of live slots
-    packed: Optional[tuple] = None,         # pack_rows_u32 output, hoisted
+    packed: Optional[tuple] = None,         # pack_cols_u32 output, hoisted
 ) -> jax.Array:
     """Segment histogram over only the rows with a real slot, with the
     work bounded by the smallest static capacity that fits (see
-    ``compacted_histogram``).  Returns [S, F, B, 3] f32.
+    ``compacted_histogram``).  Returns [S, 3, F, B] f32.
 
     Backend dispatch: sorted block-matmul arena on accelerators (the
     scatter formulation both OOMs — its [n*F, 3] update buffer lane-pads
@@ -613,17 +702,21 @@ def compacted_segment_histogram(
     (tpu_probe_r5.json), so up to ``_SMALL_ROUND_SLOTS`` passes win.
     ``LGBM_TPU_SEGHIST=sorted|scatter`` overrides (testing hook).
     """
-    n, F = binned.shape
+    F, n = binned_t.shape
     if use_sorted_seghist():
         # zero-weight rows are dropped by reslotting (cheaper than compact)
         slot_w = jnp.where(weights > 0, slot, num_slots)
 
         def arena_path(_):
             return segment_histogram_sorted(
-                binned, grad, hess, weights, slot_w, num_slots, num_bins,
+                binned_t, grad, hess, weights, slot_w, num_slots, num_bins,
                 f32_vals=f32_vals, caps=caps, packed=packed)
 
-        if num_live is None or num_slots <= _SMALL_ROUND_SLOTS:
+        # LGBM_TPU_SMALL_ROUNDS=0 drops the small-round branch (and its
+        # lax.cond program duplication) — compile-cost bisect hook
+        small_enabled = os.environ.get("LGBM_TPU_SMALL_ROUNDS") != "0"
+        if num_live is None or num_slots <= _SMALL_ROUND_SLOTS \
+                or not small_enabled:
             return arena_path(None)
 
         method = "matmul" if not f32_vals else "matmul_f32"
@@ -632,15 +725,15 @@ def compacted_segment_histogram(
             def one(kk):
                 def live(_):
                     return build_histogram(
-                        binned, grad, hess,
+                        binned_t, grad, hess,
                         weights * (slot_w == kk), num_bins, method=method)
                 return lax.cond(
                     kk < num_live, live,
-                    lambda _: jnp.zeros((F, num_bins, 3), jnp.float32),
+                    lambda _: jnp.zeros((3, F, num_bins), jnp.float32),
                     None)
             small = lax.map(one, jnp.arange(_SMALL_ROUND_SLOTS,
                                             dtype=jnp.int32))
-            pad = jnp.zeros((num_slots - _SMALL_ROUND_SLOTS, F, num_bins, 3),
+            pad = jnp.zeros((num_slots - _SMALL_ROUND_SLOTS, 3, F, num_bins),
                             jnp.float32)
             return jnp.concatenate([small, pad], axis=0)
 
@@ -655,16 +748,16 @@ def compacted_segment_histogram(
             idx = jnp.nonzero(member, size=cap, fill_value=n)[0]
             valid = idx < n
             idxc = jnp.minimum(idx, n - 1)
-            rows = jnp.take(binned, idxc, axis=0)
+            cols = jnp.take(binned_t, idxc, axis=1)
             w = jnp.where(valid, jnp.take(weights, idxc), 0.0)
             g = jnp.take(grad, idxc)
             h = jnp.take(hess, idxc)
             s = jnp.where(valid, jnp.take(slot, idxc), num_slots)
-            return segment_histogram(rows, g, h, w, s, num_slots, num_bins)
+            return segment_histogram(cols, g, h, w, s, num_slots, num_bins)
         return run
 
     if len(caps) == 1:
-        return segment_histogram(binned, grad, hess, weights,
+        return segment_histogram(binned_t, grad, hess, weights,
                                  jnp.where(member, slot, num_slots),
                                  num_slots, num_bins)
     caps_arr = jnp.asarray(caps, jnp.int32)

@@ -119,7 +119,7 @@ class PerFeatureBest(NamedTuple):
 
 
 def feature_best_splits(
-    hist: jax.Array,            # [F, B, 3] (grad, hess, count)
+    hist: jax.Array,            # [3, F, B] (grad, hess, count leading)
     sum_grad: jax.Array,        # scalar: leaf totals
     sum_hess: jax.Array,
     num_data: jax.Array,        # scalar f32/i32: leaf row count
@@ -149,7 +149,7 @@ def feature_best_splits(
     [0, num_bin-2]; categorical: a random one-hot category / sorted-scan
     position) instead of the full scan.
     """
-    F, B, _ = hist.shape
+    _, F, B = hist.shape
     bins = jnp.arange(B, dtype=jnp.int32)
     use_rand = hp.extra_trees and extra_rand_u is not None
 
@@ -173,17 +173,18 @@ def feature_best_splits(
     is_missing_bin = bins[None, :] == miss_bin[:, None]             # [F, B]
     valid_bin = bins[None, :] < num_bin[:, None]                    # [F, B]
 
-    hist_nm = jnp.where((is_missing_bin | ~valid_bin)[:, :, None], 0.0, hist)
-    prefix = jnp.cumsum(hist_nm, axis=1)                            # [F, B, 3]
-    miss = jnp.where(is_missing_bin[:, :, None], hist, 0.0).sum(axis=1)  # [F, 3]
+    drop = (is_missing_bin | ~valid_bin)[None, :, :]
+    hist_nm = jnp.where(drop, 0.0, hist)
+    prefix = jnp.cumsum(hist_nm, axis=2)                            # [3, F, B]
+    miss = jnp.where(is_missing_bin[None, :, :], hist, 0.0).sum(axis=2)  # [3, F]
 
     total_g, total_h, _ = sum_grad, sum_hess + 2 * K_EPSILON, num_data
 
     def eval_dir(missing_left: jax.Array):
         # left sums at threshold t (non-missing bins <= t, missing by dir)
-        lg = prefix[:, :, 0] + jnp.where(missing_left, miss[:, 0:1], 0.0)
-        lh = prefix[:, :, 1] + jnp.where(missing_left, miss[:, 1:2], 0.0) + K_EPSILON
-        lc = prefix[:, :, 2] + jnp.where(missing_left, miss[:, 2:3], 0.0)
+        lg = prefix[0] + jnp.where(missing_left, miss[0][:, None], 0.0)
+        lh = prefix[1] + jnp.where(missing_left, miss[1][:, None], 0.0) + K_EPSILON
+        lc = prefix[2] + jnp.where(missing_left, miss[2][:, None], 0.0)
         rg = total_g - lg
         rh = total_h - lh
         rc = num_data - lc
@@ -373,9 +374,9 @@ def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin,
     max_cat_threshold categories on the smaller side; lambda_l2 += cat_l2.
     Returns per-feature (gain, n_left_cats, left sums, bitset of bins LEFT).
     """
-    F, B, _ = hist.shape
+    _, F, B = hist.shape
     l2 = hp.lambda_l2 + hp.cat_l2
-    g, h, c = hist[:, :, 0], hist[:, :, 1], hist[:, :, 2]
+    g, h, c = hist[0], hist[1], hist[2]
     total_g, total_h = sum_grad, sum_hess + 2 * K_EPSILON
     parent_gain = leaf_gain(sum_grad, total_h, hp.lambda_l1, l2)
     min_gain_shift = parent_gain + hp.min_gain_to_split

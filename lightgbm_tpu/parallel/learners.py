@@ -50,9 +50,9 @@ def make_sharded_grower(
     """Build a jitted sharded grow-tree callable.
 
     Inputs must be sharded/padded by the caller:
-      binned [n_pad, F_pad], grad/hess/row_mask [n_pad]
+      binned_t [F_pad, n_pad] (feature-major), grad/hess/row_mask [n_pad]
     (pad rows with row_mask = 0; pad features with trivial bins).
-    Returns fn(binned, grad, hess, row_mask) -> (TreeArrays, leaf_id).
+    Returns fn(binned_t, grad, hess, row_mask) -> (TreeArrays, leaf_id).
     """
     if feature_axis and meta.resolved().has_bundles \
             and cfg.num_feature_shards <= 1:
@@ -62,8 +62,8 @@ def make_sharded_grower(
             "engine (lgb.train with tree_learner=feature) or disable "
             "bundling for this standalone grower")
     row_spec = P(data_axis) if data_axis else P()
-    fspec = P(None, feature_axis) if feature_axis else P(None)
-    binned_spec = P(data_axis, feature_axis) if feature_axis else P(data_axis)
+    binned_spec = (P(feature_axis, data_axis) if feature_axis
+                   else P(None, data_axis))
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -71,9 +71,9 @@ def make_sharded_grower(
         out_specs=(P(), row_spec),
         check_vma=False,
     )
-    def sharded(binned, grad, hess, row_mask):
+    def sharded(binned_t, grad, hess, row_mask):
         out = grow_tree(
-            binned, grad, hess, row_mask, meta, cfg,
+            binned_t, grad, hess, row_mask, meta, cfg,
             axis_name=data_axis, feature_axis_name=feature_axis)
         # CEGB-enabled configs return (tree, leaf_id, cegb_state); this
         # standalone grower drops the cross-tree state (single-tree API)
@@ -84,13 +84,16 @@ def make_sharded_grower(
 
 def shard_dataset(mesh: Mesh, binned: np.ndarray, *row_arrays,
                   data_axis: str = DATA_AXIS):
-    """Pad rows to the data-axis size and place arrays on the mesh."""
+    """Pad rows to the data-axis size and place arrays on the mesh.
+
+    ``binned`` is the HOST row-major [n, F] matrix; the device copy is
+    feature-major [F, n_pad] (ops/histogram.py LAYOUT DOCTRINE)."""
     ndev = mesh.shape[data_axis]
     n = binned.shape[0]
     n_pad = pad_rows_to(n, ndev)
     out = []
-    b = np.pad(binned, ((0, n_pad - n), (0, 0)))
-    out.append(jax.device_put(b, NamedSharding(mesh, P(data_axis))))
+    b = np.ascontiguousarray(np.pad(binned, ((0, n_pad - n), (0, 0))).T)
+    out.append(jax.device_put(b, NamedSharding(mesh, P(None, data_axis))))
     for arr in row_arrays:
         a = np.pad(np.asarray(arr), (0, n_pad - n))
         out.append(jax.device_put(a, NamedSharding(mesh, P(data_axis))))

@@ -11,14 +11,15 @@ from lightgbm_tpu.ops.histogram import (build_histogram, histogram_matmul,
 
 
 def brute_force(binned, grad, hess, mask, B):
+    """[3, F, B] reference histogram from a row-major host matrix."""
     n, F = binned.shape
-    out = np.zeros((F, B, 3), np.float64)
+    out = np.zeros((3, F, B), np.float64)
     for i in range(n):
         for f in range(F):
             b = binned[i, f]
-            out[f, b, 0] += grad[i] * mask[i]
-            out[f, b, 1] += hess[i] * mask[i]
-            out[f, b, 2] += mask[i]
+            out[0, f, b] += grad[i] * mask[i]
+            out[1, f, b] += hess[i] * mask[i]
+            out[2, f, b] += mask[i]
     return out
 
 
@@ -32,7 +33,7 @@ def test_histogram_matches_brute_force(method):
     hess = rng.rand(n).astype(np.float32)
     mask = (rng.rand(n) < 0.7).astype(np.float32)
     expect = brute_force(binned, grad, hess, mask, B)
-    got = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    got = np.asarray(build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                                      jnp.asarray(hess), jnp.asarray(mask),
                                      B, method=method))
     np.testing.assert_allclose(got, expect, rtol=2e-2, atol=2e-2)
@@ -46,7 +47,7 @@ def test_histogram_scatter_exact():
     hess = np.ones(n, np.float32)
     mask = np.ones(n, np.float32)
     expect = brute_force(binned, grad, hess, mask, B)
-    got = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    got = np.asarray(build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                                      jnp.asarray(hess), jnp.asarray(mask),
                                      B, method="scatter"))
     np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
@@ -60,10 +61,10 @@ def test_matmul_block_boundary():
     grad = rng.randn(n).astype(np.float32)
     hess = np.ones(n, np.float32)
     mask = np.ones(n, np.float32)
-    a = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    a = np.asarray(build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                                    jnp.asarray(hess), jnp.asarray(mask),
                                    B, method="matmul", ))
-    b = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    b = np.asarray(build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                                    jnp.asarray(hess), jnp.asarray(mask),
                                    B, method="scatter"))
     np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
@@ -76,10 +77,10 @@ def test_subtraction_trick():
     grad = rng.randn(n).astype(np.float32)
     hess = np.ones(n, np.float32)
     left = (rng.rand(n) < 0.5).astype(np.float32)
-    full = build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    full = build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                            jnp.asarray(hess), jnp.ones(n, jnp.float32), B,
                            method="scatter")
-    hl = build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    hl = build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                          jnp.asarray(hess), jnp.asarray(left), B,
                          method="scatter")
     hr = np.asarray(full) - np.asarray(hl)
@@ -96,7 +97,7 @@ def test_compacted_histogram_matches_masked():
                                             compacted_histogram)
     rng = np.random.RandomState(42)
     n, F, B = 10_000, 6, 16
-    binned = jnp.asarray(rng.randint(0, B, size=(n, F)).astype(np.uint8))
+    binned = jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8))
     grad = jnp.asarray(rng.randn(n).astype(np.float32))
     hess = jnp.asarray(rng.rand(n).astype(np.float32))
     weights = jnp.asarray((rng.rand(n) < 0.8).astype(np.float32) * 1.5)
@@ -121,10 +122,10 @@ def test_pallas_matches_scatter_uneven_shapes():
     grad = rng.randn(n).astype(np.float32)
     hess = rng.rand(n).astype(np.float32)
     mask = (rng.rand(n) < 0.6).astype(np.float32)
-    ref = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    ref = np.asarray(build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                                      jnp.asarray(hess), jnp.asarray(mask),
                                      B, method="scatter"))
-    got = np.asarray(build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    got = np.asarray(build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                                      jnp.asarray(hess), jnp.asarray(mask),
                                      B, method="pallas"))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
@@ -146,14 +147,14 @@ def test_segment_histogram_sorted_matches_scatter():
     rng = np.random.RandomState(11)
     for n, F, S, B in [(10_000, 28, 128, 64), (5_000, 7, 16, 32),
                        (777, 3, 4, 8), (1000, 5, 1, 8)]:
-        binned = jnp.asarray(rng.randint(0, B - 1, (n, F)).astype(np.uint8))
+        binned = jnp.asarray(rng.randint(0, B - 1, (F, n)).astype(np.uint8))
         g = jnp.asarray(rng.randn(n).astype(np.float32))
         h = jnp.abs(g) + 0.1
         w = jnp.asarray((rng.rand(n) > 0.3).astype(np.float32) * 1.5)
         slot = jnp.asarray(rng.randint(0, S + 1, n).astype(np.int32))
         ref = np.asarray(segment_histogram(binned, g, h, w, slot, S, B))
-        from lightgbm_tpu.ops.histogram import pack_rows_u32
-        packed = pack_rows_u32(binned, g, h, w)
+        from lightgbm_tpu.ops.histogram import pack_cols_u32
+        packed = pack_cols_u32(binned, g, h, w)
         for caps in (None, capacity_schedule(n, min_cap=512)):
             for pk in (None, packed):   # fused u32 record path too
                 got = np.asarray(segment_histogram_sorted(
@@ -166,7 +167,7 @@ def test_segment_histogram_sorted_all_dropped():
     from lightgbm_tpu.ops.histogram import segment_histogram_sorted
     rng = np.random.RandomState(1)
     n = 1000
-    binned = jnp.asarray(rng.randint(0, 7, (n, 5)).astype(np.uint8))
+    binned = jnp.asarray(rng.randint(0, 7, (5, n)).astype(np.uint8))
     g = jnp.asarray(rng.randn(n).astype(np.float32))
     out = segment_histogram_sorted(binned, g, g + 2.0, jnp.ones(n), 
                                    jnp.full(n, 4, jnp.int32), 4, 8,
@@ -184,7 +185,7 @@ def test_segment_histogram_small_round_path(monkeypatch):
     monkeypatch.setenv("LGBM_TPU_SEGHIST", "sorted")
     rng = np.random.RandomState(5)
     n, F, S, B = 6_000, 9, 64, 32
-    binned = jnp.asarray(rng.randint(0, B - 1, (n, F)).astype(np.uint8))
+    binned = jnp.asarray(rng.randint(0, B - 1, (F, n)).astype(np.uint8))
     g = jnp.asarray(rng.randn(n).astype(np.float32))
     h = jnp.abs(g) + 0.1
     w = jnp.asarray((rng.rand(n) > 0.2).astype(np.float32))
@@ -200,3 +201,23 @@ def test_segment_histogram_small_round_path(monkeypatch):
             num_live=jnp_.int32(live)))
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4,
                                    err_msg=f"live={live}")
+
+
+def test_take_from_table_exact(monkeypatch):
+    """One-hot matmul table lookup must be bit-exact vs a plain gather
+    ([n]-from-small-table gathers serialize on the TPU backend; the
+    matmul form replaces them on hot paths — score update, prediction)."""
+    from lightgbm_tpu.ops import histogram as H
+    monkeypatch.setattr(H, "on_accelerator", lambda: True)
+    rng = np.random.RandomState(0)
+    table = (rng.randn(255) * 1e3).astype(np.float32)
+    idx = rng.randint(0, 255, size=10_001).astype(np.int32)
+    out = np.asarray(H.take_from_table(jnp.asarray(table), jnp.asarray(idx)))
+    assert np.array_equal(out, table[idx])
+    t2 = rng.randn(255, 7).astype(np.float32)
+    out2 = np.asarray(H.take_from_table(jnp.asarray(t2), jnp.asarray(idx)))
+    assert np.array_equal(out2, t2[idx])
+    # integer tables fall back to the gather (bf16 cast would be lossy)
+    t3 = rng.randint(0, 1 << 20, 255).astype(np.int32)
+    out3 = np.asarray(H.take_from_table(jnp.asarray(t3), jnp.asarray(idx)))
+    assert np.array_equal(out3, t3[idx])

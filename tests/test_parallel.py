@@ -42,7 +42,7 @@ def problem():
 
 def _single_device_tree(problem, cfg, meta):
     binned, grad, hess, B, F = problem
-    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    tree, leaf_id = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                               jnp.asarray(hess),
                               jnp.ones(len(grad), jnp.float32), meta, cfg)
     return tree, np.asarray(leaf_id)
@@ -83,7 +83,7 @@ def test_feature_parallel_matches_serial(problem):
 
     mesh = make_mesh(8, (FEATURE_AXIS,))
     grower = create_parallel_grower("feature", mesh, meta, cfg)
-    tree, leaf_id = grower(jnp.asarray(binned), jnp.asarray(grad),
+    tree, leaf_id = grower(jnp.asarray(binned.T), jnp.asarray(grad),
                            jnp.asarray(hess),
                            jnp.ones(len(grad), jnp.float32))
     assert int(tree.num_leaves) == int(ref_tree.num_leaves)
@@ -106,7 +106,8 @@ def test_2d_mesh_matches_serial(problem):
     mesh = make_mesh(8, (DATA_AXIS, FEATURE_AXIS), shape=(4, 2))
     grower = create_parallel_grower("data_feature", mesh, meta, cfg)
     from jax.sharding import NamedSharding, PartitionSpec as P
-    b = jax.device_put(binned, NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS)))
+    b = jax.device_put(np.ascontiguousarray(binned.T),
+                       NamedSharding(mesh, P(FEATURE_AXIS, DATA_AXIS)))
     g = jax.device_put(grad, NamedSharding(mesh, P(DATA_AXIS)))
     h = jax.device_put(hess, NamedSharding(mesh, P(DATA_AXIS)))
     m = jax.device_put(np.ones(len(grad), np.float32),
@@ -292,7 +293,8 @@ def test_voting_parallel_reduces_histogram_traffic(problem):
     def lower(cfg):
         @functools.partial(
             jax.shard_map, mesh=mesh,
-            in_specs=(jax.sharding.PartitionSpec(DATA_AXIS),) * 4,
+            in_specs=(jax.sharding.PartitionSpec(None, DATA_AXIS),)
+            + (jax.sharding.PartitionSpec(DATA_AXIS),) * 3,
             out_specs=(jax.sharding.PartitionSpec(),
                        jax.sharding.PartitionSpec(DATA_AXIS)),
             check_vma=False)

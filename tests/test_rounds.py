@@ -52,10 +52,10 @@ def _assert_trees_equal(t1, t2):
 
 
 def _grow_both(binned, grad, hess, mask, meta, cfg, mc=None):
-    t_s, lid_s = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    t_s, lid_s = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                            jnp.asarray(hess), jnp.asarray(mask), meta, cfg,
                            monotone_constraints=mc)
-    t_r, lid_r = grow_tree_rounds(jnp.asarray(binned), jnp.asarray(grad),
+    t_r, lid_r = grow_tree_rounds(jnp.asarray(binned.T), jnp.asarray(grad),
                                   jnp.asarray(hess), jnp.asarray(mask),
                                   meta, cfg, monotone_constraints=mc)
     _assert_trees_equal(t_s, t_r)
@@ -132,10 +132,10 @@ def test_rounds_equals_serial_extra_trees_and_bynode(problem):
     mask = np.ones(len(grad), np.float32)
     meta = _meta(B, F)
     key = jax.random.PRNGKey(42)
-    t_s, lid_s = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    t_s, lid_s = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                            jnp.asarray(hess), jnp.asarray(mask), meta, cfg,
                            rng_key=key)
-    t_r, lid_r = grow_tree_rounds(jnp.asarray(binned), jnp.asarray(grad),
+    t_r, lid_r = grow_tree_rounds(jnp.asarray(binned.T), jnp.asarray(grad),
                                   jnp.asarray(hess), jnp.asarray(mask),
                                   meta, cfg, rng_key=key)
     _assert_trees_equal(t_s, t_r)
@@ -154,7 +154,7 @@ def test_rounds_data_parallel_matches_single(problem):
                        hist_method="scatter")
     mask = np.ones(len(grad), np.float32)
     ref_tree, ref_leaf = grow_tree_rounds(
-        jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(binned.T), jnp.asarray(grad), jnp.asarray(hess),
         jnp.asarray(mask), meta, cfg)
 
     assert jax.device_count() >= 8
@@ -162,9 +162,10 @@ def test_rounds_data_parallel_matches_single(problem):
     sharded = jax.shard_map(
         lambda b, g, h, m: grow_tree_rounds(b, g, h, m, meta, cfg,
                                             axis_name="d"),
-        mesh=mesh, in_specs=(P("d"), P("d"), P("d"), P("d")),
+        mesh=mesh, in_specs=(P(None, "d"), P("d"), P("d"), P("d")),
         out_specs=(P(), P("d")), check_vma=False)
-    tree, leaf_id = jax.jit(sharded)(binned, grad, hess, mask)
+    tree, leaf_id = jax.jit(sharded)(
+        np.ascontiguousarray(binned.T), grad, hess, mask)
 
     nl = int(ref_tree.num_leaves)
     assert int(tree.num_leaves) == nl
@@ -313,10 +314,10 @@ def test_rounds_equals_serial_sorted_seghist(problem, monkeypatch):
     for leaves in (7, 31, 64):
         cfg = GrowerConfig(num_leaves=leaves, num_bins=B,
                            hp=SplitHyperparams(), hist_method="scatter")
-        t_s, lid_s = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+        t_s, lid_s = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                                jnp.asarray(hess), jnp.asarray(mask),
                                meta, cfg)
-        t_r, lid_r = grow_tree_rounds(jnp.asarray(binned), jnp.asarray(grad),
+        t_r, lid_r = grow_tree_rounds(jnp.asarray(binned.T), jnp.asarray(grad),
                                       jnp.asarray(hess), jnp.asarray(mask),
                                       meta, cfg)
         # structure must be identical; floats only to accumulation order

@@ -55,7 +55,7 @@ def test_best_split_matches_brute_force():
     hess = np.ones(n, np.float32)
     hp = SplitHyperparams(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
 
-    hist = build_histogram(jnp.asarray(binned), jnp.asarray(grad),
+    hist = build_histogram(jnp.asarray(binned.T), jnp.asarray(grad),
                            jnp.asarray(hess), jnp.ones(n, jnp.float32), B,
                            method="scatter")
     meta = _meta(B, F)
@@ -80,7 +80,7 @@ def test_min_data_in_leaf_enforced():
     meta = _meta(B, F)
     cfg = GrowerConfig(num_leaves=31, hp=SplitHyperparams(min_data_in_leaf=30),
                        num_bins=B, hist_method="scatter")
-    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    tree, leaf_id = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                               jnp.asarray(hess), jnp.ones(n, jnp.float32),
                               meta, cfg)
     nl = int(tree.num_leaves)
@@ -97,10 +97,10 @@ def test_grower_leaf_ids_match_traversal():
     meta = _meta(B, F)
     cfg = GrowerConfig(num_leaves=15, hp=SplitHyperparams(min_data_in_leaf=5),
                        num_bins=B, hist_method="scatter")
-    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    tree, leaf_id = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                               jnp.asarray(hess), jnp.ones(n, jnp.float32),
                               meta, cfg)
-    routed = predict_leaf_index_binned(tree, jnp.asarray(binned), meta)
+    routed = predict_leaf_index_binned(tree, jnp.asarray(binned.T), meta)
     np.testing.assert_array_equal(np.asarray(leaf_id), np.asarray(routed))
 
 
@@ -115,7 +115,7 @@ def test_leaf_values_are_newton_steps():
     cfg = GrowerConfig(num_leaves=8,
                        hp=SplitHyperparams(min_data_in_leaf=10, lambda_l2=lam),
                        num_bins=B, hist_method="scatter")
-    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    tree, leaf_id = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                               jnp.asarray(hess), jnp.ones(n, jnp.float32),
                               meta, cfg)
     lid = np.asarray(leaf_id)
@@ -139,7 +139,7 @@ def test_max_depth_limit():
     cfg = GrowerConfig(num_leaves=31, max_depth=2,
                        hp=SplitHyperparams(min_data_in_leaf=1),
                        num_bins=B, hist_method="scatter")
-    tree, _ = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    tree, _ = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                         jnp.asarray(hess), jnp.ones(n, jnp.float32), meta, cfg)
     assert int(tree.num_leaves) <= 4
     assert int(np.asarray(tree.leaf_depth)[:int(tree.num_leaves)].max()) <= 2
@@ -154,9 +154,9 @@ def test_predict_tree_binned_values():
     meta = _meta(B, F)
     cfg = GrowerConfig(num_leaves=6, hp=SplitHyperparams(min_data_in_leaf=10),
                        num_bins=B, hist_method="scatter")
-    tree, leaf_id = grow_tree(jnp.asarray(binned), jnp.asarray(grad),
+    tree, leaf_id = grow_tree(jnp.asarray(binned.T), jnp.asarray(grad),
                               jnp.asarray(hess), jnp.ones(n, jnp.float32),
                               meta, cfg)
-    vals = np.asarray(predict_tree_binned(tree, jnp.asarray(binned), meta))
+    vals = np.asarray(predict_tree_binned(tree, jnp.asarray(binned.T), meta))
     lv = np.asarray(tree.leaf_value)
     np.testing.assert_allclose(vals, lv[np.asarray(leaf_id)], rtol=1e-6)

@@ -50,8 +50,8 @@ def main():
     ds = lgb.Dataset(X, label=y, params={"max_bin": MAX_BIN})
     ds.construct()
     meta = ds.feature_meta()
-    binned = jnp.asarray(ds.binned)
-    n, G = binned.shape
+    binned = jnp.asarray(np.ascontiguousarray(ds.binned.T))   # [G, n]
+    G, n = binned.shape
     B = MAX_BIN + 1
     grad = jnp.asarray(rng.randn(n).astype(np.float32))
     hess = jnp.abs(grad) + 0.1
@@ -92,7 +92,7 @@ def main():
 
     # partition update
     def part(leaf_id, thr):
-        col = jnp.take(binned, 3, axis=1).astype(jnp.int32)
+        col = jnp.take(binned, 3, axis=0).astype(jnp.int32)
         gl = col <= thr
         in_leaf = leaf_id == 0
         return jnp.where(in_leaf & ~gl, 7, leaf_id)

@@ -106,7 +106,7 @@ def make_inputs(n, f=28, bins=64, seed=0):
     import jax.numpy as jnp
     import numpy as np
     rng = np.random.RandomState(seed)
-    binned = jnp.asarray(rng.randint(0, bins - 1, (n, f), dtype=np.int64),
+    binned = jnp.asarray(rng.randint(0, bins - 1, (f, n), dtype=np.int64),
                          jnp.uint8)
     grad = jnp.asarray(rng.randn(n), jnp.float32)
     hess = jnp.abs(grad) + 0.1
@@ -173,7 +173,8 @@ def stage_seg_matmul_s16():
     from jax import lax
 
     def seg_mm(binned, grad, hess, mask, slot, S, B):
-        n, F = binned.shape
+        F, n = binned.shape
+        binned = binned.T
         vals = jnp.stack([grad, hess, jnp.ones_like(grad)], 1) * mask[:, None]
         C = 4096
         nb = n // C
@@ -214,7 +215,7 @@ def stage_nonzero_compact():
         def compact(b, mem, _cap=cap, _n=n):
             idx = jnp.nonzero(mem, size=_cap, fill_value=_n)[0]
             idxc = jnp.minimum(idx, _n - 1)
-            return jnp.take(b, idxc, axis=0)
+            return jnp.take(b, idxc, axis=1)
 
         try:
             out[f"n{n}_ms"] = d2h_time(compact, binned, member)
@@ -280,7 +281,7 @@ def stage_fori_hist():
                 def run():
                     idx = jnp.nonzero(mem, size=cap, fill_value=n)[0]
                     idxc = jnp.minimum(idx, n - 1)
-                    rows = jnp.take(b, idxc, axis=0)
+                    rows = jnp.take(b, idxc, axis=1)
                     w = jnp.where(idx < n, jnp.take(m, idxc), 0.0)
                     return H.build_histogram(rows, jnp.take(g, idxc),
                                              jnp.take(h, idxc), w, B,

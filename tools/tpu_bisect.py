@@ -1,0 +1,138 @@
+"""Compile-hang bisect for the rounds-grower training program (one-process
+TPU session, single-tenant doctrine).
+
+Round-5 evidence: the 13b30f3-era program (exact rounds, unfused gathers,
+no small-round branch) compiled on the chip in 40 s; the current default
+program (relaxed growth + small-round lax.cond + fused u32 gather) blocked
+the remote compile service for >25 min.  This script inits once, then
+tries variants from smallest program to full default, each compile in a
+worker thread with a patience cap — if a compile hangs, the thread is
+abandoned (the service may still accept the next program; if it queues,
+later attempts just time out too and the session exits with what's
+banked).
+
+Variants (env gates read at trace time):
+  v_exact_nosmall_nopack  ~ proven 13b30f3 program
+  v_exact_nosmall_pack    + fused u32 gather
+  v_fast_nosmall_pack     + relaxed growth
+  v_fast_small_pack       full current default (adds the small-round cond)
+
+Usage: python tools/tpu_bisect.py out.json [n_rows]
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.utils.platform import _cache_dir  # noqa: E402
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else os.path.join(REPO, "tpu_bisect.json")
+NROWS = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000
+PATIENCE = float(os.environ.get("BISECT_PATIENCE", 480))
+T0 = time.time()
+DATA = {"started_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "n_rows": NROWS, "stages": []}
+
+
+def bank(stage, **kw):
+    kw["stage"] = stage
+    kw["t_elapsed"] = round(time.time() - T0, 1)
+    DATA["stages"].append(kw)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(DATA, f, indent=1, default=str)
+    os.replace(tmp, OUT)
+    print(f"[bisect] {stage}: {json.dumps(kw, default=str)[:400]}", flush=True)
+
+
+VARIANTS = [
+    ("v_exact_nosmall_nopack",
+     {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"}, "rounds"),
+    ("v_exact_nosmall_pack",
+     {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "1"}, "rounds"),
+    ("v_fast_nosmall_pack",
+     {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "1"}, "fast"),
+    ("v_fast_small_pack",
+     {"LGBM_TPU_SMALL_ROUNDS": "1", "LGBM_TPU_PACK": "1"}, "fast"),
+]
+
+
+def main():
+    t = time.time()
+    try:
+        import jax
+        devs = jax.devices()
+        import jax.numpy as jnp
+        jnp.ones((8, 8)).sum().block_until_ready()
+    except Exception as e:
+        bank("init", error=str(e)[-600:])
+        return 3
+    d = devs[0]
+    bank("init", seconds=round(time.time() - t, 1), platform=d.platform,
+         kind=getattr(d, "device_kind", ""))
+    if d.platform == "cpu":
+        bank("abort", reason="cpu backend")
+        return 3
+
+    import numpy as np
+
+    import bench
+    import lightgbm_tpu as lgb
+
+    X, y = bench.make_higgs_like(NROWS, bench.F)
+
+    for name, env, growth in VARIANTS:
+        os.environ.update(env)
+        params = {"objective": "binary", "num_leaves": 255,
+                  "learning_rate": 0.1, "max_bin": 63, "metric": "None",
+                  "verbosity": -1, "tpu_tree_growth": growth}
+        result = {}
+        done = threading.Event()
+
+        def attempt(params=params, result=result, done=done):
+            try:
+                ds = lgb.Dataset(X, label=y, params=params)
+                ds.construct()
+                bst = lgb.Booster(params=params, train_set=ds)
+                t0 = time.perf_counter()
+                bst.update()
+                bench.dsync(bst.boosting.train_score)
+                result["compile_s"] = round(time.perf_counter() - t0, 1)
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    bst.update()
+                bench.dsync(bst.boosting.train_score)
+                result["sec_per_tree"] = round(
+                    (time.perf_counter() - t0) / 10, 4)
+            except Exception as e:
+                result["error"] = str(e)[-600:]
+            finally:
+                done.set()
+
+        th = threading.Thread(target=attempt, daemon=True)
+        th.start()
+        if not done.wait(PATIENCE):
+            bank(name, hung=True, patience_s=PATIENCE)
+            # abandoned thread keeps its RPC; try the next program anyway
+            continue
+        bank(name, **result)
+        # first healthy variant is enough signal; keep going only if it
+        # failed so the table shows where the wall is
+        if "sec_per_tree" in result and os.environ.get(
+                "BISECT_ALL") != "1":
+            break
+
+    bank("done", total_seconds=round(time.time() - T0, 1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ compile cache for the driver's bench run.
 import json
 import os
 import sys
+import threading
 import time
 import traceback
 
@@ -49,6 +50,21 @@ def bank(stage, **kw):
           flush=True)
 
 
+# program-variant ladder for a hung remote compile (round-5 evidence:
+# the compile service blocked >25 min on the full default program while a
+# close cousin compiled in 40 s).  Entries are env gates read at trace
+# time (ops/histogram.py / grower_rounds.py); the winning variant's env
+# persists for later stages.  The hung attempt's thread is abandoned —
+# killing the process would wedge backend init ~25 min (single-tenant
+# tunnel), an abandoned RPC just idles.
+VARIANT_LADDER = [
+    {},
+    {"LGBM_TPU_SMALL_ROUNDS": "0"},
+    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"},
+]
+COMPILE_PATIENCE = float(os.environ.get("TM_COMPILE_PATIENCE", 600))
+
+
 def guard(stage, fn, *a, **kw):
     if os.environ.get(f"TM_SKIP_{stage.upper()}") == "1":
         bank(stage, skipped=True)
@@ -63,6 +79,59 @@ def guard(stage, fn, *a, **kw):
     except Exception as e:
         bank(stage, error=str(e)[-600:], tb=traceback.format_exc()[-1500:])
         return None
+
+
+def guard_ladder(stage, fn, *a, **kw):
+    """guard() with the compile-hang variant ladder: each variant runs in
+    a worker thread; if no result lands within COMPILE_PATIENCE, the next
+    (smaller) program variant is tried.  First success wins and its env
+    stays for subsequent stages."""
+    if os.environ.get(f"TM_SKIP_{stage.upper()}") == "1":
+        bank(stage, skipped=True)
+        return None
+    for i, env in enumerate(VARIANT_LADDER):
+        os.environ.update(env)
+        box = {}
+        done = threading.Event()
+        cancel = threading.Event()
+        compile_done = threading.Event()
+
+        def attempt(box=box, done=done, cancel=cancel, cd=compile_done):
+            t1 = time.time()
+            try:
+                r = fn(*a, cancel=cancel, compile_done=cd, **kw)
+                out = dict(r) if isinstance(r, dict) else {"result": r}
+                out["stage_seconds"] = round(time.time() - t1, 1)
+                box["out"] = out
+                box["r"] = r
+            except Exception as e:
+                box["out"] = {"error": str(e)[-600:],
+                              "tb": traceback.format_exc()[-1500:]}
+            finally:
+                done.set()
+
+        th = threading.Thread(target=attempt, daemon=True)
+        th.start()
+        # the patience clock watches the COMPILE only — the timed run may
+        # legitimately run far past it (500 trees at 11M rows); once the
+        # compile lands, wait for the stage without a deadline
+        if not compile_done.wait(COMPILE_PATIENCE):
+            # the zombie's post-compile guard (bench.run_bench cancel)
+            # keeps it from racing the next attempt's timed run if its
+            # compile ever unblocks
+            cancel.set()
+            bank(f"{stage}_hung", variant=i, env=env,
+                 patience_s=COMPILE_PATIENCE)
+            continue
+        done.wait()
+        out = box["out"]
+        if i:
+            out["variant"] = i
+            out["variant_env"] = env
+        bank(stage, **out)
+        return box.get("r")
+    bank(stage, error="all program variants hung in compile")
+    return None
 
 
 def main():
@@ -84,15 +153,15 @@ def main():
 
     import bench
 
-    r1 = guard("higgs_1m",
-               bench.run_bench, 1_000_000, 20, 255, 63, tag="-1m")
+    r1 = guard_ladder("higgs_1m",
+                      bench.run_bench, 1_000_000, 20, 255, 63, tag="-1m")
 
     trees_11m = int(os.environ.get("TM_TREES_11M", 0)) or None
     if trees_11m is None:
         spt = (r1 or {}).get("sec_per_tree")
         trees_11m = 500 if (spt is not None and spt < 0.6) else 60
-    guard("higgs_11m",
-          bench.run_bench, 11_000_000, trees_11m, 255, 63)
+    guard_ladder("higgs_11m",
+                 bench.run_bench, 11_000_000, trees_11m, 255, 63)
 
     guard("ranking",
           bench.run_ranking_bench, 12_000, 100, 100, 255, 63)

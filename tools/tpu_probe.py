@@ -142,7 +142,7 @@ def main():
     from lightgbm_tpu.ops.histogram import build_histogram
     for n in (1_000_000, 8_000_000):
         tag = f"{n//1_000_000}m"
-        binned = jnp.asarray(rng.randint(0, 63, (n, 28)).astype(np.uint8))
+        binned = jnp.asarray(rng.randint(0, 63, (28, n)).astype(np.uint8))
         g = jnp.asarray(rng.randn(n).astype(np.float32))
         h = jnp.abs(g) + 0.1
         m = jnp.ones((n,), jnp.float32)
@@ -156,7 +156,7 @@ def main():
     # ---- segment histogram (current scatter impl) at 1M x 28, 128 slots
     from lightgbm_tpu.ops.histogram import segment_histogram
     n = 1_000_000
-    binned = jnp.asarray(rng.randint(0, 63, (n, 28)).astype(np.uint8))
+    binned = jnp.asarray(rng.randint(0, 63, (28, n)).astype(np.uint8))
     g = jnp.asarray(rng.randn(n).astype(np.float32))
     h = jnp.abs(g) + 0.1
     w = jnp.ones((n,), jnp.float32)
