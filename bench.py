@@ -410,6 +410,17 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
     return result
 
 
+# the descending program-variant ladder for hung remote compiles: each
+# entry is an env-gate set the growers read at TRACE time (grower_rounds
+# .py use_pack, ops/histogram.py compacted_segment_histogram).  SINGLE
+# SOURCE — tools/tpu_measure.py and tools/tpu_bisect.py import this list.
+COMPILE_VARIANT_ENVS = [
+    {},
+    {"LGBM_TPU_SMALL_ROUNDS": "0"},
+    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"},
+]
+
+
 # --------------------------------------------------------------- TPU worker
 
 def tpu_worker():
@@ -715,6 +726,12 @@ def main():
     stall_timeout = float(os.environ.get("BENCH_STALL_TIMEOUT", 2400))
     last_progress = time.time()
     full_rows = N
+    # on a hung compile the first fallback lever is a SMALLER PROGRAM
+    # (the env-gated variants the grower reads at trace time), and only
+    # then fewer rows — a hang is a compiler pathology more often than a
+    # size problem (round-5 bisect evidence)
+    variant_envs = COMPILE_VARIANT_ENVS
+    variant_idx = 0
     while try_tpu and remaining_budget() > 120:
         if proc is None:
             # measured round 5: the remote-compile service
@@ -725,7 +742,8 @@ def main():
             # service, and a post-init stall (hung compile) halves the
             # row count for the next attempt — banking a real TPU number
             # at the largest scale the service can compile.
-            variant = "default"
+            variant = f"program-v{variant_idx}"
+            os.environ.update(variant_envs[variant_idx])
             attempt += 1
             log(f"tpu worker attempt {attempt} (rows={full_rows}, "
                 f"budget left={int(remaining_budget())}s); a worker blocked "
@@ -749,10 +767,13 @@ def main():
                      for s in reader.lines)
         if (inited and time.time() - last_progress > stall_timeout
                 and remaining_budget() > 600):
-            full_rows = max(1_000_000, full_rows // 2)
+            if variant_idx < len(variant_envs) - 1:
+                variant_idx += 1
+            else:
+                full_rows = max(1_000_000, full_rows // 2)
             log(f"worker stalled {int(time.time() - last_progress)}s "
-                f"post-init (hung compile); killing and retrying at "
-                f"{full_rows} rows")
+                f"post-init (hung compile); killing and retrying with "
+                f"program-v{variant_idx} at {full_rows} rows")
             proc.kill()
             try:
                 proc.wait(timeout=30)

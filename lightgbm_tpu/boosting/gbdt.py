@@ -737,13 +737,21 @@ class GBDT:
             # (set after build) — its program is booster-specific
             if (not cegb_on and forced_plan is None
                     and not (use_renew and rf_const_init)):
+                # the trace-time env gates select program VARIANTS (the
+                # compile-hang ladders flip them between attempts in one
+                # process) — they must key the cache or a variant switch
+                # would silently reuse the previous variant's program
+                env_gates = tuple(
+                    os.environ.get(k, "") for k in
+                    ("LGBM_TPU_SEGHIST", "LGBM_TPU_SMALL_ROUNDS",
+                     "LGBM_TPU_PACK", "LGBM_TPU_TABLE_MATMUL"))
                 cache_key = (
                     "one_iter", K, n_pad, self.binned.shape,
                     str(self.binned.dtype), cfg, use_rounds, use_renew,
                     renew_pct, obj is None, mc is None,
                     mr.has_bundles, int(mr.max_group_bin),
                     len(mr.num_bin), int(mr.num_groups),
-                    bool(mr.is_categorical.any()))
+                    bool(mr.is_categorical.any()), env_gates)
             shared = _shared_program(cache_key)
             if shared is None:
                 def one_iter_full(binned, score, row_mask, grad, hess,

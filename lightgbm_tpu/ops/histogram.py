@@ -673,6 +673,68 @@ def segment_histogram_sorted(
 
 
 _SMALL_ROUND_SLOTS = 4
+# slot-expanded LHS rows: 3 * 42 = 126 <= the MXU's 128-row tile, so a
+# 42-slot segment histogram costs the SAME matmul cycles as a 1-slot one
+_EXPAND_SLOTS = 42
+
+
+def segment_histogram_expanded(
+    binned_t: jax.Array,     # [F, n] feature-major
+    grad: jax.Array,
+    hess: jax.Array,
+    weights: jax.Array,      # [n] f32
+    slot: jax.Array,         # [n] i32; values >= live_cap contribute nothing
+    num_bins: int,
+    live_cap: int = _EXPAND_SLOTS,
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+    f32_vals: bool = False,
+) -> jax.Array:
+    """Histograms of slots [0, live_cap) in ONE streamed full-matrix pass.
+
+    The plain histogram matmul uses M=3 of the MXU's 128 output rows
+    (grad/hess/count); expanding the LHS to ``[3*live_cap, C]`` — row
+    (j*live_cap + s) carrying ``vals[j] * (slot == s)`` — fills the tile and
+    computes every live slot's histogram in the SAME pass: no sort, no
+    gather, no arena.  One systolic tile (3*live_cap <= 128) costs the
+    same cycles as M=3, so this replaces the sorted arena for every
+    round with <= ``live_cap`` candidates — i.e. all but the widest
+    rounds of a 255-leaf tree (reference equivalent: one
+    ConstructHistograms call per leaf, serial_tree_learner.cpp:380-388;
+    here a frontier per PASS).  Returns [live_cap, 3, F, B] f32.
+    """
+    F, n = binned_t.shape
+    B = num_bins
+    SE = live_cap
+    nb = max(1, _pad_rows(n, block_rows) // block_rows)
+    n_pad = nb * block_rows
+    vals_t = _vals_t(grad, hess, weights)
+    slot_i = slot.astype(jnp.int32)
+    if n_pad != n:
+        binned_t = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+        vals_t = jnp.pad(vals_t, ((0, 0), (0, n_pad - n)))
+        slot_i = jnp.pad(slot_i, (0, n_pad - n), constant_values=SE)
+    iota_b = jnp.arange(B, dtype=binned_t.dtype)
+    iota_s = jnp.arange(SE, dtype=jnp.int32)
+    C = block_rows
+    acc_t = jnp.float32 if f32_vals else jnp.bfloat16
+    prec = lax.Precision.HIGHEST if f32_vals else lax.Precision.DEFAULT
+
+    def body(acc, i):
+        b = lax.dynamic_slice(binned_t, (0, i * C), (F, C))   # [F, C]
+        v = lax.dynamic_slice(vals_t, (0, i * C), (3, C))     # [3, C]
+        sl = lax.dynamic_slice(slot_i, (i * C,), (C,))        # [C]
+        oh_s = (sl[None, :] == iota_s[:, None]).astype(acc_t)   # [SE, C]
+        lhs = (v.astype(acc_t)[:, None, :] * oh_s[None, :, :]
+               ).reshape(3 * SE, C)
+        onehot2d = (b.T[:, :, None] == iota_b).astype(acc_t).reshape(
+            C, F * B)
+        part = lax.dot(lhs, onehot2d, precision=prec,
+                       preferred_element_type=jnp.float32)
+        return acc + part, None
+
+    init = jnp.zeros((3 * SE, F * B), dtype=jnp.float32)
+    acc, _ = lax.scan(body, init, jnp.arange(nb, dtype=jnp.int32))
+    return acc.reshape(3, SE, F, B).transpose(1, 0, 2, 3)
 
 
 def compacted_segment_histogram(
@@ -696,11 +758,13 @@ def compacted_segment_histogram(
     scatter formulation both OOMs — its [n*F, 3] update buffer lane-pads
     to 128 — and serializes there); XLA scatter with nonzero-compaction
     on CPU (measured fastest there every round, BENCH_r0*.json).
-    When ``num_live`` (the round's live-slot count) is given and small,
-    accelerators take a masked full-pass per slot instead: a streamed
-    matmul pass costs ~17 ms at 11M rows vs ~90 ms for sort+gather+arena
-    (tpu_probe_r5.json), so up to ``_SMALL_ROUND_SLOTS`` passes win.
-    ``LGBM_TPU_SEGHIST=sorted|scatter`` overrides (testing hook).
+    When ``num_live`` (the round's live-slot count) is given and at most
+    ``_EXPAND_SLOTS``, accelerators take ONE slot-expanded full-matrix
+    pass instead (``segment_histogram_expanded``): a streamed matmul
+    pass costs ~17 ms at 11M rows vs ~90 ms for sort+gather+arena
+    (tpu_probe_r5.json), and the expanded LHS computes up to 42 slots
+    for the cycles of one.  ``LGBM_TPU_SEGHIST=sorted|scatter``
+    overrides (testing hook).
     """
     F, n = binned_t.shape
     if use_sorted_seghist():
@@ -712,33 +776,25 @@ def compacted_segment_histogram(
                 binned_t, grad, hess, weights, slot_w, num_slots, num_bins,
                 f32_vals=f32_vals, caps=caps, packed=packed)
 
-        # LGBM_TPU_SMALL_ROUNDS=0 drops the small-round branch (and its
+        # LGBM_TPU_SMALL_ROUNDS=0 drops the expanded-pass branch (and its
         # lax.cond program duplication) — compile-cost bisect hook
         small_enabled = os.environ.get("LGBM_TPU_SMALL_ROUNDS") != "0"
         if num_live is None or num_slots <= _SMALL_ROUND_SLOTS \
                 or not small_enabled:
             return arena_path(None)
+        se = min(_EXPAND_SLOTS, num_slots)
 
-        method = "matmul" if not f32_vals else "matmul_f32"
+        def expanded_path(_):
+            hist = segment_histogram_expanded(
+                binned_t, grad, hess, weights, slot_w, num_bins,
+                live_cap=se, f32_vals=f32_vals)
+            if num_slots > se:
+                hist = jnp.concatenate(
+                    [hist, jnp.zeros((num_slots - se, 3, F, num_bins),
+                                     jnp.float32)], axis=0)
+            return hist
 
-        def small_path(_):
-            def one(kk):
-                def live(_):
-                    return build_histogram(
-                        binned_t, grad, hess,
-                        weights * (slot_w == kk), num_bins, method=method)
-                return lax.cond(
-                    kk < num_live, live,
-                    lambda _: jnp.zeros((3, F, num_bins), jnp.float32),
-                    None)
-            small = lax.map(one, jnp.arange(_SMALL_ROUND_SLOTS,
-                                            dtype=jnp.int32))
-            pad = jnp.zeros((num_slots - _SMALL_ROUND_SLOTS, 3, F, num_bins),
-                            jnp.float32)
-            return jnp.concatenate([small, pad], axis=0)
-
-        return lax.cond(num_live <= _SMALL_ROUND_SLOTS,
-                        small_path, arena_path, None)
+        return lax.cond(num_live <= se, expanded_path, arena_path, None)
 
     member = (slot < num_slots) & (weights > 0)
     count = jnp.sum(member)

@@ -176,8 +176,9 @@ def test_segment_histogram_sorted_all_dropped():
 
 
 def test_segment_histogram_small_round_path(monkeypatch):
-    """The small-round masked-pass branch (num_live <= 4 on the sorted
-    dispatch) must agree with the arena path and the scatter reference."""
+    """The slot-expanded one-pass branch (num_live <= 42 on the sorted
+    dispatch) must agree with the arena path and the scatter reference,
+    on both sides of the dispatch boundary."""
     import jax.numpy as jnp_
     from lightgbm_tpu.ops.histogram import (capacity_schedule,
                                             compacted_segment_histogram,
@@ -190,7 +191,7 @@ def test_segment_histogram_small_round_path(monkeypatch):
     h = jnp.abs(g) + 0.1
     w = jnp.asarray((rng.rand(n) > 0.2).astype(np.float32))
     caps = capacity_schedule(n, min_cap=512)
-    for live in (1, 3, 4, 5, 17):
+    for live in (1, 3, 4, 5, 17, 42, 43, 60):
         # slots >= live are dropped lanes (as the grower produces)
         slot = jnp.asarray(
             np.where(rng.rand(n) < 0.7, rng.randint(0, live, n), S)

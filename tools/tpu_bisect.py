@@ -53,16 +53,20 @@ def bank(stage, **kw):
     print(f"[bisect] {stage}: {json.dumps(kw, default=str)[:400]}", flush=True)
 
 
-VARIANTS = [
-    ("v_exact_nosmall_nopack",
-     {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"}, "rounds"),
-    ("v_exact_nosmall_pack",
-     {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "1"}, "rounds"),
-    ("v_fast_nosmall_pack",
-     {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "1"}, "fast"),
-    ("v_fast_small_pack",
-     {"LGBM_TPU_SMALL_ROUNDS": "1", "LGBM_TPU_PACK": "1"}, "fast"),
-]
+# crosses bench.COMPILE_VARIANT_ENVS (the single-source env ladder) with
+# the growth mode; ordered smallest program -> full default
+def _variants():
+    import bench
+    envs = list(reversed(bench.COMPILE_VARIANT_ENVS))   # smallest first
+    out = []
+    for growth in ("rounds", "fast"):
+        for i, env in enumerate(envs):
+            if growth == "rounds" and i == len(envs) - 1:
+                continue   # exact + full default ~ covered by fast runs
+            full = {"LGBM_TPU_SMALL_ROUNDS": "1", "LGBM_TPU_PACK": "1"}
+            full.update(env)
+            out.append((f"v_{growth}_{i}", full, growth))
+    return out
 
 
 def main():
@@ -89,7 +93,7 @@ def main():
 
     X, y = bench.make_higgs_like(NROWS, bench.F)
 
-    for name, env, growth in VARIANTS:
+    for name, env, growth in _variants():
         os.environ.update(env)
         params = {"objective": "binary", "num_leaves": 255,
                   "learning_rate": 0.1, "max_bin": 63, "metric": "None",

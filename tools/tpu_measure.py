@@ -57,12 +57,12 @@ def bank(stage, **kw):
 # persists for later stages.  The hung attempt's thread is abandoned —
 # killing the process would wedge backend init ~25 min (single-tenant
 # tunnel), an abandoned RPC just idles.
-VARIANT_LADDER = [
-    {},
-    {"LGBM_TPU_SMALL_ROUNDS": "0"},
-    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"},
-]
 COMPILE_PATIENCE = float(os.environ.get("TM_COMPILE_PATIENCE", 600))
+
+
+def _variant_ladder():
+    import bench
+    return bench.COMPILE_VARIANT_ENVS
 
 
 def guard(stage, fn, *a, **kw):
@@ -89,7 +89,7 @@ def guard_ladder(stage, fn, *a, **kw):
     if os.environ.get(f"TM_SKIP_{stage.upper()}") == "1":
         bank(stage, skipped=True)
         return None
-    for i, env in enumerate(VARIANT_LADDER):
+    for i, env in enumerate(_variant_ladder()):
         os.environ.update(env)
         box = {}
         done = threading.Event()
@@ -114,8 +114,15 @@ def guard_ladder(stage, fn, *a, **kw):
         th.start()
         # the patience clock watches the COMPILE only — the timed run may
         # legitimately run far past it (500 trees at 11M rows); once the
-        # compile lands, wait for the stage without a deadline
-        if not compile_done.wait(COMPILE_PATIENCE):
+        # compile lands, wait for the stage without a deadline.  A
+        # pre-compile failure (data-gen OOM, construct error) fires
+        # ``done`` without ``compile_done`` and banks its real error
+        # instead of masquerading as a hung compile.
+        deadline = time.time() + COMPILE_PATIENCE
+        while not done.is_set() and not compile_done.is_set() \
+                and time.time() < deadline:
+            done.wait(5)
+        if not done.is_set() and not compile_done.is_set():
             # the zombie's post-compile guard (bench.run_bench cancel)
             # keeps it from racing the next attempt's timed run if its
             # compile ever unblocks
