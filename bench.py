@@ -770,7 +770,12 @@ def main():
             if variant_idx < len(variant_envs) - 1:
                 variant_idx += 1
             else:
+                # ladder exhausted: halve the rows and retry from the FULL
+                # default program — the round-5 evidence is that compile
+                # cost is size-sensitive, so the smaller problem deserves
+                # the fastest program, not the most-stripped one
                 full_rows = max(1_000_000, full_rows // 2)
+                variant_idx = 0
             log(f"worker stalled {int(time.time() - last_progress)}s "
                 f"post-init (hung compile); killing and retrying with "
                 f"program-v{variant_idx} at {full_rows} rows")

@@ -744,7 +744,8 @@ class GBDT:
                 env_gates = tuple(
                     os.environ.get(k, "") for k in
                     ("LGBM_TPU_SEGHIST", "LGBM_TPU_SMALL_ROUNDS",
-                     "LGBM_TPU_PACK", "LGBM_TPU_TABLE_MATMUL"))
+                     "LGBM_TPU_PACK", "LGBM_TPU_TABLE_MATMUL",
+                     "LGBM_TPU_ROUTER"))
                 cache_key = (
                     "one_iter", K, n_pad, self.binned.shape,
                     str(self.binned.dtype), cfg, use_rounds, use_renew,

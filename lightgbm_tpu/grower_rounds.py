@@ -52,7 +52,8 @@ from .dataset import FeatureMeta
 from .grower import GrowerConfig, TreeArrays, _LeafBest, _psum, row_goes_left
 from .ops.histogram import (build_histogram, capacity_schedule,
                             compacted_segment_histogram, pack_cols_u32,
-                            resolve_hist_method, use_sorted_seghist)
+                            resolve_hist_method, take_from_table,
+                            use_sorted_seghist)
 from .ops.split import (MAX_CAT_WORDS, SplitResult, best_split_for_leaf,
                         leaf_output)
 
@@ -118,6 +119,12 @@ def grow_tree_rounds(
                 and os.environ.get("LGBM_TPU_PACK") != "0")
     packed = (pack_cols_u32(binned_t, grad, hess, row_mask)
               if use_pack else None)
+    # router-matmul candidate routing (see body): O(n)/round instead of
+    # the scan's O(k*n); numeric-only (categorical bitsets don't ride an
+    # f32 table) and accelerator-shaped.  LGBM_TPU_ROUTER=0 forces the
+    # scan (bisect/testing hook)
+    use_router = (use_sorted_seghist() and not meta.is_categorical.any()
+                  and os.environ.get("LGBM_TPU_ROUTER") != "0")
     # segment-histogram precision follows the resolved histogram method so
     # parent - smaller-child subtraction stays consistent: only the bf16
     # one-hot matmul is inexact; every other kernel accumulates f32-exact
@@ -366,45 +373,93 @@ def grow_tree_rounds(
         order = jnp.argsort(-gains, stable=True)
         rank = jnp.zeros(L, jnp.int32).at[order].set(iota_L)
 
-        # -- candidate scan: per-row goes-left bit, candidate rank, and
-        # smaller-child membership for the whole batch.  One scan step per
-        # candidate reads its split feature as a CONTIGUOUS column of the
-        # transposed matrix and broadcasts scalar split params — replacing
-        # the per-row take_along_axis + [n]-from-leaf-table gathers, which
-        # are serialized-gather territory on TPU (measured ~130 ms per
-        # pass at 11M rows, tpu_probe_r5.json).
+        # -- candidate routing: per-row goes-left bit, candidate rank, and
+        # smaller-child membership for the whole batch.
         b = c.best
         idl = jnp.clip(order[:KCAP], 0, L - 1)          # candidate leaves
 
-        def cstep(carry, kk):
-            def live(carry):
-                gl_a, crank_a, small_a = carry
-                leaf = idl[kk]
-                feat = jnp.clip(b.feature[leaf], 0, F - 1)
-                col = lax.dynamic_index_in_dim(binned_t, feat_group[feat], 0,
-                                               keepdims=False)       # [n]
-                nb = num_bin[feat]
-                dec = col.astype(jnp.int32) - feat_start[feat] + 1
-                binf = jnp.where((dec >= 1) & (dec < nb), dec, 0)
-                glk = row_goes_left(
-                    binf, b.threshold[leaf], b.default_left[leaf],
-                    b.is_categorical[leaf] if has_cat else None,
-                    b.cat_bitset[leaf] if has_cat else None,
-                    missing_type[feat], default_bin[feat], nb)
-                mk = c.leaf_id == leaf
-                sl = b.left_count[leaf] <= b.right_count[leaf]
-                return (jnp.where(mk, glk, gl_a),
-                        jnp.where(mk, kk, crank_a),
-                        jnp.where(mk, glk == sl, small_a))
-            # skip the O(n) column read + masking for dead candidate lanes
-            # (late-tree rounds often have k of 1-2 out of KCAP steps)
-            return lax.cond(kk < k, live, lambda c_: c_, carry), None
+        if use_router:
+            # ROUTER MATMUL (numeric features, accelerator path): ONE
+            # [9, n] take_from_table one-hot matmul hands every row its
+            # leaf's split params, then one fused [G, n] select-reduce
+            # reads the row's split-feature bin — O(G*n) total per round
+            # (~one binned-matrix stream, the cost the expanded segment
+            # histogram already pays) vs the scan's O(k*n) column passes:
+            # a clear win on the wide rounds (k up to 128) and a ~one-
+            # stream overhead on narrow ones.  All table values are
+            # integers < 2^16 or flags: exact in f32.
+            feat_l = jnp.clip(b.feature, 0, F - 1)
+            live_l = pos & (rank < k)
+            tbl = jnp.stack([
+                jnp.where(live_l, rank, KCAP).astype(jnp.float32),   # crank
+                feat_group[feat_l].astype(jnp.float32),              # group
+                b.threshold.astype(jnp.float32),
+                b.default_left.astype(jnp.float32),
+                missing_type[feat_l].astype(jnp.float32),
+                default_bin[feat_l].astype(jnp.float32),
+                num_bin[feat_l].astype(jnp.float32),
+                feat_start[feat_l].astype(jnp.float32),
+                (b.left_count <= b.right_count).astype(jnp.float32),
+            ], axis=1)                                   # [L, 9]
+            prm = take_from_table(tbl, c.leaf_id, leading=True)  # [9, n]
+            crank = prm[0].astype(jnp.int32)
+            grp = prm[1].astype(jnp.int32)
+            thr_r = prm[2].astype(jnp.int32)
+            dl_r = prm[3] > 0.5
+            mt_r = prm[4].astype(jnp.int32)
+            db_r = prm[5].astype(jnp.int32)
+            nb_r = prm[6].astype(jnp.int32)
+            fs_r = prm[7].astype(jnp.int32)
+            sl_r = prm[8] > 0.5
+            # row's bin of its leaf's split feature: a select-reduce over
+            # the feature-major matrix (exactly one group matches; fused —
+            # no [n, G] intermediate, no serialized gather)
+            iota_G = jnp.arange(G, dtype=jnp.int32)
+            col = jnp.sum(jnp.where(iota_G[:, None] == grp[None, :],
+                                    binned_t.astype(jnp.int32), 0), axis=0)
+            dec = col - fs_r + 1
+            binf = jnp.where((dec >= 1) & (dec < nb_r), dec, 0)
+            # the numeric fast path of the one documented decision-rule
+            # mirror (DenseBin::SplitInner) — per-row params broadcast
+            gl = row_goes_left(binf, thr_r, dl_r, None, None,
+                               mt_r, db_r, nb_r)
+            row_small = gl == sl_r
+        else:
+            # candidate scan: one step per candidate reads its split
+            # feature as a CONTIGUOUS column of the transposed matrix and
+            # broadcasts scalar split params (kept for categorical splits
+            # — the per-row bitset test doesn't ride an f32 table — and
+            # for CPU, where one-hot matmuls lose)
+            def cstep(carry, kk):
+                def live(carry):
+                    gl_a, crank_a, small_a = carry
+                    leaf = idl[kk]
+                    feat = jnp.clip(b.feature[leaf], 0, F - 1)
+                    col = lax.dynamic_index_in_dim(binned_t,
+                                                   feat_group[feat], 0,
+                                                   keepdims=False)   # [n]
+                    nb = num_bin[feat]
+                    dec = col.astype(jnp.int32) - feat_start[feat] + 1
+                    binf = jnp.where((dec >= 1) & (dec < nb), dec, 0)
+                    glk = row_goes_left(
+                        binf, b.threshold[leaf], b.default_left[leaf],
+                        b.is_categorical[leaf] if has_cat else None,
+                        b.cat_bitset[leaf] if has_cat else None,
+                        missing_type[feat], default_bin[feat], nb)
+                    mk = c.leaf_id == leaf
+                    sl = b.left_count[leaf] <= b.right_count[leaf]
+                    return (jnp.where(mk, glk, gl_a),
+                            jnp.where(mk, kk, crank_a),
+                            jnp.where(mk, glk == sl, small_a))
+                # skip the O(n) column read + masking for dead candidate
+                # lanes (late-tree rounds often have k of 1-2 of KCAP)
+                return lax.cond(kk < k, live, lambda c_: c_, carry), None
 
-        (gl, crank, row_small), _ = lax.scan(
-            cstep,
-            (jnp.zeros(n, jnp.bool_), jnp.full(n, KCAP, jnp.int32),
-             jnp.zeros(n, jnp.bool_)),
-            jnp.arange(KCAP, dtype=jnp.int32))
+            (gl, crank, row_small), _ = lax.scan(
+                cstep,
+                (jnp.zeros(n, jnp.bool_), jnp.full(n, KCAP, jnp.int32),
+                 jnp.zeros(n, jnp.bool_)),
+                jnp.arange(KCAP, dtype=jnp.int32))
 
         # smaller-child segment histograms: one sorted-arena pass for the
         # whole candidate batch (slot r = the round's r-th candidate)

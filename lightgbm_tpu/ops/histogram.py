@@ -427,7 +427,8 @@ def segment_histogram(
     return hist.reshape(S + 1, F, B, 3)[:S].transpose(0, 3, 1, 2)
 
 
-def take_from_table(table: jax.Array, idx: jax.Array) -> jax.Array:
+def take_from_table(table: jax.Array, idx: jax.Array,
+                    leading: bool = False) -> jax.Array:
     """``table[idx]`` for a SMALL table and a huge ``idx`` vector.
 
     On this TPU backend an [n]-sized gather from even a tiny table lowers
@@ -439,13 +440,18 @@ def take_from_table(table: jax.Array, idx: jax.Array) -> jax.Array:
     accumulation ordering to worry about).
 
     ``table`` may be [L] or [L, k]; returns idx.shape (+ [k]) in
-    table.dtype.  Falls back to a plain gather off-accelerator or when
-    ``LGBM_TPU_TABLE_MATMUL=0``.
+    table.dtype — or, with ``leading=True`` (and a 2-D table), [k] +
+    idx.shape: the component-leading layout that avoids the [n, k]
+    lane-padding tax for huge idx (see LAYOUT DOCTRINE).  Falls back to a
+    plain gather off-accelerator or when ``LGBM_TPU_TABLE_MATMUL=0``.
     """
     if (not on_accelerator()
             or os.environ.get("LGBM_TPU_TABLE_MATMUL") == "0"
             or not jnp.issubdtype(table.dtype, jnp.floating)):
-        return table[idx]
+        out = table[idx]
+        if leading and table.ndim == 2:
+            return jnp.moveaxis(out, -1, 0)
+        return out
     L = table.shape[0]
     squeeze = table.ndim == 1
     t2 = (table[:, None] if squeeze else table).astype(jnp.float32)
@@ -476,6 +482,8 @@ def take_from_table(table: jax.Array, idx: jax.Array) -> jax.Array:
     out_t = out_t.astype(table.dtype)
     if squeeze:
         return out_t[0].reshape(idx.shape)
+    if leading:
+        return out_t.reshape((k,) + idx.shape)
     return out_t.T.reshape(idx.shape + (k,))
 
 

@@ -337,3 +337,63 @@ def test_rounds_equals_serial_sorted_seghist(problem, monkeypatch):
                 np.asarray(getattr(t_s, name))[:nn],
                 np.asarray(getattr(t_r, name))[:nn], rtol=2e-4, atol=1e-5,
                 err_msg=name)
+
+
+def test_rounds_data_parallel_sorted_dispatch(problem, monkeypatch):
+    """The TPU seghist dispatch (slot-expanded pass / sorted arena, forced
+    via LGBM_TPU_SEGHIST=sorted) must agree with single-device growth when
+    psum'd under shard_map row sharding — the headline TPU configuration."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    monkeypatch.setenv("LGBM_TPU_SEGHIST", "sorted")
+    binned, grad, hess, B, F = problem
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=15, num_bins=B,
+                       hp=SplitHyperparams(min_data_in_leaf=10),
+                       hist_method="matmul_f32")
+    mask = np.ones(len(grad), np.float32)
+    ref_tree, ref_leaf = grow_tree_rounds(
+        jnp.asarray(binned.T), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), meta, cfg)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    sharded = jax.shard_map(
+        lambda b, g, h, m: grow_tree_rounds(b, g, h, m, meta, cfg,
+                                            axis_name="d"),
+        mesh=mesh, in_specs=(P(None, "d"), P("d"), P("d"), P("d")),
+        out_specs=(P(), P("d")), check_vma=False)
+    tree, leaf_id = jax.jit(sharded)(
+        np.ascontiguousarray(binned.T), grad, hess, mask)
+
+    nl = int(ref_tree.num_leaves)
+    assert int(tree.num_leaves) == nl
+    np.testing.assert_array_equal(np.asarray(tree.split_feature[:nl - 1]),
+                                  np.asarray(ref_tree.split_feature[:nl - 1]))
+    np.testing.assert_allclose(np.asarray(tree.leaf_value[:nl]),
+                               np.asarray(ref_tree.leaf_value[:nl]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(leaf_id), np.asarray(ref_leaf))
+
+
+def test_router_matmul_matches_scan(problem, monkeypatch):
+    """The router-matmul candidate routing (one-hot table lookup +
+    select-reduce bin read) must produce the identical tree to the
+    candidate scan it replaces."""
+    binned, grad, hess, B, F = problem
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=31, num_bins=B,
+                       hp=SplitHyperparams(min_data_in_leaf=10),
+                       hist_method="matmul_f32")
+    mask = np.ones(len(grad), np.float32)
+    monkeypatch.setenv("LGBM_TPU_SEGHIST", "sorted")
+    monkeypatch.setenv("LGBM_TPU_ROUTER", "0")
+    t_scan, lid_scan = grow_tree_rounds(
+        jnp.asarray(binned.T), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), meta, cfg)
+    monkeypatch.setenv("LGBM_TPU_ROUTER", "1")
+    t_rt, lid_rt = grow_tree_rounds(
+        jnp.asarray(binned.T), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), meta, cfg)
+    _assert_trees_equal(t_scan, t_rt)
+    np.testing.assert_array_equal(np.asarray(lid_scan), np.asarray(lid_rt))
