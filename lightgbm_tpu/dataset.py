@@ -393,9 +393,15 @@ class Dataset:
         forced_bounds = _load_forced_bins(p, self.num_total_features)
         total_sample_cnt = len(sample_idx)
         sample_nonzero = {}               # used-feature pos -> bool [S]
+        # one row-gather of the whole sample block: per-feature strided
+        # column gathers from the [n, F] matrix cost ~7 s at 968 features
+        # (profiled); a [S, F] contiguous block makes them slices
+        sraw = (np.ascontiguousarray(raw[sample_idx])
+                if raw is not None else None)
         self.bin_mappers = []
         for f in range(self.num_total_features):
-            col = _get_col(raw, sp, f, sample_idx)
+            col = _get_col(sraw, sp, f,
+                           None if sraw is not None else sample_idx)
             # keep NaN and non-zero samples; zeros are implicit
             keep = np.isnan(col) | (np.abs(col) > 1e-35)
             vals = col[keep]
@@ -429,7 +435,8 @@ class Dataset:
         # EFB grouping from the sample (reference: FindGroups /
         # FastFeatureBundling, dataset.cpp:97-313)
         for j, f in enumerate(self.used_features):
-            col = _get_col(raw, sp, f, sample_idx)
+            col = _get_col(sraw, sp, f,
+                           None if sraw is not None else sample_idx)
             # NaN counts as non-default: a NaN row occupies the
             # feature's NaN bin in the merged column, so it can
             # conflict with other bundle members (reference counts
