@@ -445,17 +445,39 @@ class Dataset:
         self._build_groups(sample_nonzero, total_sample_cnt)
 
     def _bin_block(self, raw, sp, out: np.ndarray) -> None:
-        """Bin a block of raw rows into ``out`` (a [rows, G] uint view)."""
+        """Bin a block of raw rows into ``out`` (a [rows, G] uint view).
+
+        Parallelized over GROUPS (numpy's searchsorted releases the GIL;
+        the reference's second pass is likewise OpenMP row-parallel,
+        dataset_loader.cpp ExtractFeaturesFromFile).  Bundle members share
+        an output column and EFB tolerates bounded conflicts where write
+        ORDER is observable, so each group's features stay serial within
+        one task — output columns are disjoint across tasks.
+        """
         dtype = out.dtype
+        by_group: Dict[int, list] = {}
         for j, f in enumerate(self.used_features):
-            col = _get_col(raw, sp, f, None)
-            bins = self.bin_mappers[f].value_to_bin(col)
-            g, start = int(self.feat_group[j]), int(self.feat_start[j])
-            if start == 1 and self._group_size[g] == 1:
-                out[:, g] = bins.astype(dtype)
-            else:
-                nz = bins != 0       # bundled features are zero-default
-                out[nz, g] = (start + bins[nz] - 1).astype(dtype)
+            by_group.setdefault(int(self.feat_group[j]), []).append((j, f))
+
+        def run_group(g, members):
+            for j, f in members:
+                col = _get_col(raw, sp, f, None)
+                bins = self.bin_mappers[f].value_to_bin(col)
+                start = int(self.feat_start[j])
+                if start == 1 and self._group_size[g] == 1:
+                    out[:, g] = bins.astype(dtype)
+                else:
+                    nz = bins != 0   # bundled features are zero-default
+                    out[nz, g] = (start + bins[nz] - 1).astype(dtype)
+
+        if len(by_group) > 1 and out.shape[0] * len(self.used_features) > (1 << 22):
+            from concurrent.futures import ThreadPoolExecutor
+            workers = min(8, len(by_group), os.cpu_count() or 1)
+            with ThreadPoolExecutor(max_workers=workers) as ex:
+                list(ex.map(lambda kv: run_group(*kv), by_group.items()))
+        else:
+            for g, members in by_group.items():
+                run_group(g, members)
 
     # -- streaming construction (reference: LGBM_DatasetCreateFromSampledColumn
     #    + LGBM_DatasetPushRows / PushRowsByCSR, c_api.h:98-144) -------------
