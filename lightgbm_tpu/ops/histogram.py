@@ -319,8 +319,9 @@ def measured_best_method(n: int, num_features: int, num_bins: int,
     return winner
 
 
-def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS) -> list:
-    """Descending power-of-two-ish capacities n, n/2, ... >= min_cap.
+def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS,
+                      step: int = 4) -> list:
+    """Descending capacities n, n/step, ... >= min_cap.
 
     Trace-time constants for the bucketed compaction below.  The smaller
     child of a split never exceeds n/2 rows, and leaf sizes shrink roughly
@@ -330,10 +331,15 @@ def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS) -> list:
     (src/io/dataset.cpp:1318-1333) without data-dependent shapes.
 
     The ladder stops at ``max(min_cap, n/256)``: every rung is a compiled
-    branch of a ``lax.switch`` (XLA compile time scales with them), and a
-    histogram pass over n/256 rows is already noise next to the per-loop-
-    step overhead the compaction exists to avoid.
+    branch of a ``lax.switch`` (XLA compile time — and the remote compile
+    service's appetite — scales with them), and a histogram pass over
+    n/256 rows is already noise next to the per-loop-step overhead the
+    compaction exists to avoid.  ``step=4`` (default) keeps the rung
+    count at ~4 for 11M rows: a rung overshoots the live set by at most
+    4x, a bounded waste the slot-expanded pass has made cheap, while the
+    branch count stays compile-friendly.
     """
+    step = max(int(step), 2)
     min_cap = max(min_cap, _pad_rows(max(n, 1), min_cap) // 256)
     caps = []
     c = _pad_rows(n, min_cap)
@@ -341,7 +347,7 @@ def capacity_schedule(n: int, min_cap: int = _DEFAULT_BLOCK_ROWS) -> list:
         caps.append(c)
         if c == min_cap:
             break
-        c = _pad_rows((c + 1) // 2, min_cap)
+        c = _pad_rows((c + step - 1) // step, min_cap)
         if caps and c == caps[-1]:
             break
     if not caps:

@@ -166,6 +166,45 @@ def main():
                b, gg, hh, ww, s, 128, 64)), binned, g, h, w, slot, reps=3)
     del binned, g, h, w, slot
 
+    # ---- round-5b kernels: slot-expanded segment histogram, sorted
+    # arena (new layout), router table matmul — at bench-relevant shapes
+    from lightgbm_tpu.ops.histogram import (segment_histogram_expanded,
+                                            segment_histogram_sorted,
+                                            pack_cols_u32, take_from_table,
+                                            capacity_schedule)
+    for n in (1_000_000, 11_000_000):
+        tag = f"{n//1_000_000}m"
+        binned = jnp.asarray(rng.randint(0, 63, (28, n)).astype(np.uint8))
+        g = jnp.asarray(rng.randn(n).astype(np.float32))
+        h = jnp.abs(g) + 0.1
+        w = jnp.ones((n,), jnp.float32)
+        slot = jnp.asarray(rng.randint(0, 43, n).astype(np.int32))
+        timeit(f"seghist_expanded42_{tag}",
+               jax.jit(lambda b, gg, hh, ww, s: segment_histogram_expanded(
+                   b, gg, hh, ww, s, 64, live_cap=42)),
+               binned, g, h, w, slot, reps=3)
+        slot128 = jnp.asarray(rng.randint(0, 129, n).astype(np.int32))
+        caps = capacity_schedule(n)
+        words, wb = pack_cols_u32(binned, g, h, w)
+        # the pack rides as an ARGUMENT: production hoists it per tree, so
+        # the probe must isolate the arena kernel, not per-call packing
+        timeit(f"seghist_arena_t_{tag}",
+               jax.jit(lambda b, gg, hh, ww, s, wd, _c=tuple(caps),
+                       _w=wb: segment_histogram_sorted(
+                           b, gg, hh, ww, s, 128, 64, caps=list(_c),
+                           packed=(wd, _w))),
+               binned, g, h, w, slot128, words, reps=3)
+        leaf_id = jnp.asarray(rng.randint(0, 255, n).astype(np.int32))
+        tbl = jnp.asarray(rng.randn(255, 9).astype(np.float32))
+        timeit(f"table_matmul9_{tag}",
+               jax.jit(lambda t, i: take_from_table(t, i, leading=True)),
+               tbl, leaf_id, reps=3)
+        tbl1 = jnp.asarray(rng.randn(255).astype(np.float32))
+        timeit(f"table_matmul1_{tag}",
+               jax.jit(take_from_table), tbl1, leaf_id, reps=3)
+        del binned, g, h, w, slot, slot128, words, leaf_id
+    del tbl, tbl1
+
     # ---- while_loop per-step overhead: tiny body, 1000 steps
     def loop_tiny(x):
         def body(c):
