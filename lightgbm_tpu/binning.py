@@ -57,6 +57,26 @@ def _check_double_equal_ordered(a: float, b: float) -> bool:
     return b <= math.nextafter(a, math.inf)
 
 
+def _greedy_find_bin_native(distinct_values, counts, max_bin, total_cnt,
+                            min_data_in_bin):
+    """Native GreedyFindBin (native/findbin.cpp); None if lib unavailable."""
+    from .native.build import load_native_lib
+    lib = load_native_lib()
+    if lib is None or not hasattr(lib, "lgbt_greedy_find_bin"):
+        return None
+    import ctypes
+    dv = np.ascontiguousarray(distinct_values, dtype=np.float64)
+    ct = np.ascontiguousarray(counts, dtype=np.int64)
+    out = np.empty(max(max_bin, 1), np.float64)
+    n = lib.lgbt_greedy_find_bin(
+        dv.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ct.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(len(dv)), ctypes.c_int(int(max_bin)),
+        ctypes.c_int64(int(total_cnt)), ctypes.c_int(int(min_data_in_bin)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out[:n].tolist()
+
+
 def greedy_find_bin(
     distinct_values: np.ndarray,
     counts: np.ndarray,
@@ -67,8 +87,17 @@ def greedy_find_bin(
     """Equal-ish-frequency bin boundaries over sorted distinct values.
 
     reference: GreedyFindBin (src/io/bin.cpp:77-155).  Returns the list of
-    bin upper bounds, last element is +inf.
+    bin upper bounds, last element is +inf.  The greedy scan is
+    sequential over up to the sampled distinct-value count; the native
+    implementation (native/findbin.cpp, identical float semantics) does
+    it at C speed, with this Python body as the fallback and the
+    equivalence pinned by tests/test_binning.py.
     """
+    if len(distinct_values) > 512 and max_bin > 0:
+        native = _greedy_find_bin_native(distinct_values, counts, max_bin,
+                                         total_cnt, min_data_in_bin)
+        if native is not None:
+            return native
     num_distinct_values = len(distinct_values)
     bin_upper_bound: List[float] = []
     assert max_bin > 0

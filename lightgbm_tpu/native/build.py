@@ -48,7 +48,7 @@ def _host_tag() -> str:
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, f"_liblgbt_{_host_tag()}.so")
-_SOURCES = ["predictor.cpp"]
+_SOURCES = ["predictor.cpp", "findbin.cpp"]
 
 _lock = threading.Lock()
 _lib = None
