@@ -603,19 +603,38 @@ class Dataset:
 
         groups: List[List[int]] = []       # positions (into used_features)
         group_nz: List[np.ndarray] = []    # bool [S] union of nonzeros
+        group_cnt: List[int] = []          # popcount of the union
         group_conflict: List[int] = []
         group_bins: List[int] = []         # 1 + sum(nb_f - 1)
-        eligible.sort(key=lambda j: int(sample_nonzero[j].sum()), reverse=True)
+        nz_cnt = {j: int(sample_nonzero[j].sum()) for j in eligible}
+        eligible.sort(key=lambda j: nz_cnt[j], reverse=True)
+        # bounded search, like the reference: at most max_search_group
+        # groups are probed per feature (dataset.cpp FindGroups samples
+        # kMaxSearchGroup candidates), and a group is only probed when the
+        # PIGEONHOLE lower bound on overlap — cnt_j + cnt_g - S — leaves
+        # the budget reachable.  Without these, 2000 dense features cost
+        # O(F^2 * S) boolean ANDs (measured: minutes at Epsilon shape).
+        max_search_group = 100
         for j in eligible:
             nz = sample_nonzero[j]
+            cnt_j = nz_cnt[j]
             nb = self.bin_mappers[self.used_features[j]].num_bin
             placed = False
+            searched = 0
             for gi in range(len(groups)):
-                conflict = int((group_nz[gi] & nz).sum())
-                if (group_conflict[gi] + conflict <= budget
-                        and group_bins[gi] + nb - 1 <= 256):
+                if searched >= max_search_group:
+                    break
+                if group_bins[gi] + nb - 1 > 256:
+                    continue
+                lower = max(0, cnt_j + group_cnt[gi] - total_sample_cnt)
+                if group_conflict[gi] + lower > budget:
+                    continue
+                searched += 1
+                conflict = int(np.count_nonzero(group_nz[gi] & nz))
+                if group_conflict[gi] + conflict <= budget:
                     groups[gi].append(j)
                     group_nz[gi] = group_nz[gi] | nz
+                    group_cnt[gi] = group_cnt[gi] + cnt_j - conflict
                     group_conflict[gi] += conflict
                     group_bins[gi] += nb - 1
                     placed = True
@@ -623,6 +642,7 @@ class Dataset:
             if not placed:
                 groups.append([j])
                 group_nz.append(nz.copy())
+                group_cnt.append(cnt_j)
                 group_conflict.append(0)
                 group_bins.append(1 + (nb - 1))
 
