@@ -146,8 +146,10 @@ def test_segment_histogram_sorted_matches_scatter():
                                             segment_histogram_sorted)
     rng = np.random.RandomState(11)
     for n, F, S, B in [(10_000, 28, 128, 64), (5_000, 7, 16, 32),
-                       (777, 3, 4, 8), (1000, 5, 1, 8)]:
-        binned = jnp.asarray(rng.randint(0, B - 1, (F, n)).astype(np.uint8))
+                       (777, 3, 4, 8), (1000, 5, 1, 8),
+                       (3_000, 4, 8, 300)]:   # u16 bins: no packing
+        dt = np.uint8 if B <= 256 else np.uint16
+        binned = jnp.asarray(rng.randint(0, B - 1, (F, n)).astype(dt))
         g = jnp.asarray(rng.randn(n).astype(np.float32))
         h = jnp.abs(g) + 0.1
         w = jnp.asarray((rng.rand(n) > 0.3).astype(np.float32) * 1.5)
