@@ -6,7 +6,7 @@
 # success signal).
 cd /root/repo
 i=0
-while [ $i -lt 12 ]; do
+while [ $i -lt ${TPU_RETRY_MAX:-12} ]; do
     i=$((i+1))
     out=/root/repo/tpu_measure_r5_att$i.json
     echo "[tpu_retry] attempt $i $(date -u +%H:%M:%S)"
@@ -17,5 +17,5 @@ while [ $i -lt 12 ]; do
         echo "[tpu_retry] attempt $i banked a complete session; stopping"
         break
     fi
-    sleep 90
+    sleep ${TPU_RETRY_SLEEP:-90}
 done
