@@ -359,40 +359,37 @@ class BinMapper:
         if len(values):
             newgrp = values[1:] > np.nextafter(values[:-1], np.inf)
             ends = np.append(np.nonzero(newgrp)[0], len(values) - 1)
-            dvals = values[ends]                        # last member of group
-            cnts = np.diff(np.append(-1, ends))
-            distinct_values = dvals.tolist()
-            counts = cnts.tolist()
+            dv = values[ends]                           # last member of group
+            ct = np.diff(np.append(-1, ends)).astype(np.int64)
             # splice the implicit-zeros group at its sorted position,
             # mirroring the scalar loop exactly: before everything only
             # when zero_cnt > 0; BETWEEN a negative and a positive group
             # unconditionally (the loop inserts a zero-count group there
             # too); after everything only when zero_cnt > 0.  Sampled
             # values have |v| > kZeroThreshold, so no group spans zero.
+            # (arrays end to end — the former .tolist()/.insert round-trip
+            # of 200k-element vectors was a measured ~40% of find_bin)
+            zpos = None
             if values[0] > 0.0:
                 if zero_cnt > 0:
-                    distinct_values.insert(0, 0.0)
-                    counts.insert(0, zero_cnt)
+                    zpos = 0
             elif values[-1] < 0.0:
                 if zero_cnt > 0:
-                    distinct_values.append(0.0)
-                    counts.append(zero_cnt)
-            elif dvals[0] < 0.0 and dvals[-1] > 0.0:
-                zpos = int(np.searchsorted(dvals, 0.0))
-                distinct_values.insert(zpos, 0.0)
-                counts.insert(zpos, zero_cnt)
+                    zpos = len(dv)
+            elif dv[0] < 0.0 and dv[-1] > 0.0:
+                zpos = int(np.searchsorted(dv, 0.0))
+            if zpos is not None:
+                dv = np.insert(dv, zpos, 0.0)
+                ct = np.insert(ct, zpos, zero_cnt)
         else:
-            distinct_values = [0.0]
-            counts = [zero_cnt]
+            dv = np.array([0.0], np.float64)
+            ct = np.array([zero_cnt], np.int64)
 
-        if not distinct_values:
-            self.num_bin = 1
-            self.is_trivial = True
-            return
-        self.min_val = distinct_values[0]
-        self.max_val = distinct_values[-1]
-        dv = np.asarray(distinct_values, dtype=np.float64)
-        ct = np.asarray(counts, dtype=np.int64)
+        # dv is never empty here: the grouped branch always yields at
+        # least one group and the empty-values branch builds the zero
+        # group explicitly
+        self.min_val = float(dv[0])
+        self.max_val = float(dv[-1])
         num_distinct_values = len(dv)
         cnt_in_bin: List[int] = []
 
