@@ -11,6 +11,7 @@ split thresholds and model text are cross-compatible.
 
 from __future__ import annotations
 
+import ctypes
 import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -64,7 +65,6 @@ def _greedy_find_bin_native(distinct_values, counts, max_bin, total_cnt,
     lib = load_native_lib()
     if lib is None or not hasattr(lib, "lgbt_greedy_find_bin"):
         return None
-    import ctypes
     dv = np.ascontiguousarray(distinct_values, dtype=np.float64)
     ct = np.ascontiguousarray(counts, dtype=np.int64)
     out = np.empty(max(max_bin, 1), np.float64)
