@@ -410,15 +410,86 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
     return result
 
 
+def run_serving_bench(n_train=100_000, trees=50, leaves=63, max_bin=63,
+                      n_requests=600, n_threads=8, max_request_rows=700,
+                      max_batch_rows=1024):
+    """Serving-throughput metric: train a small booster, stand up the
+    in-process server (lightgbm_tpu/serving/), fire mixed-shape requests
+    from concurrent threads, report rows/s + latency + batching telemetry.
+
+    Emitted alongside the training numbers: the ROADMAP north star is
+    "serves heavy traffic", and this is the request-path half of it —
+    micro-batched, shape-bucketed DeviceForest inference, so after
+    warmup the accelerator sees only pre-compiled bucket shapes.
+    """
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving.loadgen import fire_requests
+
+    rng = np.random.RandomState(0)
+    f = F
+    X = rng.randn(n_train, f).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    booster = lgb.train(
+        {"objective": "binary", "verbosity": -1, "num_leaves": leaves,
+         "max_bin": max_bin},
+        lgb.Dataset(X, label=y), num_boost_round=trees, verbose_eval=False)
+    del X
+
+    server = booster.serve(max_batch_rows=max_batch_rows,
+                           batch_window_ms=2.0)
+    # warmup: compile every bucket before the clock starts — off the
+    # request path, so the latency/batch metrics report steady-state
+    # serving only (no compile-time traffic)
+    server.warm()
+    storm = fire_requests(server, n_requests, n_threads,
+                          max_request_rows, f)
+    m = server.metrics_dict()
+    server.close()
+    lat = m["histograms"].get("request_latency_ms", {})
+    fill = m["histograms"].get("batch_fill_ratio", {})
+    c = m["counters"]
+    wall = storm["wall_seconds"]
+    out = {
+        "requests": storm["requests"],
+        "rows": storm["rows"],
+        "trees": trees,
+        "wall_seconds": round(wall, 3),
+        "rows_per_second": round(storm["rows"] / wall, 1),
+        "request_latency_ms_mean": lat.get("mean"),
+        "request_latency_ms_max": lat.get("max"),
+        "batch_fill_ratio_mean": fill.get("mean"),
+        "batches": c.get("batches_total"),
+        "multi_submitter_batches": c.get("multi_submitter_batches"),
+        "compile_events": c.get("compile_events"),
+        "bucket_hits": c.get("bucket_hits"),
+    }
+    if storm["errors"]:
+        out["worker_errors"] = storm["errors"]
+    return out
+
+
 # the descending program-variant ladder for hung remote compiles: each
 # entry is an env-gate set the growers read at TRACE time (grower_rounds
 # .py use_pack, ops/histogram.py compacted_segment_histogram).  SINGLE
 # SOURCE — tools/tpu_measure.py and tools/tpu_bisect.py import this list.
-COMPILE_VARIANT_ENVS = [
-    {},
-    {"LGBM_TPU_SMALL_ROUNDS": "0"},
-    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"},
+# Every entry FULLY specifies every gate (as tpu_bisect's merged dict
+# does): the ladder is applied with os.environ.update, so a partial v0
+# after a stripped variant would silently inherit the stripped gates and
+# mislabel the banked result (ADVICE.md round 5).  Non-stripped slots are
+# seeded from the operator's environment at startup, so an explicit
+# `LGBM_TPU_PACK=0 python bench.py` is honored from attempt 0 instead of
+# being clobbered back to the default.
+_VARIANT_LADDER = [
+    {"LGBM_TPU_SMALL_ROUNDS": os.environ.get("LGBM_TPU_SMALL_ROUNDS", "1"),
+     "LGBM_TPU_PACK": os.environ.get("LGBM_TPU_PACK", "1")},  # full default
+    {"LGBM_TPU_SMALL_ROUNDS": "0",
+     "LGBM_TPU_PACK": os.environ.get("LGBM_TPU_PACK", "1")},
+    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"},    # most stripped
 ]
+# a pre-stripped operator env can make adjacent rungs identical; dedupe
+# so a hung compile never burns a stall_timeout retrying the same program
+COMPILE_VARIANT_ENVS = [e for i, e in enumerate(_VARIANT_LADDER)
+                        if i == 0 or e != _VARIANT_LADDER[i - 1]]
 
 
 # --------------------------------------------------------------- TPU worker
@@ -502,6 +573,18 @@ def tpu_worker():
             emit(r)
         except Exception as e:
             emit({"stage": "ranking", "error": str(e)[-500:]})
+
+    # serving-throughput metric (lightgbm_tpu/serving/): the request-path
+    # half of the north star, after every training number is banked
+    if os.environ.get("BENCH_SKIP_SERVING") != "1" and remaining_budget() > 300:
+        try:
+            t1 = time.time()
+            r = run_serving_bench()
+            r["stage"] = "serving"
+            r["elapsed"] = round(time.time() - t1, 1)
+            emit(r)
+        except Exception as e:
+            emit({"stage": "serving", "error": str(e)[-500:]})
     return 0
 
 
@@ -563,7 +646,17 @@ def cpu_worker():
     try:
         res = run_bench(N, TREES, LEAVES, MAX_BIN, tag="-fallback")
         res["stage"] = "cpu"
+        # emit the moment it is ready (round-4 insurance against the
+        # driver dying mid-run), THEN re-emit with serving telemetry —
+        # the driver's collect() keeps the last "cpu" line
         emit(res)
+        if os.environ.get("BENCH_SKIP_SERVING") != "1":
+            try:
+                res["serving"] = run_serving_bench(
+                    n_train=50_000, trees=30, n_requests=400, n_threads=4)
+            except Exception as e:
+                res["serving"] = {"error": str(e)[-300:]}
+            emit(res)
         return 0
     except Exception as e:
         emit({"stage": "cpu", "error": str(e)[-800:],
@@ -604,6 +697,15 @@ def _annotate(line, tpu_stages, cpu_result):
     if rank:
         line["ranking"] = {k: v for k, v in rank.items()
                            if k not in ("stage", "elapsed")}
+    serv = collect_ok(tpu_stages, "serving")
+    if serv:
+        line["serving"] = {k: v for k, v in serv.items()
+                           if k not in ("stage", "elapsed")}
+    if "serving" not in line and cpu_result and \
+            isinstance(cpu_result.get("serving"), dict) and \
+            "error" not in cpu_result["serving"]:
+        line["serving"] = dict(cpu_result["serving"],
+                               note="cpu-fallback serving numbers")
     if cpu_result and "error" not in cpu_result:
         line["cpu_reference"] = {
             "sec_per_tree": cpu_result.get("sec_per_tree"),
@@ -767,6 +869,15 @@ def main():
                      for s in reader.lines)
         if (inited and time.time() - last_progress > stall_timeout
                 and remaining_budget() > 600):
+            if have_full():
+                # the hang is in a post-full telemetry stage (ranking /
+                # serving): the training number is banked, so never
+                # relaunch hours of training for it — and never kill a
+                # post-init worker (single-tenant tunnel wedge); leave it
+                # to wind down when the parent exits
+                log(f"worker stalled {int(time.time() - last_progress)}s "
+                    "post-full (telemetry stage); stopping retries")
+                break
             if variant_idx < len(variant_envs) - 1:
                 variant_idx += 1
             else:
@@ -842,6 +953,14 @@ def main():
             cpu_result = {"error": "cpu worker produced no result"}
     if cpu_proc.poll() is None:
         cpu_proc.kill()
+        try:
+            cpu_proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+    # collect the insurance line the worker may have emitted before the
+    # kill (cpu_worker emits "cpu" the moment training lands, then
+    # re-emits with serving telemetry — either line counts)
+    poll_cpu()
 
     refresh_emission(force=True)
     full_ok = have_full()

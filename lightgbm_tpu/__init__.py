@@ -16,14 +16,15 @@ from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .dataset import Dataset
-from .engine import CVBooster, cv, train
+from . import serving  # noqa: F401  (in-process inference server)
+from .engine import CVBooster, cv, serve, train
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Dataset", "Booster", "Config", "LightGBMError", "train", "cv",
     "CVBooster", "early_stopping", "print_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "EarlyStopException", "serve", "serving",
 ]
 
 try:  # sklearn API is optional at import time

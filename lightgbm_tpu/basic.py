@@ -549,6 +549,27 @@ class Booster:
         conv = self._convert_output(raw)
         return conv[0] if (K == 1 and conv.shape[0] == 1) else conv.T
 
+    def serve(self, config=None, **overrides):
+        """In-process inference server over this model (docs/SERVING.md).
+
+        Returns a ``serving.Server``: thread-safe ``submit``/``predict``
+        with micro-batching into power-of-two shape buckets, per-request
+        deadlines, queue backpressure, atomic model hot-swap
+        (``swap_model``), a JSON-dumpable metrics registry, and graceful
+        drain on ``close()``.  Keyword overrides populate a
+        ``serving.ServingConfig`` (e.g. ``max_batch_rows=512,
+        backend="host"``).
+
+        No process boundary is crossed: where the reference serves
+        predictions through the C API from caller threads
+        (src/application/predictor.hpp row-parallel OpenMP), here
+        concurrent callers' rows are coalesced into one padded device
+        batch per bucket shape so XLA compiles once per
+        (model, bucket, num_class) and never again.
+        """
+        from .serving import Server
+        return Server(self, config=config, **overrides)
+
     def _convert_output(self, raw: np.ndarray) -> np.ndarray:
         obj = self.objective_name.split(" ")[0] if self.objective_name else ""
         if obj == "binary":

@@ -140,9 +140,11 @@ def greedy_find_bin(
         if not big_l[i]:
             rest_sample_cnt -= counts_l[i]
         cur_cnt_inbin += counts_l[i]
-        # need a new bin: reference keeps `mean_bin_size * 0.5f` as float32
+        # need a new bin: the reference's `std::max(1.0, mean_bin_size *
+        # 0.5f)` promotes to DOUBLE (double * float -> double), so the
+        # half-mean trigger compares at double precision (ADVICE.md r5)
         if big_l[i] or cur_cnt_inbin >= mean_bin_size or (
-            big_l[i + 1] and cur_cnt_inbin >= max(1.0, np.float32(mean_bin_size * 0.5))
+            big_l[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5)
         ):
             upper_bounds[bin_cnt] = vals_l[i]
             bin_cnt += 1

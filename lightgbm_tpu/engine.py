@@ -345,3 +345,15 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
     if return_cvbooster:
         out["cvbooster"] = cvbooster
     return out
+
+
+def serve(model, config=None, **overrides):
+    """Construct a serving.Server from a Booster or a model-file path.
+
+    The module-level twin of ``Booster.serve`` (docs/SERVING.md) so a
+    deployment can go file -> server in one call::
+
+        server = lgb.serve("model.txt", max_batch_rows=512)
+    """
+    from .serving import Server
+    return Server(Server._as_booster(model), config=config, **overrides)

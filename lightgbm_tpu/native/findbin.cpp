@@ -11,8 +11,9 @@
 // Float semantics mirrored exactly:
 //  - bound = nextafter((upper + lower) / 2, +inf)
 //  - dedup: CheckDoubleEqualOrdered(a, b) == (b <= nextafter(a, +inf))
-//  - the "half mean bin" trigger casts mean_bin_size * 0.5 to float32
-//    (the reference keeps it in a float local)
+//  - the "half mean bin" trigger compares at DOUBLE precision (the
+//    reference's std::max(1.0, mean_bin_size * 0.5f) promotes:
+//    double * float -> double)
 
 #include <cmath>
 #include <cstdint>
@@ -81,13 +82,13 @@ int lgbt_greedy_find_bin(const double* distinct_values,
     for (int64_t i = 0; i < num_distinct - 1; ++i) {
         if (!is_big[i]) rest_sample_cnt -= counts[i];
         cur_cnt_inbin += counts[i];
-        float half = (float)(mean_bin_size * 0.5);    // reference float local
-        if (half < 1.0f) half = 1.0f;
-        // the half-mean compare runs at FLOAT precision (the Python
-        // mirror's NumPy promotion does too): counts past 2^24 must
-        // round identically on both paths
+        // the reference's std::max(1.0, mean_bin_size * 0.5f) promotes
+        // to DOUBLE (double * float -> double), so the half-mean compare
+        // runs at double precision — mirrored by binning.py
+        double half = mean_bin_size * 0.5;
+        if (half < 1.0) half = 1.0;
         if (is_big[i] || (double)cur_cnt_inbin >= mean_bin_size ||
-            (is_big[i + 1] && (float)cur_cnt_inbin >= half)) {
+            (is_big[i + 1] && (double)cur_cnt_inbin >= half)) {
             upper[bin_cnt] = distinct_values[i];
             ++bin_cnt;
             lower[bin_cnt] = distinct_values[i + 1];

@@ -37,6 +37,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # rows per block of the one-hot matmul; 8 sublanes * 128 lanes friendly
@@ -433,6 +434,72 @@ def segment_histogram(
     return hist.reshape(S + 1, F, B, 3)[:S].transpose(0, 3, 1, 2)
 
 
+# one-time per-backend verdict of the table-matmul exactness probe:
+# {backend_name: bool}.  Populated lazily by _table_matmul_verified.
+_TABLE_MATMUL_PROBE: dict = {}
+
+
+def _table_matmul_probe() -> bool:
+    """Run the one-hot table matmul ON THE LIVE BACKEND and compare it
+    bitwise against a host-side plain gather.
+
+    The matmul path's exactness claim (one nonzero per one-hot row, so
+    each output is a single f32 product that precision=HIGHEST must
+    round-trip) is only TESTED on CPU (test_histogram.py monkeypatches
+    on_accelerator); leaf values ride this kernel into train scores and
+    predictions, so an accelerator where HIGHEST is not bit-exact would
+    silently perturb every prediction (ADVICE.md round 5).  The probe
+    covers both the single-block and the lax.scan-blocked variant (via a
+    shrunken block size) and every table entry, with awkward magnitudes
+    across the full NORMAL f32 range (tiny, huge, negatives, zeros).
+    Subnormals are deliberately excluded: XLA's dot kernels flush them to
+    zero on every backend (measured here even on CPU), and table entries
+    — leaf values, per-leaf stat rows — are normal-range by construction,
+    so failing the probe on an irrelevant domain would cost the MXU path
+    for nothing.  Any mismatch — or any crash — demotes the backend to
+    the plain gather, equivalent to LGBM_TPU_TABLE_MATMUL=0.
+    """
+    rng = np.random.RandomState(7)
+    vals = np.concatenate([
+        rng.standard_normal(40),
+        10.0 ** rng.uniform(-37, 38, 20),
+        -(10.0 ** rng.uniform(-37, 38, 20)),
+        np.array([0.0, -0.0, 1.2e-38, -1.2e-38, np.float32(np.pi), 3e38]),
+    ]).astype(np.float32)
+    L = len(vals)
+    idx = np.concatenate([np.arange(L), rng.randint(0, L, 4 * L)]) \
+        .astype(np.int32)
+    want = vals[idx]
+    try:
+        got1 = np.asarray(_take_matmul(jnp.asarray(vals), jnp.asarray(idx),
+                                       leading=False))
+        got2 = np.asarray(_take_matmul(jnp.asarray(vals), jnp.asarray(idx),
+                                       leading=False, block=64))
+        ok = (np.array_equal(got1, want) and np.array_equal(got2, want))
+    except Exception:
+        ok = False
+    return ok
+
+
+def _table_matmul_verified() -> bool:
+    """True iff the one-hot table matmul is bit-exact on this backend
+    (probed once per backend name, at first accelerator use)."""
+    backend = jax.default_backend()
+    ok = _TABLE_MATMUL_PROBE.get(backend)
+    if ok is None:
+        # eager probe on concrete arrays: safe to run even while another
+        # function is being traced (nothing here consumes tracers)
+        ok = _table_matmul_probe()
+        _TABLE_MATMUL_PROBE[backend] = ok
+        if not ok:
+            import warnings
+            warnings.warn(
+                f"take_from_table: one-hot matmul is NOT bit-exact on "
+                f"backend {backend!r}; falling back to plain gather "
+                "(equivalent to LGBM_TPU_TABLE_MATMUL=0)")
+    return ok
+
+
 def take_from_table(table: jax.Array, idx: jax.Array,
                     leading: bool = False) -> jax.Array:
     """``table[idx]`` for a SMALL table and a huge ``idx`` vector.
@@ -443,21 +510,32 @@ def take_from_table(table: jax.Array, idx: jax.Array,
     one-hot has exactly one nonzero per row, so each output is a single
     product — numerically EXACT in f32 under precision=HIGHEST (XLA's
     bf16x3 expansion round-trips f32 multiplicands exactly; there is no
-    accumulation ordering to worry about).
+    accumulation ordering to worry about).  That claim is VERIFIED on the
+    live backend by a one-time probe at first use
+    (``_table_matmul_verified``); a backend that fails it serves plain
+    gathers instead of silently perturbing predictions.
 
     ``table`` may be [L] or [L, k]; returns idx.shape (+ [k]) in
     table.dtype — or, with ``leading=True`` (and a 2-D table), [k] +
     idx.shape: the component-leading layout that avoids the [n, k]
     lane-padding tax for huge idx (see LAYOUT DOCTRINE).  Falls back to a
-    plain gather off-accelerator or when ``LGBM_TPU_TABLE_MATMUL=0``.
+    plain gather off-accelerator, when ``LGBM_TPU_TABLE_MATMUL=0``, or
+    when the probe failed.
     """
     if (not on_accelerator()
             or os.environ.get("LGBM_TPU_TABLE_MATMUL") == "0"
-            or not jnp.issubdtype(table.dtype, jnp.floating)):
+            or not jnp.issubdtype(table.dtype, jnp.floating)
+            or not _table_matmul_verified()):
         out = table[idx]
         if leading and table.ndim == 2:
             return jnp.moveaxis(out, -1, 0)
         return out
+    return _take_matmul(table, idx, leading)
+
+
+def _take_matmul(table: jax.Array, idx: jax.Array, leading: bool = False,
+                 block: int = 65536) -> jax.Array:
+    """The MXU one-hot formulation of ``take_from_table`` (no dispatch)."""
     L = table.shape[0]
     squeeze = table.ndim == 1
     t2 = (table[:, None] if squeeze else table).astype(jnp.float32)
@@ -469,7 +547,7 @@ def take_from_table(table: jax.Array, idx: jax.Array,
     # (dot operands are not producer-fused) — exactly the lane-padded-HBM
     # class of failure this module's layout doctrine exists to avoid
     k = t2.shape[1]
-    C = 65536
+    C = block
     if n <= C:
         # [k, L] @ [L, n] keeps every intermediate k-leading (minor dim n)
         oh = (iota_L[:, None] == flat[None, :]).astype(jnp.float32)

@@ -341,6 +341,34 @@ class DeviceForest:
         node = lax.while_loop(cond, body, jnp.zeros((T, nc), jnp.int32))
         return ~node
 
+    def predict_raw_padded(self, Xpad: np.ndarray,
+                           num_class: int = 1) -> np.ndarray:
+        """Raw scores [K, rows] for ONE already-padded, bucket-shaped
+        batch — the serving subsystem's entry point (serving/registry.py).
+
+        Unlike ``predict_raw`` there is no internal chunking or padding:
+        the caller owns the shape, so ``jax.jit`` holds exactly one
+        executable per distinct (rows, features) it ever passes — the
+        shape-bucket ladder guarantees that set stays tiny.
+
+        Routing runs on device; leaf-value accumulation happens on the
+        HOST in float64, with the same gather + ``sum(axis=0)`` (a
+        sequential reduction over the leading axis in NumPy) that
+        ``StackedForest.predict_raw`` uses — so for float32-precision
+        feature values the output is bit-identical to the offline host
+        path, padding rows included-then-sliced notwithstanding.
+        """
+        import jax.numpy as jnp
+        leaves = np.asarray(self._leaves_jit(
+            jnp.asarray(np.asarray(Xpad, np.float32))))      # [T, rows]
+        f = self.forest
+        K = max(num_class, 1)
+        iters = f.num_trees // K
+        rows = leaves.shape[1]
+        tid = np.arange(f.num_trees)
+        lv = f.leaf_value[tid[:, None], leaves]              # [T, rows] f64
+        return lv.reshape(iters, K, rows).sum(axis=0)        # [K, rows]
+
     def predict_raw(self, X: np.ndarray, num_class: int = 1) -> np.ndarray:
         """Summed raw scores [K, n] (float32 accumulation on device)."""
         import jax.numpy as jnp

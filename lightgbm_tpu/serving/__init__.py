@@ -1,0 +1,29 @@
+"""In-process serving subsystem: micro-batched, shape-bucketed forest
+inference with model hot-swap and metrics (docs/SERVING.md).
+
+Quick start::
+
+    server = booster.serve(max_batch_rows=512)     # or lgb.serve(path)
+    fut = server.submit(X)                         # thread-safe, batched
+    scores = fut.result()
+    server.swap_model("model_v2.txt")              # atomic, warm first
+    print(server.metrics_json())
+    server.close()                                 # graceful drain
+
+Module map: ``server`` (facade: submit/deadlines/backpressure/drain),
+``batcher`` (micro-batch scheduler + bucket ladder), ``registry``
+(compiled-program LRU + model hot-swap), ``metrics`` (JSON-dumpable
+instrument registry), ``errors`` (typed rejections).
+"""
+
+from .batcher import BucketLadder
+from .errors import DeadlineExceeded, QueueFull, ServerClosed, ServingError
+from .metrics import MetricsRegistry
+from .registry import CompiledModel, ModelRegistry, ProgramRegistry
+from .server import Server, ServingConfig
+
+__all__ = [
+    "Server", "ServingConfig", "BucketLadder", "MetricsRegistry",
+    "ProgramRegistry", "ModelRegistry", "CompiledModel",
+    "ServingError", "QueueFull", "DeadlineExceeded", "ServerClosed",
+]

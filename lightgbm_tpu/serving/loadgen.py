@@ -1,0 +1,64 @@
+"""Threaded mixed-shape load driver for the serving subsystem.
+
+The one request-storm implementation shared by ``bench.py``'s serving
+stage and ``tools/serve_smoke.py`` (their drivers used to be near-twins;
+a fix to one — e.g. dead-thread error accounting — kept missing the
+other).  Deliberately not a benchmark harness: it fires, optionally
+verifies bit-equality, and reports honest completed counts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def fire_requests(server, n_requests: int, n_threads: int,
+                  max_request_rows: int, num_features: int,
+                  verify_forest=None, timeout: float = 300.0) -> dict:
+    """Fire ``n_requests`` (rounded down to a multiple of ``n_threads``)
+    mixed-size requests of float32-precise rows from ``n_threads``
+    threads; return completed/row counts, wall time, and per-thread
+    errors.  With ``verify_forest`` every response is checked bit-equal
+    to ``verify_forest.predict_raw`` (the serving acceptance bar).
+    """
+    per_thread = n_requests // n_threads
+    done = [0] * n_threads
+    rows_served = [0] * n_threads
+    mismatches: list = []
+    errors: list = []
+
+    def worker(tidx: int) -> None:
+        r = np.random.RandomState(100 + tidx)
+        try:
+            for _ in range(per_thread):
+                m = int(r.randint(1, max_request_rows + 1))
+                Xr = r.randn(m, num_features).astype(np.float32) \
+                    .astype(np.float64)
+                out = server.predict(Xr, timeout=timeout)
+                rows_served[tidx] += m
+                done[tidx] += 1
+                if verify_forest is not None and not np.array_equal(
+                        out, verify_forest.predict_raw(Xr)[0]):
+                    mismatches.append((tidx, m))
+        except Exception as e:  # a dead thread must not bank clean numbers
+            errors.append(f"thread {tidx}: {type(e).__name__}: {str(e)[:200]}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {
+        "requests": sum(done),
+        "requests_planned": per_thread * n_threads,
+        "rows": sum(rows_served),
+        "wall_seconds": time.perf_counter() - t0,
+        "mismatches": mismatches,
+        "errors": errors,
+    }

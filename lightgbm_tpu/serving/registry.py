@@ -1,0 +1,258 @@
+"""Compiled-program + model registry: one executable per
+(model digest, row bucket, num_class), plus atomic model hot-swap.
+
+XLA specializes a jitted program per input shape, so the serving layer's
+job is to make sure the device only ever sees shapes from the bucket
+ladder and to know — cheaply, by key lookup — whether a (model, bucket)
+pair has been compiled before.  ``ProgramRegistry`` is that lookup: an
+LRU of predict callables keyed ``(digest, bucket_rows, num_class)``.  A
+miss builds the callable and counts a ``compile_events`` metric (the
+first invocation triggers the actual XLA compile, unless the persistent
+compilation cache already has the executable); a hit is free.  Eviction
+is bookkeeping — the underlying device executable lives in the model's
+``DeviceForest`` jit cache and is freed when the model object is
+released, not per-program.
+
+``ModelRegistry`` owns the serving pointer: ``swap()`` builds the new
+model's forests, optionally pre-runs every bucket the old model had
+warmed (in the caller's thread or a background one), then atomically
+flips ``active``.  Requests are pinned to the model they were admitted
+against at submit (server.py), so a swap never drops, corrupts, or
+generation-mixes in-flight work; the old model is garbage-collected when
+its last request completes and its programs age out of the LRU.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Optional, Set, Tuple
+
+import numpy as np
+
+
+def forest_digest(forest) -> str:
+    """Stable content hash of a StackedForest's semantic arrays."""
+    h = hashlib.sha256()
+    for a in (forest.split_feature, forest.threshold, forest.left,
+              forest.right, forest.leaf_value, forest.is_cat,
+              forest.default_left, forest.missing_type,
+              forest.cat_offset, forest.cat_nwords, forest.cat_words):
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(np.int64([forest.num_trees]).tobytes())
+    return h.hexdigest()[:16]
+
+
+class CompiledModel:
+    """One immutable loaded model: booster + host forest (+ device forest
+    for the "device" backend), its digest, and its output transform."""
+
+    def __init__(self, booster, backend: str = "device",
+                 num_iteration: Optional[int] = None,
+                 start_iteration: int = 0):
+        self.booster = booster
+        self.backend = backend
+        K = max(booster.num_tree_per_iteration, 1)
+        self.num_class = K
+        n_total_iter = len(booster.models) // K
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = (booster.best_iteration
+                             if booster.best_iteration > 0 else n_total_iter)
+        stop_iter = min(start_iteration + num_iteration, n_total_iter)
+        self.num_iterations = stop_iter - start_iteration
+        self.forest = booster._forest(start_iteration, stop_iter)
+        self.num_features = booster.num_features()
+        # share Booster.predict's cached DeviceForest: predict() then
+        # serve() on the same model must not re-trace per shape twice
+        self.device_forest = (booster._device_forest(self.forest)
+                              if backend == "device" else None)
+        self.digest = forest_digest(self.forest)
+        self.average_output = bool(getattr(booster, "average_output", False))
+
+    def make_program(self, bucket_rows: int) -> Callable:
+        """Predict callable for one bucket shape: [bucket, F] float64
+        padded batch -> raw scores [K, bucket] float64.
+
+        Both backends are bit-identical to ``StackedForest.predict_raw``
+        per row — "host" unconditionally (it IS predict_raw on the padded
+        batch; per-row work is independent of the padding rows), "device"
+        for float32-precision feature values (DeviceForest's documented
+        routing-exactness domain; leaf-value accumulation happens on the
+        host in float64 in the same order as predict_raw).
+        """
+        K = self.num_class
+        if self.backend == "host":
+            forest = self.forest
+
+            def run(Xpad: np.ndarray) -> np.ndarray:
+                return forest.predict_raw(Xpad, num_class=K)
+
+            return run
+        dev = self.device_forest
+
+        def run(Xpad: np.ndarray) -> np.ndarray:
+            return dev.predict_raw_padded(Xpad, num_class=K)
+
+        return run
+
+    def scale_raw(self, raw: np.ndarray) -> np.ndarray:
+        """The average_output division Booster.predict applies to BOTH
+        raw and transformed output (basic.py _predict_inner) — identity
+        for every boosting mode but rf."""
+        if self.average_output and self.num_iterations > 0:
+            raw = raw / self.num_iterations
+        return raw
+
+    def transform_raw(self, raw: np.ndarray) -> np.ndarray:
+        """predict()'s objective transform for ALREADY-SCALED raw
+        [K, n]; returns [K, n]."""
+        return self.booster._convert_output(raw)
+
+
+class ProgramRegistry:
+    """LRU of predict programs keyed (digest, bucket_rows, num_class)."""
+
+    def __init__(self, metrics, max_programs: int = 64):
+        self.metrics = metrics
+        self.max_programs = max_programs
+        self._lock = threading.Lock()
+        self._lru: "OrderedDict[Tuple[str, int, int], Callable]" = \
+            OrderedDict()
+        # (bucket, num_class) shapes ever served — the warm set for swaps
+        self.seen_buckets: Set[Tuple[int, int]] = set()
+
+    def get(self, model: CompiledModel, bucket_rows: int) -> Callable:
+        key = (model.digest, bucket_rows, model.num_class)
+        with self._lock:
+            prog = self._lru.get(key)
+            if prog is not None:
+                self._lru.move_to_end(key)
+                self.metrics.counter("bucket_hits").inc()
+                return prog
+        # build outside the lock (jit-wrapper creation is cheap, but the
+        # first call compiles; never serialize other buckets behind it)
+        prog = model.make_program(bucket_rows)
+        with self._lock:
+            race = self._lru.get(key)
+            if race is not None:
+                self._lru.move_to_end(key)
+                self.metrics.counter("bucket_hits").inc()
+                return race
+            self._lru[key] = prog
+            self.seen_buckets.add((bucket_rows, model.num_class))
+            self.metrics.counter("bucket_misses").inc()
+            self.metrics.counter("compile_events").inc()
+            while len(self._lru) > self.max_programs:
+                self._lru.popitem(last=False)
+                self.metrics.counter("program_evictions").inc()
+        return prog
+
+    def warm(self, model: CompiledModel,
+             buckets: Optional[Set[Tuple[int, int]]] = None) -> int:
+        """Pre-run ``model``'s program on zeros for every bucket-rows
+        value in ``buckets`` (default: every shape ever served) so the
+        XLA compile happens BEFORE the model starts taking traffic.
+        The num_class half of the seen keys is ignored — the new model's
+        own K applies, so warm still covers every bucket when a swap
+        changes the class count.  Returns the number of buckets warmed."""
+        with self._lock:
+            todo = sorted({b for b, _k in (buckets if buckets is not None
+                                           else self.seen_buckets)})
+        n = 0
+        for bucket_rows in todo:
+            prog = self.get(model, bucket_rows)
+            prog(np.zeros((bucket_rows, model.num_features), np.float64))
+            n += 1
+        return n
+
+
+class ModelRegistry:
+    """The serving pointer + hot-swap protocol."""
+
+    def __init__(self, booster, programs: ProgramRegistry, metrics,
+                 backend: str = "device",
+                 num_iteration: Optional[int] = None,
+                 start_iteration: int = 0):
+        self.programs = programs
+        self.metrics = metrics
+        self.backend = backend
+        self._swap_lock = threading.Lock()    # serializes swaps, not reads
+        self._seq_lock = threading.Lock()     # ticket allocation only
+        self._active = CompiledModel(booster, backend=backend,
+                                     num_iteration=num_iteration,
+                                     start_iteration=start_iteration)
+        metrics.gauge("active_model_digest").set(self._active.digest)
+        metrics.gauge("model_generation").set(0)
+        self._generation = 0
+        self._swap_seq = 0          # ticket order of swap() CALLS
+        self._applied_seq = 0       # highest ticket that has flipped
+
+    @property
+    def active(self) -> CompiledModel:
+        # plain attribute read: atomic under the GIL, no lock on the
+        # per-batch hot path
+        return self._active
+
+    def swap(self, booster, warm: bool = True, block: bool = True,
+             num_iteration: Optional[int] = None,
+             start_iteration: int = 0) -> "threading.Thread | None":
+        """Load ``booster`` as the new serving model.
+
+        With ``warm=True`` every bucket shape ever served is pre-compiled
+        for the new model before the pointer flips, so the first
+        post-swap batches pay no compile latency.  ``block=False`` does
+        the warm+flip in a daemon thread and returns it (the flip still
+        happens only after warmup; serving continues on the old model
+        meanwhile)."""
+        new = CompiledModel(booster, backend=self.backend,
+                            num_iteration=num_iteration,
+                            start_iteration=start_iteration)
+        # ticket taken at CALL time: two block=False swaps whose daemon
+        # threads win the lock out of order must still converge on the
+        # later call's model, not the later lock acquirer's.  Allocation
+        # uses its own lock so block=False returns immediately even while
+        # a previous swap holds _swap_lock through a long warm/compile.
+        with self._seq_lock:
+            self._swap_seq += 1
+            seq = self._swap_seq
+
+        def do_swap():
+            try:
+                with self._swap_lock:
+                    if seq < self._applied_seq:
+                        return      # a newer swap already landed
+                    if warm:
+                        self.programs.warm(new)
+                    self._applied_seq = seq
+                    self._active = new
+                    self._generation += 1
+                    self.metrics.counter("hot_swaps").inc()
+                    self.metrics.gauge("active_model_digest").set(new.digest)
+                    self.metrics.gauge("model_generation").set(
+                        self._generation)
+            except Exception:
+                # count on BOTH paths: the blocking caller sees the raise,
+                # but a dashboard reading metrics must too
+                self.metrics.counter("swap_failures").inc()
+                raise
+
+        if block:
+            do_swap()
+            return None
+
+        def do_swap_bg():
+            # a warm/compile failure must not vanish with the daemon
+            # thread: park the exception on the handle so "joined dead
+            # thread + unchanged generation" is readable as a FAILED
+            # swap, not a slow one
+            try:
+                do_swap()
+            except Exception as e:  # noqa: BLE001
+                t.exception = e
+
+        t = threading.Thread(target=do_swap_bg, name="lgbt-serving-swap",
+                             daemon=True)
+        t.exception = None
+        t.start()
+        return t

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Serving smoke: train a tiny booster, stand up the in-process server,
+fire mixed-shape requests from several threads, print the metrics JSON.
+
+The CLI twin of tests/test_serving.py::test_serving_stress — for eyeballs
+and CI logs rather than asserts.  The LAST stdout line is a single JSON
+object: throughput, latency percentiles (from the histogram buckets) and
+the full serving metrics snapshot (schema: docs/SERVING.md).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/serve_smoke.py \
+        [--requests 1000] [--threads 8] [--rows 2000] \
+        [--max-batch-rows 512] [--backend device|host] [--model model.txt]
+
+Without --model a 12-round binary booster is trained on synthetic
+float32-precise data, and every response is verified bit-equal to
+StackedForest.predict_raw (the serving acceptance bar).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=1000)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=2000,
+                    help="training rows for the synthetic booster")
+    ap.add_argument("--features", type=int, default=10)
+    ap.add_argument("--max-request-rows", type=int, default=700)
+    ap.add_argument("--max-batch-rows", type=int, default=512)
+    ap.add_argument("--batch-window-ms", type=float, default=2.0)
+    ap.add_argument("--backend", default="device",
+                    choices=["device", "host"])
+    ap.add_argument("--model", default=None,
+                    help="model file to serve (skips training + verify)")
+    args = ap.parse_args()
+
+    import lightgbm_tpu as lgb
+
+    f = args.features
+    verify_forest = None
+    if args.model:
+        booster = lgb.Booster(model_file=args.model)
+        f = booster.num_features()
+    else:
+        rng = np.random.RandomState(0)
+        X = rng.randn(args.rows, f).astype(np.float32).astype(np.float64)
+        y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+        booster = lgb.train(
+            {"objective": "binary", "verbosity": -1, "num_leaves": 31},
+            lgb.Dataset(X, label=y), num_boost_round=12, verbose_eval=False)
+        n_iter = len(booster.models) // booster.num_tree_per_iteration
+        verify_forest = booster._forest(0, n_iter)
+
+    from lightgbm_tpu.serving.loadgen import fire_requests
+
+    server = booster.serve(max_batch_rows=args.max_batch_rows,
+                           batch_window_ms=args.batch_window_ms,
+                           backend=args.backend)
+    print(f"[serve_smoke] firing "
+          f"{args.requests // args.threads * args.threads} requests "
+          f"from {args.threads} threads (backend={args.backend})",
+          flush=True)
+    storm = fire_requests(server, args.requests, args.threads,
+                          args.max_request_rows, f,
+                          verify_forest=verify_forest, timeout=120)
+    metrics = server.metrics_dict()
+    server.close()
+
+    wall = storm["wall_seconds"]
+    failed = bool(storm["mismatches"] or storm["errors"]
+                  or storm["requests"] != storm["requests_planned"])
+    result = {
+        "requests": storm["requests"],
+        "requests_planned": storm["requests_planned"],
+        "rows": storm["rows"],
+        "wall_seconds": round(wall, 3),
+        "requests_per_second": round(storm["requests"] / wall, 1),
+        "rows_per_second": round(storm["rows"] / wall, 1),
+        "bit_equal_verified": (None if verify_forest is None
+                               else not failed),
+        "mismatches": len(storm["mismatches"]),
+        "worker_errors": storm["errors"],
+        "metrics": metrics,
+    }
+    print(json.dumps(result, indent=1, sort_keys=True))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
