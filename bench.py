@@ -468,6 +468,76 @@ def run_serving_bench(n_train=100_000, trees=50, leaves=63, max_bin=63,
     return out
 
 
+def run_resilience_bench(n_train=50_000, trees=24, leaves=63, max_bin=63,
+                         snapshot_freq=8):
+    """Fault-tolerance overhead metric: checkpoint-bundle save/load
+    latency and resume bit-parity at bench scale (docs/RESILIENCE.md).
+
+    Reports what periodic checkpointing costs the training loop
+    (save_seconds covers state capture incl. the device->host score
+    fetch, sha256 manifest, atomic write) and proves the resume path on
+    THIS backend: a run killed after a bundle and resumed must produce a
+    byte-identical model.
+    """
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience import CheckpointManager, load_checkpoint
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(n_train, F)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0.8).astype(np.float32)
+    P = {"objective": "binary", "verbosity": -1, "num_leaves": leaves,
+         "max_bin": max_bin, "bagging_fraction": 0.8, "bagging_freq": 2}
+
+    with tempfile.TemporaryDirectory() as td:
+        t0 = time.time()
+        full = lgb.train(P, lgb.Dataset(X, label=y), trees,
+                         verbose_eval=False)
+        plain_s = time.time() - t0
+        full.save_model(f"{td}/full.txt")
+
+        t0 = time.time()
+        lgb.train(P, lgb.Dataset(X, label=y), trees, verbose_eval=False,
+                  snapshot_freq=snapshot_freq,
+                  snapshot_out=f"{td}/ck.txt")
+        ckpt_s = time.time() - t0
+        n_saves = trees // snapshot_freq
+
+        mgr = CheckpointManager(f"{td}/ck.txt.ckpt")
+        newest = mgr.bundles()[-1]
+        t0 = time.time()
+        ck = load_checkpoint(f"{td}/ck.txt.ckpt/{newest}")
+        load_s = time.time() - t0
+
+        die_at = max(snapshot_freq, trees // 2)
+        lgb.train(P, lgb.Dataset(X, label=y), die_at, verbose_eval=False,
+                  snapshot_freq=snapshot_freq,
+                  snapshot_out=f"{td}/part.txt")
+        t0 = time.time()
+        res = lgb.train(P, lgb.Dataset(X, label=y), trees,
+                        verbose_eval=False,
+                        resume_from=f"{td}/part.txt.ckpt")
+        resume_s = time.time() - t0
+        res.save_model(f"{td}/res.txt")
+        identical = (open(f"{td}/full.txt", "rb").read()
+                     == open(f"{td}/res.txt", "rb").read())
+        bundle_bytes = os.path.getsize(f"{td}/ck.txt.ckpt/{newest}")
+
+    return {
+        "trees": trees,
+        "rows": n_train,
+        "checkpoint_saves": n_saves,
+        "save_seconds_each": round(max(0.0, ckpt_s - plain_s)
+                                   / max(n_saves, 1), 4),
+        "bundle_load_verify_seconds": round(load_s, 4),
+        "bundle_bytes": bundle_bytes,
+        "bundle_iteration": ck.iteration,
+        "resume_wall_seconds": round(resume_s, 3),
+        "resume_bit_identical": bool(identical),
+    }
+
+
 # the descending program-variant ladder for hung remote compiles: each
 # entry is an env-gate set the growers read at TRACE time (grower_rounds
 # .py use_pack, ops/histogram.py compacted_segment_histogram).  SINGLE
@@ -585,6 +655,19 @@ def tpu_worker():
             emit(r)
         except Exception as e:
             emit({"stage": "serving", "error": str(e)[-500:]})
+
+    # fault-tolerance overhead (lightgbm_tpu/resilience/): checkpoint
+    # save/load cost + resume bit-parity on the live backend
+    if os.environ.get("BENCH_SKIP_RESILIENCE") != "1" \
+            and remaining_budget() > 240:
+        try:
+            t1 = time.time()
+            r = run_resilience_bench()
+            r["stage"] = "resilience"
+            r["elapsed"] = round(time.time() - t1, 1)
+            emit(r)
+        except Exception as e:
+            emit({"stage": "resilience", "error": str(e)[-500:]})
     return 0
 
 
@@ -657,6 +740,13 @@ def cpu_worker():
             except Exception as e:
                 res["serving"] = {"error": str(e)[-300:]}
             emit(res)
+        if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
+            try:
+                res["resilience"] = run_resilience_bench(
+                    n_train=20_000, trees=16, snapshot_freq=4)
+            except Exception as e:
+                res["resilience"] = {"error": str(e)[-300:]}
+            emit(res)
         return 0
     except Exception as e:
         emit({"stage": "cpu", "error": str(e)[-800:],
@@ -706,6 +796,15 @@ def _annotate(line, tpu_stages, cpu_result):
             "error" not in cpu_result["serving"]:
         line["serving"] = dict(cpu_result["serving"],
                                note="cpu-fallback serving numbers")
+    resil = collect_ok(tpu_stages, "resilience")
+    if resil:
+        line["resilience"] = {k: v for k, v in resil.items()
+                              if k not in ("stage", "elapsed")}
+    if "resilience" not in line and cpu_result and \
+            isinstance(cpu_result.get("resilience"), dict) and \
+            "error" not in cpu_result["resilience"]:
+        line["resilience"] = dict(cpu_result["resilience"],
+                                  note="cpu-fallback resilience numbers")
     if cpu_result and "error" not in cpu_result:
         line["cpu_reference"] = {
             "sec_per_tree": cpu_result.get("sec_per_tree"),

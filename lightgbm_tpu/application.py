@@ -93,6 +93,10 @@ class Application:
                                         p.pop("valid", "")).split(",") if v]
         output_model = p.pop("output_model", "LightGBM_model.txt")
         input_model = p.pop("input_model", None)
+        # resume_from: a checkpoint bundle or <output_model>.ckpt directory
+        # (docs/RESILIENCE.md) — restores full training state, unlike
+        # input_model's continued training
+        resume_from = p.pop("resume_from", None)
         p.pop("__config_dir__", None)
 
         cfg = Config.from_params(p)
@@ -121,6 +125,7 @@ class Application:
             verbose_eval=max(cfg.metric_freq, 1),
             snapshot_freq=cfg.snapshot_freq,
             snapshot_out=output_model,
+            resume_from=resume_from,
         )
         booster.save_model(output_model)
         log_info(f"Finished training; model saved to {output_model}")

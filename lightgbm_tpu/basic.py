@@ -831,11 +831,14 @@ class Booster:
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        # routed through the pluggable file-system seam (reference:
+        # atomic for local paths — temp sibling + os.replace, parent dirs
+        # created — so a crash mid-write can never leave a truncated model
+        # and snapshot_out into a nonexistent dir works; scheme:// paths
+        # route through the pluggable file-system seam (reference:
         # VirtualFileWriter, src/io/file_io.cpp)
-        from .utils.file_io import open_file
-        with open_file(filename, "w") as fh:
-            fh.write(self.model_to_string(num_iteration, start_iteration))
+        from .utils.file_io import write_atomic
+        write_atomic(filename,
+                     self.model_to_string(num_iteration, start_iteration))
         return self
 
     def _init_from_string(self, s: str) -> None:

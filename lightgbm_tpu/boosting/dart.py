@@ -42,6 +42,21 @@ class DART(GBDT):
         self.sum_weight = 0.0
         self._history_mode = "all"   # any this-run tree can be dropped
 
+    # -- checkpoint -------------------------------------------------------
+
+    def capture_state(self) -> dict:
+        st = super().capture_state()
+        st["drop_rng"] = self._drop_rng.get_state()
+        st["tree_weight"] = list(self.tree_weight)
+        st["sum_weight"] = float(self.sum_weight)
+        return st
+
+    def restore_state(self, st: dict) -> None:
+        super().restore_state(st)
+        self._drop_rng.set_state(st["drop_rng"])
+        self.tree_weight = list(st["tree_weight"])
+        self.sum_weight = float(st["sum_weight"])
+
     # -- helpers ----------------------------------------------------------
 
     def _tree_pred_train(self, model_idx: int) -> jax.Array:

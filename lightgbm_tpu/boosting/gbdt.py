@@ -1166,6 +1166,98 @@ class GBDT:
         self.iter += 1
         return False
 
+    # ------------------------------------------------------------ checkpoint
+
+    def capture_state(self) -> dict:
+        """Pickle-able snapshot of EVERY mutable training-loop state:
+        host trees, device score arrays, all RNG streams, bagging mask,
+        device tree history.  ``restore_state`` of this dict into a
+        structurally-identical booster makes the continued run replay the
+        same random decisions and accumulate the same float32 sums — the
+        contract behind resilience/checkpoint.py's bit-identical resume.
+
+        Reading ``self.models`` drains any deferred device trees first,
+        so the deferred-host accelerator path checkpoints correctly (at
+        the cost of one bulk D2H per checkpoint)."""
+        import copy as _copy
+        models = [_copy.deepcopy(m) for m in self.models]
+        return {
+            "boosting_type": self.boosting_type,
+            "iter": self.iter,
+            "num_init_iteration": self.num_init_iteration,
+            "models": models,
+            "train_score": np.asarray(jax.device_get(self.train_score)),
+            "valid_scores": [np.asarray(jax.device_get(v))
+                             for v in self.valid_scores],
+            "init_scores": list(self.init_scores),
+            "init_score_added": self._init_score_added,
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "bagging_rng": self._rng.get_state(),
+            "goss_rng_key": np.asarray(jax.device_get(self._goss_rng_key)),
+            "feature_rng": self._feature_rng.get_state(),
+            "cur_mask": (np.asarray(jax.device_get(self._cur_mask))
+                         if self._cur_mask is not None else None),
+            "history_mode": self._history_mode,
+            "history_scale": dict(self.history_scale),
+            "tree_history": [
+                jax.tree_util.tree_map(lambda x: np.asarray(
+                    jax.device_get(x)), st) for st in self.tree_history],
+            # cross-tree CEGB device state (per-feature used set + lazy
+            # row coverage): already-charged penalties must not be charged
+            # again after resume
+            "cegb_state": tuple(np.asarray(jax.device_get(a))
+                                for a in self._cegb_state),
+        }
+
+    def restore_state(self, st: dict) -> None:
+        """Inverse of ``capture_state`` into a freshly-constructed booster
+        of the SAME config/dataset (engine.py builds it before calling)."""
+        import copy as _copy
+        if st.get("boosting_type") != self.boosting_type:
+            raise ValueError(
+                f"checkpoint was boosting={st.get('boosting_type')!r}, this "
+                f"run is boosting={self.boosting_type!r}")
+        if len(st["valid_scores"]) != len(self.valid_scores):
+            raise ValueError(
+                f"checkpoint has {len(st['valid_scores'])} valid sets, this "
+                f"run has {len(self.valid_scores)}")
+        self.iter = int(st["iter"])
+        self.num_init_iteration = int(st["num_init_iteration"])
+        self._pending = []
+        self._models = [_copy.deepcopy(m) for m in st["models"]]
+        ts = st["train_score"]
+        if self._mesh is not None and self._data_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self.train_score = jax.device_put(
+                np.asarray(ts),
+                NamedSharding(self._mesh, P(None, self._data_axis)))
+        else:
+            self.train_score = jnp.asarray(ts)
+        self.valid_scores = [jnp.asarray(v) for v in st["valid_scores"]]
+        self.init_scores = list(st["init_scores"])
+        self._init_score_added = bool(st["init_score_added"])
+        self.shrinkage_rate = float(st["shrinkage_rate"])
+        self._rng.set_state(st["bagging_rng"])
+        self._goss_rng_key = jnp.asarray(st["goss_rng_key"])
+        self._feature_rng.set_state(st["feature_rng"])
+        self._cur_mask = (jnp.asarray(st["cur_mask"])
+                          if st["cur_mask"] is not None else None)
+        self._history_mode = st["history_mode"]
+        self.history_scale = dict(st["history_scale"])
+        self.tree_history = [jax.tree_util.tree_map(jnp.asarray, t)
+                             for t in st["tree_history"]]
+        used0, rows0 = st["cegb_state"]
+        rows0 = jnp.asarray(rows0)
+        if rows0.shape != (1, 1) and self._mesh is not None \
+                and self._data_axis is not None:
+            # lazy-mode row bitmap is row-sharded (mirrors __init__)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rows0 = jax.device_put(
+                np.asarray(st["cegb_state"][1]),
+                NamedSharding(self._mesh, P(None, self._data_axis)))
+        self._cegb_state = (jnp.asarray(used0), rows0)
+        self.models_version += 1
+
     def refit_leaf_values(self, leaf_preds: np.ndarray,
                           decay_rate: float) -> None:
         """Refit every tree's leaf values against THIS dataset's gradients,

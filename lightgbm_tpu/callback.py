@@ -54,7 +54,22 @@ def record_evaluation(eval_result: dict) -> Callable:
             eval_result.setdefault(data_name, collections.OrderedDict())
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(item[2])
+
+    # resume seam (resilience/checkpoint.py): the recorded history is part
+    # of the training state a resumed run must replay
+    def _get_state():
+        import copy
+        return copy.deepcopy(eval_result)
+
+    def _set_state(state) -> None:
+        import copy
+        eval_result.clear()
+        eval_result.update(copy.deepcopy(state))
+
     _callback.order = 20
+    _callback._resume_token = "record_evaluation"
+    _callback.get_state = _get_state
+    _callback.set_state = _set_state
     return _callback
 
 
@@ -85,6 +100,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     best_iter: List = []
     best_score_list: List = []
     cmp_op: List = []
+    higher_better: List[bool] = []
     enabled: List[bool] = [True]
     first_metric: List[str] = [""]
 
@@ -107,6 +123,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         for eval_ret in env.evaluation_result_list:
             best_iter.append(0)
             best_score_list.append(None)
+            higher_better.append(bool(eval_ret[3]))
             if eval_ret[3]:  # higher better
                 best_score.append(float("-inf"))
                 cmp_op.append(lambda x, y: x > y)
@@ -145,5 +162,38 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 raise EarlyStopException(best_iter[i], best_score_list[i])
             if first_metric_only:
                 break
+
+    # resume seam (resilience/checkpoint.py): the closure's best-so-far
+    # tracking IS training state — without it a resumed run would restart
+    # the patience window and could stop at a different iteration than
+    # the uninterrupted run.  cmp_op holds lambdas (unpicklable), so the
+    # state carries higher_better flags and set_state rebuilds them.
+    def _get_state():
+        return {
+            "best_score": list(best_score),
+            "best_iter": list(best_iter),
+            "best_score_list": list(best_score_list),
+            "higher_better": list(higher_better),
+            "enabled": enabled[0],
+            "first_metric": first_metric[0],
+        }
+
+    def _set_state(state) -> None:
+        for lst in (best_score, best_iter, best_score_list, cmp_op,
+                    higher_better):
+            del lst[:]
+        best_score.extend(state["best_score"])
+        best_iter.extend(state["best_iter"])
+        best_score_list.extend(state["best_score_list"])
+        higher_better.extend(state["higher_better"])
+        cmp_op.extend((lambda x, y: x > y) if hib else (lambda x, y: x < y)
+                      for hib in state["higher_better"])
+        enabled[0] = state["enabled"]
+        first_metric[0] = state["first_metric"]
+
     _callback.order = 30
+    _callback._resume_token = (f"early_stopping({stopping_rounds},"
+                               f"{first_metric_only})")
+    _callback.get_state = _get_state
+    _callback.set_state = _set_state
     return _callback

@@ -98,6 +98,7 @@ def distributed_bin_mappers(
     rank: Optional[int] = None,
     world: Optional[int] = None,
     allgather_bytes: Optional[AllgatherBytes] = None,
+    resilience=None,
 ):
     """Returns (bin_mappers [F], sample_nonzero {feature -> bool [S_total]},
     total_sample_cnt) — identical on every rank.
@@ -107,10 +108,19 @@ def distributed_bin_mappers(
     sample (every rank's sampled values for that feature travel in the
     allgather), matching the reference, which gathers per-feature sample
     values before binning them on the owning rank.
+
+    ``resilience`` (a ``resilience.retry.ResilienceConfig``, or implied
+    by ``params['network_resilience']=True``) routes both allgather
+    rounds through ``resilient_allgather`` — CRC framing, deadline +
+    backoff, rank-consistent verdict — so a flaky transport retries or
+    aborts consistently on every rank instead of hanging or silently
+    consuming a corrupted payload.  With ``degraded_fallback`` set, a
+    permanent collective failure falls back LOUDLY to single-rank
+    binning over the local sample (mappers then differ across ranks —
+    only for salvage runs, never silent).
     """
     p = dict(params or {})
     sample = _as_2d(local_sample)
-    S, F = sample.shape
     if allgather_bytes is None:
         allgather_bytes = jax_allgather_bytes
     if rank is None or world is None:
@@ -118,6 +128,33 @@ def distributed_bin_mappers(
         rank = jax.process_index()
         world = jax.process_count()
 
+    from ..resilience.retry import ResilienceConfig
+    res = resilience if resilience is not None else \
+        ResilienceConfig.from_params(p)
+    if res is not None and world > 1:
+        from ..resilience.retry import CollectiveError, make_resilient
+        from ..utils.log import log_warning
+        wrapped = make_resilient(allgather_bytes, world=world, rank=rank,
+                                 config=res, label="distributed_bin_mappers")
+        try:
+            return _bin_mappers_impl(sample, p, categorical, rank, world,
+                                     wrapped)
+        except CollectiveError:
+            if not res.degraded_fallback:
+                raise
+            log_warning(
+                "distributed_bin_mappers: COLLECTIVE FAILED PERMANENTLY; "
+                f"rank {rank} continuing DEGRADED as a single-rank binning "
+                "over its local sample ONLY — bin mappers will NOT agree "
+                "across ranks (network_degraded_fallback=True)")
+            return _bin_mappers_impl(sample, p, categorical, 0, 1,
+                                     lambda b: [b])
+    return _bin_mappers_impl(sample, p, categorical, rank, world,
+                             allgather_bytes)
+
+
+def _bin_mappers_impl(sample, p, categorical, rank, world, allgather_bytes):
+    S, F = sample.shape
     # phase 1: every rank contributes its sampled VALUES for every feature
     # (NaN and non-zero only — zeros are implicit, like the reference's
     # sparse sample representation) plus its nonzero/NaN mask, in a binary
@@ -173,6 +210,7 @@ def construct_distributed(
     rank: Optional[int] = None,
     world: Optional[int] = None,
     allgather_bytes: Optional[AllgatherBytes] = None,
+    resilience=None,
 ) -> Dataset:
     """Build this rank's Dataset over its LOCAL rows with GLOBALLY agreed
     bin mappers and EFB layout (so data-parallel histogram psums line up).
@@ -189,7 +227,8 @@ def construct_distributed(
     sample_idx = _sample_indices(n_local, sample_cnt, seed)
     mappers, sample_nonzero, total_sample_cnt = distributed_bin_mappers(
         data[sample_idx], params=p, categorical=categorical_feature,
-        rank=rank, world=world, allgather_bytes=allgather_bytes)
+        rank=rank, world=world, allgather_bytes=allgather_bytes,
+        resilience=resilience)
 
     ds = Dataset(data, label=label, params=p,
                  categorical_feature=list(categorical_feature) or "auto")
@@ -209,24 +248,42 @@ def construct_distributed(
     return ds
 
 
-def make_fake_allgather(world: int):
+def make_fake_allgather(world: int, timeout: Optional[float] = None):
     """In-process simulated transport for tests: K ranks run in K threads
     and rendezvous at a barrier per allgather round — the
     NetworkInitWithFunctions-style injection seam (c_api.h:1036) driven
-    without a real second host.  Returns ``fn_for(rank)``."""
+    without a real second host.  Returns ``fn_for(rank)``.
+
+    Rounds are indexed by a PER-RANK call counter and each round gets its
+    own barrier, so a broken rendezvous (a rank that stalled past
+    ``timeout`` or died) poisons only that round: every waiter raises
+    ``BrokenBarrierError`` and the next call starts a fresh round — the
+    shape ``resilience.retry`` needs to retry against.  ``timeout=None``
+    (the default) waits forever, the original rendezvous semantics.
+    """
     import threading
 
-    buf: dict = {}
-    barrier = threading.Barrier(world)
+    barriers: dict = {}
+    bufs: dict = {}
+    rounds = [0] * world
     lock = threading.Lock()
 
     def fn_for(rank: int) -> AllgatherBytes:
         def allgather(payload: bytes) -> List[bytes]:
             with lock:
+                r = rounds[rank]
+                rounds[rank] += 1
+                if r not in barriers:
+                    barriers[r] = threading.Barrier(world)
+                bar = barriers[r]
+                buf = bufs.setdefault(r, {})
                 buf[rank] = payload
-            barrier.wait()               # everyone has written
-            out = [buf[r] for r in range(world)]
-            barrier.wait()               # everyone has read; next round safe
+            bar.wait(timeout)            # everyone has written
+            out = [buf[q] for q in range(world)]
+            bar.wait(timeout)            # everyone has read; round retired
+            with lock:                   # old rounds can't be re-entered
+                barriers.pop(r - 4, None)
+                bufs.pop(r - 4, None)
             return out
         return allgather
 

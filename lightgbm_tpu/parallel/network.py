@@ -41,14 +41,28 @@ def parse_machine_list(machines: Optional[str] = None,
         entries = [tok for tok in str(machines).replace("\n", ",").split(",")
                    if tok.strip()]
     elif machine_list_file:
-        from ..utils.file_io import open_file
+        from ..utils.file_io import exists, open_file
+        if not exists(machine_list_file):
+            # reference: Log::Fatal on an unreadable machine list file
+            # (linkers_socket.cpp:27) — fail loudly instead of silently
+            # training single-machine
+            raise ValueError(
+                f"machine_list_file {str(machine_list_file)!r} does not "
+                "exist; every machine needs the same host:port list file")
         with open_file(machine_list_file) as fh:
             entries = [ln.strip() for ln in fh.read().splitlines()
                        if ln.strip()]
     out = []
     for e in entries:
         host, _, port = e.strip().partition(":")
-        out.append((host, int(port) if port else 12400))
+        if not host:
+            raise ValueError(f"machine list entry {e!r} has no host")
+        try:
+            out.append((host, int(port) if port else 12400))
+        except ValueError:
+            raise ValueError(
+                f"machine list entry {e!r}: port {port!r} is not an "
+                "integer") from None
     return out
 
 
@@ -100,6 +114,19 @@ def init_network(machines: Optional[str] = None,
     num_processes, process_id); with ``dry_run`` nothing is initialized
     (for tests and introspection).
     """
+    if listen_time_out is None:
+        listen_time_out = 120      # the signature default, for explicit None
+    # this value is exported into JAX_COORDINATION_SERVICE_TIMEOUT_SECS; a
+    # zero/negative (or unparseable) timeout would make every coordination
+    # call fail instantly (or never)
+    try:
+        ok = float(listen_time_out) > 0
+    except (TypeError, ValueError):
+        ok = False
+    if not ok:
+        raise ValueError(
+            f"listen_time_out must be a positive number of seconds, "
+            f"got {listen_time_out!r}")
     ml = parse_machine_list(machines, machine_list_file)
     if not ml and num_machines in (None, 0, 1):
         log_warning("init_network: no machine list and num_machines<=1; "
@@ -125,7 +152,7 @@ def init_network(machines: Optional[str] = None,
         log_info("init_network: single machine; skipping jax.distributed")
         return coordinator, n, rank
     os.environ.setdefault("JAX_COORDINATION_SERVICE_TIMEOUT_SECS",
-                          str(int(listen_time_out)))
+                          str(max(1, round(float(listen_time_out)))))
     log_info(f"init_network: jax.distributed.initialize("
              f"{coordinator!r}, num_processes={n}, process_id={rank})")
     jax.distributed.initialize(coordinator_address=coordinator,

@@ -1,0 +1,26 @@
+"""Fault tolerance for training and collectives (docs/RESILIENCE.md).
+
+- ``checkpoint``: checksummed atomic checkpoint bundles, keep-last-K
+  retention, corruption fallback, bit-identical resume state.
+- ``faults``: deterministic seeded chaos injection over the allgather
+  and pluggable-file-system seams.
+- ``retry``: ``resilient_allgather`` — CRC framing, deadline + backoff,
+  rank-consistent verdict round, consistent abort.
+"""
+
+from .checkpoint import (Checkpoint, CheckpointCorruptError, CheckpointError,
+                         CheckpointManager, CheckpointNotFoundError,
+                         load_checkpoint, resolve_resume_point,
+                         restore_booster, save_checkpoint)
+from .faults import ChaosRegistry, FaultSpec, parse_schedule
+from .retry import (CollectiveError, ResilienceConfig, make_resilient,
+                    resilient_allgather)
+
+__all__ = [
+    "Checkpoint", "CheckpointCorruptError", "CheckpointError",
+    "CheckpointManager", "CheckpointNotFoundError", "load_checkpoint",
+    "resolve_resume_point", "restore_booster", "save_checkpoint",
+    "ChaosRegistry", "FaultSpec", "parse_schedule",
+    "CollectiveError", "ResilienceConfig", "make_resilient",
+    "resilient_allgather",
+]

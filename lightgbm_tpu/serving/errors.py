@@ -26,3 +26,9 @@ class DeadlineExceeded(ServingError):
 
 class ServerClosed(ServingError):
     """Submit after close(), or pending work failed by close(drain=False)."""
+
+
+class SwapQuarantined(ServingError):
+    """A hot-swap candidate failed its pre-promotion probe batch (raised,
+    or produced non-finite output) and was NOT promoted; serving continues
+    on the previous model (registry.py swap probe)."""

@@ -8,6 +8,12 @@ snapshot is plain JSON — ``bench.py`` and ``tools/serve_smoke.py`` print
 it verbatim, and the tier-1 tests assert against it (compile counter,
 multi-submitter batches).
 
+The resilience subsystem reports through the same registry: hot-swap
+probe rejections count ``swap_quarantines`` (registry.py), and a
+``MetricsRegistry`` passed to ``resilience.retry.resilient_allgather``
+collects ``collective_clean`` / ``collective_retries`` /
+``collective_retries_recovered`` / ``collective_aborts``.
+
 Instruments are deliberately simple — a histogram is fixed upper-bound
 buckets plus count/sum/min/max, not a quantile sketch: the consumers here
 are tests and benchmark JSON, where exact bucket counts beat approximate

@@ -31,6 +31,8 @@ from typing import Callable, Dict, Optional, Set, Tuple
 
 import numpy as np
 
+from .errors import SwapQuarantined
+
 
 def forest_digest(forest) -> str:
     """Stable content hash of a StackedForest's semantic arrays."""
@@ -194,9 +196,40 @@ class ModelRegistry:
         # per-batch hot path
         return self._active
 
+    # rows for the pre-promotion probe batch when no bucket has ever been
+    # served (otherwise the smallest seen bucket is used)
+    probe_rows = 8
+
+    def _probe(self, model: CompiledModel) -> None:
+        """Run one probe batch through the candidate BEFORE promotion; a
+        raise or a non-finite raw score quarantines the swap — the active
+        pointer never flips to a model that cannot serve.  (The serving
+        counterpart of the checkpoint manifest: corruption is caught at
+        the boundary, not by the first unlucky request.)"""
+        with self.programs._lock:
+            seen = sorted(b for b, _k in self.programs.seen_buckets)
+        rows = seen[0] if seen else self.probe_rows
+        try:
+            raw = model.make_program(rows)(
+                np.zeros((rows, model.num_features), np.float64))
+            raw = model.scale_raw(np.asarray(raw, np.float64))
+        except SwapQuarantined:
+            raise
+        except Exception as e:  # noqa: BLE001 — any probe failure quarantines
+            self.metrics.counter("swap_quarantines").inc()
+            raise SwapQuarantined(
+                f"hot-swap candidate {model.digest} failed its probe batch "
+                f"({rows} rows): {e!r}; swap rolled back") from e
+        if not np.isfinite(raw).all():
+            self.metrics.counter("swap_quarantines").inc()
+            raise SwapQuarantined(
+                f"hot-swap candidate {model.digest} produced non-finite "
+                f"probe output; swap rolled back")
+
     def swap(self, booster, warm: bool = True, block: bool = True,
              num_iteration: Optional[int] = None,
-             start_iteration: int = 0) -> "threading.Thread | None":
+             start_iteration: int = 0,
+             probe: bool = True) -> "threading.Thread | None":
         """Load ``booster`` as the new serving model.
 
         With ``warm=True`` every bucket shape ever served is pre-compiled
@@ -204,7 +237,10 @@ class ModelRegistry:
         post-swap batches pay no compile latency.  ``block=False`` does
         the warm+flip in a daemon thread and returns it (the flip still
         happens only after warmup; serving continues on the old model
-        meanwhile)."""
+        meanwhile).  With ``probe=True`` (default) the candidate must
+        first survive a probe batch — exceptions or non-finite output
+        quarantine it (``SwapQuarantined``; ``swap_quarantines`` metric)
+        and the old model keeps serving."""
         new = CompiledModel(booster, backend=self.backend,
                             num_iteration=num_iteration,
                             start_iteration=start_iteration)
@@ -222,6 +258,8 @@ class ModelRegistry:
                 with self._swap_lock:
                     if seq < self._applied_seq:
                         return      # a newer swap already landed
+                    if probe:
+                        self._probe(new)
                     if warm:
                         self.programs.warm(new)
                     self._applied_seq = seq

@@ -287,7 +287,7 @@ class Server:
     # ------------------------------------------------------------- hot swap
 
     def swap_model(self, booster_or_path, warm: bool = True,
-                   block: bool = True):
+                   block: bool = True, probe: bool = True):
         """Replace the serving model without dropping in-flight requests.
 
         ``booster_or_path``: a Booster or a model-file path.  With
@@ -296,10 +296,13 @@ class Server:
         ``block=False`` runs warm+flip in a background thread and returns
         it immediately — join it, or poll metrics' model_generation; a
         warm failure sets the thread's ``exception`` attribute and the
-        ``swap_failures`` counter instead of flipping."""
+        ``swap_failures`` counter instead of flipping.  With ``probe=True``
+        (default) the candidate runs a probe batch first and is
+        QUARANTINED (``SwapQuarantined``, swap rolled back,
+        ``swap_quarantines`` counter) on exception or non-finite output."""
         booster = self._as_booster(booster_or_path)
         return self.models.swap(
-            booster, warm=warm, block=block,
+            booster, warm=warm, block=block, probe=probe,
             num_iteration=self.config.num_iteration,
             start_iteration=self.config.start_iteration)
 

@@ -10,19 +10,29 @@ plain paths use the builtin ``open``.
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 _OPENERS: Dict[str, Callable] = {}
+_REMOVERS: Dict[str, Callable] = {}
 
 
-def register_file_system(scheme: str, opener: Callable) -> None:
+def register_file_system(scheme: str, opener: Callable,
+                         remover: Optional[Callable] = None) -> None:
     """Install ``opener(path, mode) -> file-like`` for ``scheme://`` paths
-    (the USE_HDFS build-option analogue, made a runtime registry)."""
+    (the USE_HDFS build-option analogue, made a runtime registry).
+
+    ``remover(path)`` is optional; backends without one simply skip
+    deletions (checkpoint retention logs and moves on)."""
     _OPENERS[scheme] = opener
+    if remover is not None:
+        _REMOVERS[scheme] = remover
+    else:
+        _REMOVERS.pop(scheme, None)
 
 
 def unregister_file_system(scheme: str) -> None:
     _OPENERS.pop(scheme, None)
+    _REMOVERS.pop(scheme, None)
 
 
 def open_file(path, mode: str = "r"):
@@ -45,6 +55,81 @@ def open_file(path, mode: str = "r"):
                 f"cannot handle it ({e}); register_file_system({scheme!r}, "
                 "opener) to add one") from e
     return open(path, mode)
+
+
+def write_atomic(path, data) -> None:
+    """Crash-safe write of ``data`` (str or bytes) to ``path``.
+
+    Local paths: parent directories are created, the payload goes to a
+    temp sibling in the SAME directory (same filesystem, so the final
+    rename cannot cross devices), is fsync'd, and lands via ``os.replace``
+    — a reader never observes a truncated file, no matter when the writer
+    dies.  ``scheme://`` paths route through the ``open_file`` seam; their
+    atomicity is the backend's contract (object stores commit on close),
+    and the checksummed checkpoint manifest catches the ones that lie.
+    """
+    path = str(path)
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    if "://" in path:
+        with open_file(path, mode) as fh:
+            fh.write(data)
+        return
+    import os
+    import uuid
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    # O_EXCL + mode 0o666: unique temp sibling whose final permissions are
+    # umask-honoring exactly like a plain open() (the kernel applies the
+    # umask atomically — no process-global umask flip, no 0600 surprise
+    # for whoever serves the model next)
+    tmp = os.path.join(d, ".{}.tmp.{}.{}".format(
+        os.path.basename(path), os.getpid(), uuid.uuid4().hex[:8]))
+    fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
+    try:
+        with os.fdopen(fd, mode) as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def remove(path) -> bool:
+    """Best-effort delete through the scheme registry; returns True when
+    the file is known gone, False when it could not be deleted (no
+    remover, or the backend refused).  Never raises — callers doing
+    retention cleanup must not die over an undeletable old file."""
+    path = str(path)
+    if "://" in path:
+        scheme = path.split("://", 1)[0]
+        if scheme in _REMOVERS:
+            try:
+                _REMOVERS[scheme](path)
+                return True
+            except Exception:
+                return False
+        if scheme in _OPENERS:
+            return False
+        try:
+            import fsspec
+            fs, p = fsspec.core.url_to_fs(path)
+            fs.rm(p)
+            return True
+        except Exception:
+            return False
+    import os
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return True
+    except OSError:
+        return False
 
 
 def exists(path) -> bool:

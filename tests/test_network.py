@@ -1,0 +1,100 @@
+"""Machine-list parsing / rank-resolution / init_network edge cases
+(reference: Linkers::Linkers, linkers_socket.cpp:23-76).
+
+Satellite of the resilience PR: a mistyped machine_list_file or a
+non-positive listen_time_out must fail LOUDLY at init, not export
+garbage into JAX_COORDINATION_SERVICE_TIMEOUT_SECS or silently train
+single-machine.
+"""
+import socket
+
+import pytest
+
+from lightgbm_tpu.parallel.network import (init_network, parse_machine_list,
+                                           resolve_rank)
+
+
+def test_parse_machines_string_and_default_port():
+    ml = parse_machine_list(machines="10.0.0.1:123,10.0.0.2,10.0.0.3:9")
+    assert ml == [("10.0.0.1", 123), ("10.0.0.2", 12400), ("10.0.0.3", 9)]
+
+
+def test_parse_machines_newline_separated():
+    ml = parse_machine_list(machines="a:1\nb:2\n")
+    assert ml == [("a", 1), ("b", 2)]
+
+
+def test_parse_machine_list_file(tmp_path):
+    f = tmp_path / "mlist.txt"
+    f.write_text("hostA:5000\n\nhostB:5001\n")
+    assert parse_machine_list(machine_list_file=str(f)) == \
+        [("hostA", 5000), ("hostB", 5001)]
+
+
+def test_parse_missing_machine_list_file_raises(tmp_path):
+    with pytest.raises(ValueError, match="does not exist"):
+        parse_machine_list(machine_list_file=str(tmp_path / "nope.txt"))
+
+
+def test_parse_bad_port_raises():
+    with pytest.raises(ValueError, match="not an integer"):
+        parse_machine_list(machines="hostA:http")
+
+
+def test_parse_empty_host_raises():
+    with pytest.raises(ValueError, match="no host"):
+        parse_machine_list(machines=":123")
+
+
+def test_resolve_rank_by_position():
+    me = socket.gethostname()
+    ml = [("other-host-zzz", 1), (me, 2), ("another-host-yyy", 3)]
+    assert resolve_rank(ml) == 1
+
+
+def test_resolve_rank_duplicate_hosts_port_disambiguates():
+    """Multi-process-per-host: the same hostname appears twice and
+    local_listen_port picks the right slot."""
+    me = "localhost"
+    ml = [(me, 5000), (me, 5001), (me, 5002)]
+    assert resolve_rank(ml, local_listen_port=5001) == 1
+    assert resolve_rank(ml, local_listen_port=5002) == 2
+    # unknown port: first local match wins (reference fallback)
+    assert resolve_rank(ml, local_listen_port=9999) == 0
+    assert resolve_rank(ml) == 0
+
+
+def test_resolve_rank_no_match_raises():
+    with pytest.raises(ValueError, match="matches this host"):
+        resolve_rank([("host-that-is-not-us-1", 1),
+                      ("host-that-is-not-us-2", 2)])
+
+
+def test_init_network_truncates_list_to_num_machines():
+    coord, n, rank = init_network(
+        machines="localhost:12400,localhost:12401,ghost:12402",
+        local_listen_port=12401, num_machines=2, dry_run=True)
+    assert (coord, n, rank) == ("localhost:12400", 2, 1)
+
+
+def test_init_network_num_machines_exceeding_list_raises():
+    with pytest.raises(ValueError, match="machine list has"):
+        init_network(machines="localhost:12400", num_machines=3,
+                     dry_run=True)
+
+
+def test_init_network_missing_file_raises(tmp_path):
+    with pytest.raises(ValueError, match="does not exist"):
+        init_network(machine_list_file=str(tmp_path / "missing.txt"),
+                     dry_run=True)
+
+
+@pytest.mark.parametrize("bad", [0, -1, -120])
+def test_init_network_rejects_nonpositive_timeout(bad):
+    with pytest.raises(ValueError, match="listen_time_out"):
+        init_network(machines="localhost:12400,localhost:12401",
+                     listen_time_out=bad, dry_run=True)
+
+
+def test_init_network_no_list_single_machine_is_noop():
+    assert init_network(dry_run=True) is None

@@ -1,0 +1,241 @@
+"""Fault-tolerant training: checkpoint bundles, bit-identical resume,
+corruption fallback, atomic model writes (docs/RESILIENCE.md).
+
+The core contract under test is the acceptance bar of PR 2: a run killed
+after a checkpoint at iteration k and resumed via ``resume_from``
+produces a model file BYTE-identical to the uninterrupted run — across
+bagging, GOSS and DART configs — and a corrupted newest bundle is
+detected (sha256 manifest) and skipped for the previous good one.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.dataset import Dataset
+from lightgbm_tpu.resilience import (CheckpointCorruptError,
+                                     CheckpointManager,
+                                     CheckpointNotFoundError,
+                                     load_checkpoint, save_checkpoint)
+
+
+def _data(seed=0, n=400, f=6):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0.8).astype(np.float32)
+    Xv = rng.rand(n // 2, f)
+    yv = (Xv[:, 0] + Xv[:, 1] * Xv[:, 2] > 0.8).astype(np.float32)
+    return X, y, Xv, yv
+
+
+BASE = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+        "min_data_in_leaf": 5}
+
+
+def _resume_parity(tmp_path, params, rounds=12, die_after=7, freq=3,
+                   early_stopping_rounds=None):
+    """Train full; train-and-die at ``die_after`` with bundles every
+    ``freq``; resume from the bundle dir; compare final model bytes."""
+    X, y, Xv, yv = _data()
+    kw = dict(verbose_eval=False,
+              early_stopping_rounds=early_stopping_rounds)
+
+    er_full = {}
+    full = lgb.train(params, Dataset(X, label=y), rounds,
+                     valid_sets=[Dataset(Xv, label=yv)],
+                     evals_result=er_full, **kw)
+    full.save_model(str(tmp_path / "full.txt"))
+
+    er_part = {}
+    lgb.train(params, Dataset(X, label=y), die_after,
+              valid_sets=[Dataset(Xv, label=yv)], evals_result=er_part,
+              snapshot_freq=freq, snapshot_out=str(tmp_path / "part.txt"),
+              **kw)
+
+    er_res = {}
+    res = lgb.train(params, Dataset(X, label=y), rounds,
+                    valid_sets=[Dataset(Xv, label=yv)], evals_result=er_res,
+                    resume_from=str(tmp_path / "part.txt.ckpt"), **kw)
+    res.save_model(str(tmp_path / "res.txt"))
+
+    a = (tmp_path / "full.txt").read_bytes()
+    b = (tmp_path / "res.txt").read_bytes()
+    assert a == b, "resumed model file is not byte-identical"
+    assert full.best_iteration == res.best_iteration
+    assert er_full == er_res, "resumed eval history diverged"
+    return full, res
+
+
+def test_resume_bit_identical_bagging(tmp_path):
+    _resume_parity(tmp_path, {**BASE, "bagging_fraction": 0.7,
+                              "bagging_freq": 2, "feature_fraction": 0.8})
+
+
+def test_resume_bit_identical_dart(tmp_path):
+    _resume_parity(tmp_path, {**BASE, "boosting": "dart", "drop_rate": 0.5})
+
+
+def test_resume_bit_identical_dart_nonuniform(tmp_path):
+    _resume_parity(tmp_path, {**BASE, "boosting": "dart", "drop_rate": 0.5,
+                              "uniform_drop": False})
+
+
+def test_resume_bit_identical_goss(tmp_path):
+    _resume_parity(tmp_path, {**BASE, "boosting": "goss",
+                              "learning_rate": 0.3})
+
+
+def test_resume_bit_identical_rf(tmp_path):
+    _resume_parity(tmp_path, {**BASE, "boosting": "rf",
+                              "bagging_fraction": 0.7, "bagging_freq": 1})
+
+
+def test_resume_bit_identical_cegb(tmp_path):
+    """CEGB carries cross-iteration device state (used features + lazy
+    row coverage); already-charged penalties must not re-charge after
+    resume."""
+    _resume_parity(tmp_path, {
+        **BASE, "cegb_tradeoff": 0.5, "cegb_penalty_split": 0.1,
+        "cegb_penalty_feature_coupled": [0.4] * 6,
+        "cegb_penalty_feature_lazy": [0.3] * 6})
+
+
+def test_resume_early_stopping_state(tmp_path):
+    """The patience window carries across the kill: the resumed run must
+    stop at the same iteration with the same best score."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(400, 6)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    Xv = rng.rand(150, 6)
+    yv = (rng.rand(150) > 0.5).astype(np.float32)   # noise: stops early
+    kw = dict(verbose_eval=False, early_stopping_rounds=4)
+
+    full = lgb.train(BASE, Dataset(X, label=y), 40,
+                     valid_sets=[Dataset(Xv, label=yv)], **kw)
+    full.save_model(str(tmp_path / "full.txt"))
+    assert full.best_iteration < 40, "test needs early stopping to fire"
+
+    lgb.train(BASE, Dataset(X, label=y), 4,
+              valid_sets=[Dataset(Xv, label=yv)],
+              snapshot_freq=2, snapshot_out=str(tmp_path / "p.txt"), **kw)
+    res = lgb.train(BASE, Dataset(X, label=y), 40,
+                    valid_sets=[Dataset(Xv, label=yv)],
+                    resume_from=str(tmp_path / "p.txt.ckpt"), **kw)
+    res.save_model(str(tmp_path / "res.txt"))
+    assert (tmp_path / "full.txt").read_bytes() == \
+        (tmp_path / "res.txt").read_bytes()
+    assert res.best_iteration == full.best_iteration
+    assert res.best_score == full.best_score
+
+
+def test_corrupted_newest_bundle_falls_back(tmp_path):
+    """Bit-flip the newest bundle: it must be detected and skipped, and
+    resume must continue from the previous verified one."""
+    X, y, _, _ = _data()
+    lgb.train(BASE, Dataset(X, label=y), 9, verbose_eval=False,
+              snapshot_freq=3, snapshot_out=str(tmp_path / "m.txt"))
+    d = tmp_path / "m.txt.ckpt"
+    bundles = sorted(p for p in os.listdir(d) if p.endswith(".lgbckpt"))
+    assert bundles == ["ckpt_iter_00000003.lgbckpt",
+                       "ckpt_iter_00000006.lgbckpt",
+                       "ckpt_iter_00000009.lgbckpt"]
+    newest = d / bundles[-1]
+    blob = bytearray(newest.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    newest.write_bytes(bytes(blob))
+
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(str(newest))
+    ck = CheckpointManager(str(d)).latest_verified()
+    assert ck.iteration == 6
+
+    res = lgb.train(BASE, Dataset(X, label=y), 9, verbose_eval=False,
+                    resume_from=str(d))
+    assert len(res.boosting.models) == 9
+
+
+def test_truncated_bundle_detected(tmp_path):
+    X, y, _, _ = _data()
+    bst = lgb.train(BASE, Dataset(X, label=y), 3, verbose_eval=False)
+    p = str(tmp_path / "one.lgbckpt")
+    save_checkpoint(bst, p, iteration=3)
+    blob = (tmp_path / "one.lgbckpt").read_bytes()
+    (tmp_path / "one.lgbckpt").write_bytes(blob[:len(blob) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(p)
+
+
+def test_all_bundles_corrupt_raises_not_found(tmp_path):
+    X, y, _, _ = _data()
+    lgb.train(BASE, Dataset(X, label=y), 4, verbose_eval=False,
+              snapshot_freq=2, snapshot_out=str(tmp_path / "m.txt"))
+    d = tmp_path / "m.txt.ckpt"
+    for name in os.listdir(d):
+        if name.endswith(".lgbckpt"):
+            (d / name).write_bytes(b"garbage")
+    with pytest.raises(CheckpointNotFoundError):
+        CheckpointManager(str(d)).latest_verified()
+
+
+def test_retention_keeps_last_k(tmp_path):
+    X, y, _, _ = _data()
+    lgb.train(BASE, Dataset(X, label=y), 10, verbose_eval=False,
+              snapshot_freq=2, snapshot_out=str(tmp_path / "m.txt"),
+              snapshot_keep=2)
+    d = tmp_path / "m.txt.ckpt"
+    bundles = sorted(p for p in os.listdir(d) if p.endswith(".lgbckpt"))
+    assert bundles == ["ckpt_iter_00000008.lgbckpt",
+                       "ckpt_iter_00000010.lgbckpt"]
+
+
+def test_resume_from_specific_bundle_file(tmp_path):
+    X, y, _, _ = _data()
+    lgb.train(BASE, Dataset(X, label=y), 6, verbose_eval=False,
+              snapshot_freq=2, snapshot_out=str(tmp_path / "m.txt"))
+    bundle = tmp_path / "m.txt.ckpt" / "ckpt_iter_00000004.lgbckpt"
+    res = lgb.train(BASE, Dataset(X, label=y), 6, verbose_eval=False,
+                    resume_from=str(bundle))
+    assert len(res.boosting.models) == 6
+
+
+def test_resume_missing_location_raises(tmp_path):
+    X, y, _, _ = _data()
+    with pytest.raises(CheckpointNotFoundError):
+        lgb.train(BASE, Dataset(X, label=y), 3, verbose_eval=False,
+                  resume_from=str(tmp_path / "nope"))
+
+
+def test_bundle_model_txt_member_loads_standalone(tmp_path):
+    """The model.txt member is a complete reference-format model."""
+    X, y, _, _ = _data()
+    bst = lgb.train(BASE, Dataset(X, label=y), 5, verbose_eval=False)
+    p = str(tmp_path / "b.lgbckpt")
+    save_checkpoint(bst, p, iteration=5)
+    ck = load_checkpoint(p)
+    loaded = lgb.Booster(model_str=ck.model_str)
+    np.testing.assert_allclose(loaded.predict(X[:16]), bst.predict(X[:16]),
+                               rtol=1e-6)
+
+
+def test_save_model_atomic_creates_parent_dirs(tmp_path):
+    """Satellite: snapshot_out / save_model into a nonexistent directory
+    must work, and no temp sibling may linger."""
+    X, y, _, _ = _data()
+    bst = lgb.train(BASE, Dataset(X, label=y), 2, verbose_eval=False)
+    target = tmp_path / "does" / "not" / "exist" / "model.txt"
+    bst.save_model(str(target))
+    assert target.is_file()
+    siblings = os.listdir(target.parent)
+    assert siblings == ["model.txt"], siblings
+    reload = lgb.Booster(model_file=str(target))
+    np.testing.assert_allclose(reload.predict(X[:8]), bst.predict(X[:8]),
+                               rtol=1e-6)
+
+
+def test_snapshot_out_into_new_dir(tmp_path):
+    X, y, _, _ = _data()
+    out = tmp_path / "fresh" / "dir" / "m.txt"
+    lgb.train(BASE, Dataset(X, label=y), 4, verbose_eval=False,
+              snapshot_freq=2, snapshot_out=str(out))
+    assert (out.parent / "m.txt.ckpt" / "index.json").is_file()

@@ -453,3 +453,69 @@ def test_table_matmul_probe_fallback(monkeypatch):
     # verdict is cached: no re-probe, still the gather
     out = np.asarray(H.take_from_table(table, idx))
     np.testing.assert_array_equal(out, np.asarray(table))
+
+
+# ------------------------------------------------- swap probe / quarantine
+
+
+def test_swap_probe_quarantines_poisoned_model(binary_booster):
+    """A hot-swap candidate producing non-finite output must be rejected
+    BEFORE promotion: SwapQuarantined raised, generation unchanged,
+    swap_quarantines counted, old model still serving identical bytes."""
+    from lightgbm_tpu.serving import SwapQuarantined
+    rng = np.random.RandomState(5)
+    X = _f32_data(rng, 32)
+    srv = binary_booster.serve(backend="host")
+    try:
+        before = srv.predict(X)
+        gen = srv.metrics.gauge("model_generation").value
+        poisoned = _train(rounds=4, seed=9)
+        poisoned.boosting.models[0].leaf_value[:] = np.nan
+        with pytest.raises(SwapQuarantined):
+            srv.swap_model(poisoned)
+        assert srv.metrics.gauge("model_generation").value == gen
+        assert srv.metrics.counter("swap_quarantines").value == 1
+        assert srv.metrics.counter("swap_failures").value >= 1
+        np.testing.assert_array_equal(srv.predict(X), before)
+    finally:
+        srv.close()
+
+
+def test_swap_probe_quarantines_raising_model(binary_booster):
+    """A candidate whose predict path RAISES is quarantined the same way
+    (probe catches the exception, not the first live batch)."""
+    from lightgbm_tpu.serving import SwapQuarantined
+    srv = binary_booster.serve(backend="host")
+    try:
+        bad = _train(rounds=4, seed=11)
+
+        class _Exploding:
+            num_trees = 0
+
+            def predict_raw(self, Xpad, num_class=1):
+                raise RuntimeError("boom")
+
+        gen = srv.metrics.gauge("model_generation").value
+        # sabotage the CompiledModel the registry will build: swap via the
+        # registry directly with a broken forest
+        from lightgbm_tpu.serving.registry import CompiledModel
+        new = CompiledModel(bad, backend="host")
+        new.forest = _Exploding()
+        new.make_program(8)  # sanity: building the callable is fine
+        with pytest.raises(SwapQuarantined):
+            srv.models._probe(new)
+        assert srv.metrics.counter("swap_quarantines").value == 1
+        assert srv.metrics.gauge("model_generation").value == gen
+    finally:
+        srv.close()
+
+
+def test_swap_healthy_model_passes_probe(binary_booster):
+    srv = binary_booster.serve(backend="host")
+    try:
+        gen = srv.metrics.gauge("model_generation").value
+        srv.swap_model(_train(rounds=6, seed=13))
+        assert srv.metrics.gauge("model_generation").value == gen + 1
+        assert srv.metrics.counter("swap_quarantines").value == 0
+    finally:
+        srv.close()

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Chaos smoke: train under a scripted fault schedule and assert recovery.
+
+The CLI twin of tests/test_chaos.py — for eyeballs and CI logs.  Three
+phases, each asserting its acceptance bar, with a single JSON summary as
+the LAST stdout line (exit 0 only when every phase holds):
+
+1. **collective** — ``distributed_bin_mappers`` over a fake K-rank mesh
+   with the ``--schedule`` faults applied to the allgather seam and
+   ``resilient_allgather`` wrapping it: every rank must either complete
+   with mappers identical to the fault-free run, or (for dead-transport
+   schedules) abort with CollectiveError on every rank inside the
+   deadline.  It must never hang and never bin from a corrupted payload.
+2. **checkpoint** — train with bundle snapshots while the ``fs.*`` part
+   of the schedule fires through the chaos:// file system; then resume
+   from the surviving bundles and assert the final model is
+   BYTE-IDENTICAL to an uninterrupted run.
+3. **quarantine** — hot-swap a NaN-poisoned model into a server and
+   assert it is rejected by the probe batch.
+
+Schedule syntax (docs/RESILIENCE.md), e.g.::
+
+    python tools/chaos_smoke.py \
+        --schedule "allgather.bitflip@0:rank=1,allgather.drop@3:rank=2,fs.partial@4" \
+        --world 4 --rounds 12 --snapshot-freq 2 --seed 0
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_SCHEDULE = ("allgather.bitflip@0:rank=1,allgather.truncate@4:rank=2,"
+                    "allgather.drop@2:rank=0,fs.partial@4")
+
+
+def phase_collective(args, summary):
+    from lightgbm_tpu.parallel.dist_data import (distributed_bin_mappers,
+                                                 make_fake_allgather)
+    from lightgbm_tpu.resilience import (ChaosRegistry, CollectiveError,
+                                         ResilienceConfig)
+    rng = np.random.RandomState(args.seed)
+    X = rng.rand(args.rows_per_rank * args.world, 6)
+    bounds = np.linspace(0, len(X), args.world + 1).astype(int)
+    cfg = ResilienceConfig(deadline_s=args.deadline, max_retries=8,
+                           base_backoff_s=0.01, jitter_seed=args.seed)
+
+    def run(chaos):
+        fake = make_fake_allgather(args.world, timeout=2.0)
+        out, errs = [None] * args.world, [None] * args.world
+
+        def r(k):
+            ag = fake(k)
+            if chaos is not None:
+                ag = chaos.wrap_allgather(ag, k)
+            try:
+                out[k] = distributed_bin_mappers(
+                    X[bounds[k]:bounds[k + 1]], params={}, rank=k,
+                    world=args.world, allgather_bytes=ag, resilience=cfg)
+            except Exception as e:  # noqa: BLE001
+                errs[k] = e
+        ts = [threading.Thread(target=r, args=(k,))
+              for k in range(args.world)]
+        [t.start() for t in ts]
+        deadline = time.monotonic() + args.deadline + 60
+        for t in ts:
+            t.join(max(1.0, deadline - time.monotonic()))
+        assert not any(t.is_alive() for t in ts), "HANG: a rank never returned"
+        return out, errs
+
+    clean, errs = run(None)
+    assert not any(errs), f"fault-free run failed: {errs}"
+    chaos = ChaosRegistry(args.schedule, seed=args.seed)
+    t0 = time.monotonic()
+    out, errs = run(chaos)
+    elapsed = time.monotonic() - t0
+    if any(errs):
+        assert all(isinstance(e, CollectiveError) for e in errs), \
+            f"INCONSISTENT abort: {errs}"
+        assert elapsed < args.deadline + 30, "abort not deadline-bounded"
+        summary["collective"] = {"outcome": "consistent_abort",
+                                 "elapsed_s": round(elapsed, 2)}
+    else:
+        for k in range(args.world):
+            for m, n in zip(out[k][0], clean[0][0]):
+                assert m.num_bin == n.num_bin and np.array_equal(
+                    m.bin_upper_bound, n.bin_upper_bound), \
+                    f"rank {k} binned from a corrupted payload"
+        summary["collective"] = {"outcome": "recovered",
+                                 "faults_fired": chaos.log,
+                                 "elapsed_s": round(elapsed, 2)}
+
+
+def phase_checkpoint(args, summary, workdir):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience import ChaosRegistry
+    rng = np.random.RandomState(args.seed)
+    X = rng.rand(600, 8)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    P = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "bagging_fraction": 0.8, "bagging_freq": 1, "min_data_in_leaf": 5}
+
+    full = lgb.train(P, lgb.Dataset(X, label=y), args.rounds,
+                     verbose_eval=False)
+    full.save_model(f"{workdir}/full.txt")
+
+    chaos = ChaosRegistry(args.schedule, seed=args.seed)
+    chaos.install_filesystem("chaos")
+    died_at = max(args.snapshot_freq, args.rounds * 2 // 3)
+    try:
+        lgb.train(P, lgb.Dataset(X, label=y), died_at, verbose_eval=False,
+                  snapshot_freq=args.snapshot_freq,
+                  snapshot_out=f"chaos://{workdir}/m.txt")
+    except OSError as e:
+        # an injected ENOSPC/transient killed the run mid-snapshot —
+        # exactly the crash being simulated; resume from what survived
+        summary.setdefault("checkpoint_notes", []).append(
+            f"train died on injected fault: {e}")
+    finally:
+        chaos.uninstall_filesystem()
+
+    res = lgb.train(P, lgb.Dataset(X, label=y), args.rounds,
+                    verbose_eval=False,
+                    resume_from=f"{workdir}/m.txt.ckpt")
+    res.save_model(f"{workdir}/res.txt")
+    a = open(f"{workdir}/full.txt", "rb").read()
+    b = open(f"{workdir}/res.txt", "rb").read()
+    assert a == b, "resumed model is NOT byte-identical to uninterrupted run"
+    summary["checkpoint"] = {"outcome": "bit_identical_resume",
+                             "fs_faults_fired": [f for f in chaos.log
+                                                 if f.startswith("fs")],
+                             "model_bytes": len(a)}
+
+
+def phase_quarantine(args, summary):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import SwapQuarantined
+    rng = np.random.RandomState(args.seed)
+    X = rng.rand(400, 6)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    P = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "min_data_in_leaf": 5}
+    good = lgb.train(P, lgb.Dataset(X, label=y), 4, verbose_eval=False)
+    bad = lgb.train(P, lgb.Dataset(X, label=y), 4, verbose_eval=False)
+    bad.boosting.models[0].leaf_value[:] = np.nan
+    srv = good.serve(backend="host")
+    try:
+        srv.predict(X[:8])
+        gen = srv.metrics.gauge("model_generation").value
+        try:
+            srv.swap_model(bad)
+            raise AssertionError("poisoned swap was PROMOTED")
+        except SwapQuarantined:
+            pass
+        assert srv.metrics.gauge("model_generation").value == gen
+        summary["quarantine"] = {
+            "outcome": "rejected_at_probe",
+            "swap_quarantines":
+                srv.metrics.counter("swap_quarantines").value}
+    finally:
+        srv.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schedule", default=DEFAULT_SCHEDULE,
+                    help="fault schedule (docs/RESILIENCE.md syntax)")
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--snapshot-freq", type=int, default=2)
+    ap.add_argument("--rows-per-rank", type=int, default=500)
+    ap.add_argument("--deadline", type=float, default=20.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh temp dir)")
+    args = ap.parse_args()
+
+    from lightgbm_tpu.utils.platform import force_cpu_inprocess
+    force_cpu_inprocess(1)
+
+    import tempfile
+    summary = {"schedule": args.schedule, "ok": False}
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as td:
+        workdir = args.workdir or td
+        phase_collective(args, summary)
+        phase_checkpoint(args, summary, workdir)
+        phase_quarantine(args, summary)
+    summary["ok"] = True
+    summary["elapsed_s"] = round(time.monotonic() - t0, 2)
+    print(json.dumps(summary, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(json.dumps({"ok": False, "assertion": str(e)}))
+        sys.exit(1)
