@@ -385,7 +385,37 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
         jax.profiler.stop_trace()
 
     sec_per_tree = elapsed / trees
-    auc = holdout_auc(booster, F)
+    auc = holdout_auc(booster, F)      # metric BEFORE the chunked segment
+    # extends the model, so the reported AUC stays comparable to baselines
+
+    # fused macro-steps (lightgbm_tpu/boosting/macro.py): continue the
+    # SAME booster with update_chunk so training compute matches and only
+    # the dispatch count changes; LGBM_TPU_CHUNK=0 (the compile-variant
+    # ladder's chunk-off rung) skips this segment
+    from lightgbm_tpu.boosting.macro import chunk_cap, pow2_chunk
+    chunk_result = None
+    cap = chunk_cap()
+    if cap > 1 and booster.boosting.chunk_supported():
+        # whole chunks only: each distinct chunk size is a separate
+        # compiled shape, so a ragged tail step would put an XLA compile
+        # inside the clock and corrupt iters_per_sec_chunked
+        c = pow2_chunk(trees, cap)
+        n_chunks = max(trees // c, 1)
+        chunk_iters = n_chunks * c
+        booster.update_chunk(c)            # chunk program compile
+        dsync(booster.boosting.train_score)
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            booster.update_chunk(c)
+        dsync(booster.boosting.train_score)
+        chunk_s = time.perf_counter() - t0
+        chunk_result = {
+            "chunk_size": c,
+            "chunk_iters": chunk_iters,
+            "iters_per_sec_chunked": round(chunk_iters / chunk_s, 3),
+            "sec_per_tree_chunked": round(chunk_s / chunk_iters, 4),
+        }
+
     result = {
         "metric": f"synthetic-HIGGS {n}x{F} train wall-clock, "
                   f"{trees} trees x {leaves} leaves, max_bin={max_bin} "
@@ -396,12 +426,15 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
         "platform": platform,
         "device_kind": getattr(device, "device_kind", ""),
         "sec_per_tree": round(sec_per_tree, 4),
+        "iters_per_sec": round(1.0 / max(sec_per_tree, 1e-9), 3),
         "compile_seconds": round(compile_seconds, 2),
         "bin_seconds": round(bin_seconds, 2),
         "holdout_auc": round(float(auc), 5),
         "rows": n,
         "trees": trees,
     }
+    if chunk_result is not None:
+        result.update(chunk_result)
     peak = peak_flops_for(device)
     result["mfu_histogram_lower_bound"] = round(
         mfu_estimate(n, F, max_bin, leaves, sec_per_tree, peak), 4)
@@ -551,10 +584,18 @@ def run_resilience_bench(n_train=50_000, trees=24, leaves=63, max_bin=63,
 # being clobbered back to the default.
 _VARIANT_LADDER = [
     {"LGBM_TPU_SMALL_ROUNDS": os.environ.get("LGBM_TPU_SMALL_ROUNDS", "1"),
-     "LGBM_TPU_PACK": os.environ.get("LGBM_TPU_PACK", "1")},  # full default
+     "LGBM_TPU_PACK": os.environ.get("LGBM_TPU_PACK", "1"),
+     "LGBM_TPU_CHUNK": os.environ.get("LGBM_TPU_CHUNK", "")},  # full default
     {"LGBM_TPU_SMALL_ROUNDS": "0",
-     "LGBM_TPU_PACK": os.environ.get("LGBM_TPU_PACK", "1")},
-    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0"},    # most stripped
+     "LGBM_TPU_PACK": os.environ.get("LGBM_TPU_PACK", "1"),
+     "LGBM_TPU_CHUNK": os.environ.get("LGBM_TPU_CHUNK", "")},
+    # chunk-off rung: fused macro-steps disabled, legacy one-program-per-
+    # round dispatch — isolates scan-program compiles from the hang hunt
+    # and doubles as the bisection gate for macro-step regressions
+    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0",
+     "LGBM_TPU_CHUNK": os.environ.get("LGBM_TPU_CHUNK", "")},
+    {"LGBM_TPU_SMALL_ROUNDS": "0", "LGBM_TPU_PACK": "0",
+     "LGBM_TPU_CHUNK": "0"},                                 # most stripped
 ]
 # a pre-stripped operator env can make adjacent rungs identical; dedupe
 # so a hung compile never burns a stall_timeout retrying the same program
@@ -602,6 +643,18 @@ def tpu_worker():
             emit(probe)
         except Exception as e:
             emit({"stage": "kernel_probe", "error": str(e)[-500:]})
+
+    if os.environ.get("BENCH_SKIP_DISPATCH_PROBE") != "1":
+        try:
+            t1 = time.time()
+            sys.path.insert(0, os.path.join(REPO, "tools"))
+            from dispatch_probe import run_probe
+            dp = run_probe(rows=min(N, 100_000), iters=12, chunks=(8, 32))
+            dp.update({"stage": "dispatch_probe",
+                       "elapsed": round(time.time() - t1, 1)})
+            emit(dp)
+        except Exception as e:
+            emit({"stage": "dispatch_probe", "error": str(e)[-500:]})
 
     if os.environ.get("BENCH_SKIP_SMOKE") != "1":
         try:

@@ -196,6 +196,15 @@ class Booster:
             return self.boosting.train_one_iter(np.asarray(grad), np.asarray(hess))
         return self.boosting.train_one_iter()
 
+    def update_chunk(self, chunk: int, learning_rates=None) -> bool:
+        """Train ``chunk`` boosting iterations in ONE fused device program
+        (lax.scan macro-step, boosting/macro.py) — bit-identical to calling
+        ``update()`` ``chunk`` times for the supported modes
+        (``self.boosting.chunk_supported()``).  ``learning_rates``: optional
+        per-iteration shrinkage schedule of length ``chunk``.  Returns True
+        if training stopped (no more splittable leaves)."""
+        return self.boosting.train_chunk(chunk, learning_rates)
+
     def rollback_one_iter(self) -> "Booster":
         self.boosting.rollback_one_iter()
         return self

@@ -34,6 +34,8 @@ from .gbdt import GBDT
 class DART(GBDT):
     boosting_type = "dart"
     _defer_host_ok = False   # per-iteration host drop & rescale of models
+    _macro_ok = False        # same reason: no fused macro-steps (the chunk
+    # scheduler in engine.py falls back to c=1 per-iteration training)
 
     def __init__(self, config, train_set, objective):
         super().__init__(config, train_set, objective)

@@ -67,6 +67,9 @@ class GBDT:
     # subclasses with per-iteration host-side model logic (DART's drop &
     # rescale, RF's averaged extension) must keep the eager finish path
     _defer_host_ok = True
+    # fused multi-iteration macro-steps (boosting/macro.py): DART's
+    # per-iteration host drop & rescale cannot ride inside a lax.scan
+    _macro_ok = True
 
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[ObjectiveFunction]):
@@ -93,7 +96,10 @@ class GBDT:
 
         self.meta = self.train_set.feature_meta()
         self.num_data = self.train_set.num_data
-        n, F = self.train_set.binned.shape
+        n, F = self.train_set.host_binned().shape
+        # captured so _build_jit_fns rebuilds (reset_parameter) never touch
+        # the host binned matrix — it may be released below
+        self._binned_shape = (n, F)
         # padded bin axis: power-of-two-ish friendly size
         self.num_bins = int(self.meta.max_num_bin)
 
@@ -177,6 +183,18 @@ class GBDT:
         self._history_mode = "last"
 
         self._build_jit_fns()
+
+        # device residency established: the host [n, F] binned matrix is a
+        # duplicate of self.binned now.  When the user signalled the
+        # Dataset is consumed (free_raw_data, the default) drop it —
+        # roughly halves peak RSS at HIGGS scale.  Gated to accelerator
+        # backends by default (a released Dataset cannot build a second
+        # booster / subset / save_binary); LGBM_TPU_FREE_BINNED=1/0
+        # overrides either way.
+        env_free = os.environ.get("LGBM_TPU_FREE_BINNED", "")
+        if self.train_set.free_raw_data and env_free != "0" and (
+                env_free == "1" or on_accelerator()):
+            self.train_set.release_host_binned()
 
     # ------------------------------------------------------------------ setup
 
@@ -422,7 +440,7 @@ class GBDT:
         self.valid_sets.append(valid_set)
         self.valid_names.append(name)
         self.valid_binned.append(jnp.asarray(
-            np.ascontiguousarray(valid_set.binned.T)))
+            np.ascontiguousarray(valid_set.host_binned().T)))
         K = self.num_tree_per_iteration
         vs = jnp.zeros((K, valid_set.num_data), jnp.float32)
         if valid_set.metadata.init_score is not None:
@@ -519,7 +537,7 @@ class GBDT:
         if hist_method == "auto" and on_accelerator():
             from ..ops.histogram import measured_best_method
             hist_method = measured_best_method(
-                self.num_data, self.train_set.binned.shape[1], self.num_bins)
+                self.num_data, self._binned_shape[1], self.num_bins)
         # re-derive the grower config so reset_parameter() of tree
         # hyper-parameters (lambda_l1, min_data_in_leaf, ...) takes effect
         self.grower_cfg = GrowerConfig(
@@ -771,6 +789,13 @@ class GBDT:
                            lr, rng, cegb_used, cegb_rows,
                            label_a, weight_a, mc_j, meta_args)
             self._iter_fn = one_iter
+
+            def macro_core(binned, score, row_mask, grad, hess, fmask, lr,
+                           rng, cu, cr, label_r, weight_r):
+                return iter_body(binned, score, row_mask, grad, hess,
+                                 fmask, lr, rng, label_r, weight_r, cu, cr,
+                                 None, None, mc_arr=mc_j,
+                                 meta_args=meta_args)
         else:
             from jax.sharding import PartitionSpec as P
             ax_d, ax_f = self._data_axis, self._feature_axis
@@ -785,7 +810,8 @@ class GBDT:
             krow = P(None, ax_d)
             # lazy-mode used-rows bitmap is sharded with the rows
             rows_spec = krow if (cegb_on and cfg.cegb_lazy) else P()
-            sharded = jax.shard_map(
+            from ..parallel.learners import shard_map_compat
+            sharded = shard_map_compat(
                 core, mesh=self._mesh,
                 in_specs=(P(ax_f, ax_d), krow, row, krow, krow, P(), P(),
                           P(), row, row, P(), rows_spec),
@@ -798,6 +824,11 @@ class GBDT:
                                fmask, lr, rng, label_a, weight_a,
                                cegb_used, cegb_rows)
             self._iter_fn = jax.jit(one_iter, donate_argnums=(1,))
+
+            def macro_core(binned, score, row_mask, grad, hess, fmask, lr,
+                           rng, cu, cr, label_r, weight_r):
+                return sharded(binned, score, row_mask, grad, hess,
+                               fmask, lr, rng, label_r, weight_r, cu, cr)
         if not hasattr(self, "_feature_rng"):  # survive jit-fn rebuilds
             self._feature_rng = np.random.RandomState(
                 self.config.feature_fraction_seed)
@@ -830,6 +861,17 @@ class GBDT:
             return g, h
 
         self._gradients_fn = jax.jit(gradients_fn)
+
+        # fused macro-step context (boosting/macro.py): the SAME iter_body
+        # (serial or shard_map'd) and the same gradient closure, re-traced
+        # inside a lax.scan chunk program; rebuilt alongside the
+        # per-iteration programs so reset_parameter invalidates both
+        self._macro_core = macro_core
+        self._macro_grad = gradients_fn
+        self._macro_ctx = {"label": label_a, "weight": weight_a}
+        self._macro_chunk_jit = None
+        self._macro_valid_jit = None
+        self._has_forced_plan = forced_plan is not None
 
         # prediction-side programs share across boosters the same way:
         # bin metadata rides as runtime args, keyed on structure only
@@ -974,8 +1016,28 @@ class GBDT:
         with global_timer.section("GBDT::TrainOneIter"):
             return self._train_one_iter_inner(grad, hess)
 
+    def _chunk_single(self) -> Optional[bool]:
+        """Run ONE iteration through the fused chunk program (c=1) when
+        the macro path is enabled; None = caller takes the legacy path.
+
+        Routing per-iteration training of supported modes through the
+        same runtime-trip-count loop body as multi-iteration chunks makes
+        training invariant to the chunk decomposition (see
+        macro.build_chunk_program) — the invariant behind byte-identical
+        chunked vs. per-iteration models and chunk-agnostic
+        checkpoint/resume replay.  LGBM_TPU_CHUNK=0 restores the legacy
+        per-iteration program for bisection."""
+        from .macro import chunk_cap, run_chunk
+        if not self.chunk_supported() or chunk_cap() <= 0:
+            return None
+        return run_chunk(self, 1, None)
+
     def _train_one_iter_inner(self, grad, hess) -> bool:
         from ..utils.timer import global_timer
+        if grad is None:
+            single = self._chunk_single()
+            if single is not None:
+                return single
         K = self.num_tree_per_iteration
         n = self.num_data
         self.boost_from_average()
@@ -1002,6 +1064,143 @@ class GBDT:
 
     def _node_key(self):
         return jax.random.fold_in(self._node_key_base, self.iter)
+
+    # ------------------------------------------------------ fused macro-steps
+
+    def chunk_supported(self) -> bool:
+        """True when the fused multi-iteration executor (boosting/macro.py)
+        can train this booster.  Paths with per-iteration host logic —
+        DART drop/rollback, CEGB penalties, forced splits, custom fobj
+        (objective None) — report False and the engine's chunk scheduler
+        falls back to c=1 per-iteration training."""
+        return (type(self)._macro_ok
+                and not self._cegb_enabled
+                and not self._has_forced_plan
+                and self.objective is not None)
+
+    def train_chunk(self, c: int, lrs=None) -> bool:
+        """Train ``c`` boosting iterations in ONE fused, score-donating
+        device program (lax.scan over the same iter_body).  Bit-identical
+        to ``c`` train_one_iter calls; returns True if training should
+        stop (no more splittable leaves)."""
+        from ..utils.timer import global_timer
+        from .macro import run_chunk
+        with global_timer.section("GBDT::TrainChunk"):
+            return run_chunk(self, c, lrs)
+
+    def _macro_goss_inputs(self, c: int, it0: int, lrs):
+        """Per-iteration GOSS subkeys + sampling flags for a chunk; the
+        base class feeds inert dummies (DCE'd by XLA)."""
+        key = self._goss_rng_key
+        return (jnp.zeros((c,) + key.shape, key.dtype),
+                jnp.zeros((c,), bool))
+
+    def _macro_const_grads(self):
+        """RF overrides with its constant gradients; dummies otherwise."""
+        z = jnp.zeros((1, 1), jnp.float32)
+        return z, z
+
+    def _chunk_valid_update(self, vscore, stacked_seq, binned, its):
+        if self._macro_valid_jit is None:
+            from .macro import build_chunk_valid
+            self._macro_valid_jit = build_chunk_valid(self)
+        return self._macro_valid_jit(vscore, stacked_seq, binned, its,
+                                     np.int32(its.shape[0]))
+
+    def _finish_chunk(self, stacked_seq, c: int, shrinks, it0: int) -> bool:
+        """Chunk counterpart of _finish_iter: per-iteration bookkeeping
+        from ONE stacked ``[c, ...]`` device tree bundle.  Same timer tag
+        as _finish_iter — it is the same role, amortized over c."""
+        from ..utils.timer import global_timer
+        with global_timer.section("GBDT::FinishIter(host trees)"):
+            return self._finish_chunk_inner(stacked_seq, c, shrinks, it0)
+
+    def _chunk_slice(self, stacked_seq, j: int):
+        return jax.tree_util.tree_map(lambda x: x[j], stacked_seq)
+
+    def _chunk_bias_fold(self, st, abs_it: int):
+        """Fold the iter-0 init bias into a history slice (mirrors
+        _finish_iter's handling of the saved device trees)."""
+        if abs_it == 0 and any(abs(s) > K_EPSILON for s in self.init_scores):
+            bias = jnp.asarray(self.init_scores, jnp.float32)[:, None]
+            st = st._replace(leaf_value=st.leaf_value + bias)
+        return st
+
+    def _finish_chunk_inner(self, stacked_seq, c, shrinks, it0) -> bool:
+        K = self.num_tree_per_iteration
+        if self._defer_enabled():
+            # bank per-iteration device slices; host conversion stays one
+            # bulk transfer at _drain_pending, stop detection moves there
+            # exactly as on the per-iteration deferred path
+            for j in range(c):
+                self._pending.append(
+                    (it0 + j, shrinks[j], self._chunk_slice(stacked_seq, j)))
+            if self._history_mode == "all":
+                for j in range(c):
+                    self.tree_history.append(self._chunk_bias_fold(
+                        self._chunk_slice(stacked_seq, j), it0 + j))
+            else:
+                self.tree_history = [self._chunk_bias_fold(
+                    self._chunk_slice(stacked_seq, c - 1), it0 + c - 1)]
+            self.models_version += 1
+            its = jnp.arange(it0, it0 + c, dtype=jnp.int32)
+            for i in range(len(self.valid_scores)):
+                self.valid_scores[i] = self._chunk_valid_update(
+                    self.valid_scores[i], stacked_seq,
+                    self.valid_binned[i], its)
+            self.iter += c
+            return False
+        # eager path: ONE bulk device->host transfer for the whole chunk,
+        # then the per-iteration host bookkeeping of _finish_iter_inner
+        bh = jax.device_get(stacked_seq)
+        stopped = False
+        kept = 0
+        for j in range(c):
+            abs_it = it0 + j
+            new_models, any_split = [], False
+            for k in range(K):
+                tree_k = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x[j][k]), bh)
+                ht = tree_to_host(tree_k, self.train_set, shrinks[j])
+                if ht.num_leaves > 1:
+                    any_split = True
+                if abs_it == 0 and abs(self.init_scores[k]) > K_EPSILON:
+                    ht.add_bias(self.init_scores[k])
+                new_models.append(ht)
+            if not any_split:
+                if abs_it == 0 and not self.models:
+                    for k, ht in enumerate(new_models):
+                        ht.leaf_value[:1] = self.init_scores[k]
+                    self.models.extend(new_models)
+                stopped = True
+                break
+            self.models.extend(new_models)
+            for k in range(K):
+                self.history_scale[len(self.models) - K + k] = 1.0
+            kept = j + 1
+        self.models_version += 1
+        if stopped:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+        if kept:
+            if self._history_mode == "all":
+                for j in range(kept):
+                    self.tree_history.append(self._chunk_bias_fold(
+                        self._chunk_slice(stacked_seq, j), it0 + j))
+            else:
+                self.tree_history = [self._chunk_bias_fold(
+                    self._chunk_slice(stacked_seq, kept - 1),
+                    it0 + kept - 1)]
+            seq_kept = (stacked_seq if kept == c else
+                        jax.tree_util.tree_map(lambda x: x[:kept],
+                                               stacked_seq))
+            its = jnp.arange(it0, it0 + kept, dtype=jnp.int32)
+            for i in range(len(self.valid_scores)):
+                self.valid_scores[i] = self._chunk_valid_update(
+                    self.valid_scores[i], seq_kept, self.valid_binned[i],
+                    its)
+        self.iter = it0 + kept
+        return stopped
 
     @property
     def models(self) -> List[HostTree]:
@@ -1327,7 +1526,7 @@ class GBDT:
             scale = self.history_scale.get(model_idx, 1.0)
             return out * jnp.float32(scale) if scale != 1.0 else out
         p = self.models[model_idx].predict_binned_np(
-            dataset.binned, dataset.feat_group, dataset.feat_start)
+            dataset.host_binned(), dataset.feat_group, dataset.feat_start)
         if binned.shape[1] > len(p):
             p = np.pad(p, (0, binned.shape[1] - len(p)))
         return jnp.asarray(p, jnp.float32)

@@ -34,7 +34,7 @@ class GOSS(GBDT):
         n_pad = self._n_pad
         row_valid = self._row_valid
 
-        def goss_mask(grad, hess, key):
+        def goss_mask_raw(grad, hess, key, row_valid):
             # grad/hess: [K, n_pad]; sharding-pad rows (row_valid == 0) are
             # pushed below any real score so they can never enter the top set
             score = jnp.sum(jnp.abs(grad * hess), axis=0)
@@ -48,12 +48,24 @@ class GOSS(GBDT):
             return jnp.where(is_top, 1.0,
                              jnp.where(keep_rest, amp, 0.0)) * row_valid
 
-        self._goss_mask_fn = jax.jit(goss_mask)
+        # the macro-step scan body (boosting/macro.py) traces the SAME
+        # function with the row mask riding as the scan input
+        self._macro_goss_mask = goss_mask_raw
+        self._goss_mask_fn = jax.jit(
+            lambda grad, hess, key: goss_mask_raw(grad, hess, key,
+                                                  row_valid))
 
     def _bagging_mask(self, it):
         return self._row_valid
 
     def train_one_iter(self, grad=None, hess=None):
+        if grad is None:
+            # macro path: warm-up gating and sampling ride inside the
+            # chunk program (_macro_goss_inputs); keeps per-iteration and
+            # chunked GOSS on the same compiled loop body
+            single = self._chunk_single()
+            if single is not None:
+                return single
         # warm-up: no sampling for the first 1/learning_rate iterations
         warmup = 1.0 / max(self.config.learning_rate, 1e-12)
         if grad is None and self.iter >= warmup:
@@ -63,6 +75,24 @@ class GOSS(GBDT):
             mask = self._goss_mask_fn(g, h, sub)
             return self._train_with(g, h, mask)
         return super().train_one_iter(grad, hess)
+
+    def _macro_goss_inputs(self, c, it0, lrs):
+        """Per-chunk GOSS subkeys: sampling iterations consume a split of
+        the stream in the exact per-iteration order; warm-up iterations
+        (no sampling) leave the stream untouched and get a dummy key.
+        ``lrs`` carries the per-iteration learning rate (a reset_parameter
+        schedule moves the 1/lr warm-up threshold per iteration)."""
+        keys, flags = [], []
+        for j in range(c):
+            warmup = 1.0 / max(lrs[j], 1e-12)
+            if it0 + j >= warmup:
+                self._goss_rng_key, sub = jax.random.split(self._goss_rng_key)
+                keys.append(sub)
+                flags.append(True)
+            else:
+                keys.append(jnp.zeros_like(self._goss_rng_key))
+                flags.append(False)
+        return jnp.stack(keys), jnp.asarray(np.asarray(flags))
 
     def _train_with(self, grad, hess, mask):
         (self.train_score, stacked, leaf_ids,

@@ -53,9 +53,57 @@ class RF(GBDT):
         self._rf_renew_const_init = True
         self._build_jit_fns()
 
+    def _macro_const_grads(self):
+        """The macro-step scan body (boosting/macro.py) uses RF's
+        once-computed gradients as loop-invariant runtime inputs."""
+        return self._grad, self._hess
+
+    def _finish_chunk_inner(self, stacked_seq, c, shrinks, it0) -> bool:
+        """RF chunk finish: eager averaged extension per iteration from ONE
+        bulk device fetch; valid scores renormalized by the fused
+        running-mean scan (macro.build_chunk_valid's rf mode)."""
+        import jax
+        K = self.num_tree_per_iteration
+        bh = jax.device_get(stacked_seq)
+        stopped = False
+        kept = 0
+        for j in range(c):
+            new_models, any_split = [], False
+            for k in range(K):
+                tree_k = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x[j][k]), bh)
+                ht = tree_to_host(tree_k, self.train_set, 1.0)
+                if ht.num_leaves > 1:
+                    any_split = True
+                if abs(self.init_scores[k]) > K_EPSILON:
+                    ht.add_bias(self.init_scores[k])
+                new_models.append(ht)
+            if not any_split:
+                log_warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                stopped = True
+                break
+            self.models.extend(new_models)
+            kept = j + 1
+        self.models_version += 1
+        if kept:
+            seq_kept = (stacked_seq if kept == c else
+                        jax.tree_util.tree_map(lambda x: x[:kept],
+                                               stacked_seq))
+            its = jnp.arange(it0, it0 + kept, dtype=jnp.int32)
+            for i in range(len(self.valid_scores)):
+                self.valid_scores[i] = self._chunk_valid_update(
+                    self.valid_scores[i], seq_kept, self.valid_binned[i],
+                    its)
+        self.iter = it0 + kept
+        return stopped
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if grad is not None:
             raise ValueError("RF mode does not support custom objectives")
+        single = self._chunk_single()
+        if single is not None:
+            return single
         it = self.iter
         mask = self._bagging_mask(it)
         # run the shared step on it*mean (so "+ tree" keeps the sum), then

@@ -40,6 +40,9 @@ def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
                 _format_eval_result(x, show_stdv) for x in env.evaluation_result_list)
             print(f"[{env.iteration + 1}]\t{result}")
     _callback.order = 10
+    # no-op on iterations without evaluation results: the engine's fused
+    # chunk scheduler may skip invoking it for mid-chunk iterations
+    _callback._chunk_safe = True
     return _callback
 
 
@@ -68,6 +71,7 @@ def record_evaluation(eval_result: dict) -> Callable:
 
     _callback.order = 20
     _callback._resume_token = "record_evaluation"
+    _callback._chunk_safe = True   # no-op on empty evaluation lists
     _callback.get_state = _get_state
     _callback.set_state = _set_state
     return _callback
@@ -90,6 +94,11 @@ def reset_parameter(**kwargs) -> Callable:
             env.params.update(new_parameters)
     _callback.before_iteration = True
     _callback.order = 10
+    # a pure learning-rate schedule can ride INSIDE a fused chunk as a
+    # [c] array (engine.py precomputes the per-iteration values); any
+    # other reset forces the per-iteration path
+    _callback._lr_schedule = (kwargs["learning_rate"]
+                              if set(kwargs) == {"learning_rate"} else None)
     return _callback
 
 
@@ -133,6 +142,12 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
 
     def _callback(env: CallbackEnv) -> None:
         if not cmp_op:
+            if not env.evaluation_result_list:
+                # metric_freq gating / fused chunks: iterations without an
+                # evaluation carry no signal — defer init to the first
+                # evaluated iteration (engine.py raises up front when no
+                # eval will ever happen)
+                return
             _init(env)
         if not enabled[0]:
             return
@@ -192,6 +207,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         first_metric[0] = state["first_metric"]
 
     _callback.order = 30
+    _callback._chunk_safe = True   # no-op on empty evaluation lists
     _callback._resume_token = (f"early_stopping({stopping_rounds},"
                                f"{first_metric_only})")
     _callback.get_state = _get_state

@@ -774,6 +774,29 @@ class Dataset:
                 "(pass free_raw_data=False to keep it)")
         return self.raw_data
 
+    def release_host_binned(self) -> "Dataset":
+        """Free the host [n, F] binned matrix once a device-resident copy
+        exists (GBDT.__init__ calls this when ``free_raw_data`` is set on
+        accelerator backends, halving peak RSS for large matrices).  The
+        Dataset can no longer build another booster, subset, save_binary
+        or add_features_from afterwards; ``host_binned`` raises then."""
+        if self.binned is not None:
+            self.binned = None
+            self._host_binned_released = True
+        return self
+
+    def host_binned(self) -> np.ndarray:
+        """The host binned matrix, with an informative error after
+        ``release_host_binned`` dropped it."""
+        if self.binned is None and getattr(self, "_host_binned_released",
+                                           False):
+            raise RuntimeError(
+                "the Dataset's host binned matrix was released after device "
+                "upload (free_raw_data=True on an accelerator backend); "
+                "pass free_raw_data=False or set LGBM_TPU_FREE_BINNED=0 to "
+                "keep it for reuse")
+        return self.binned
+
     def get_params(self) -> dict:
         return dict(self.params)
 
@@ -853,8 +876,8 @@ class Dataset:
             base + f for f in other.used_features]
         dtype = (np.uint16 if max(self.max_group_bin, other.max_group_bin) > 256
                  else np.uint8)
-        self.binned = np.hstack([self.binned.astype(dtype, copy=False),
-                                 other.binned.astype(dtype, copy=False)])
+        self.binned = np.hstack([self.host_binned().astype(dtype, copy=False),
+                                 other.host_binned().astype(dtype, copy=False)])
         self.feat_group = np.concatenate(
             [self.feat_group, other.feat_group + self.num_groups]).astype(np.int32)
         self.feat_start = np.concatenate(
@@ -884,7 +907,7 @@ class Dataset:
                      + ", ".join(self.feature_names) + "\n")
             meta = self.feature_meta().resolved()
             for i in range(self.num_data):
-                row = self.binned[i]
+                row = self.host_binned()[i]
                 bins = []
                 for j in range(F):
                     g = meta.feat_group[j]
@@ -1014,7 +1037,7 @@ class Dataset:
         sub.constructed = True
         sub.bin_mappers = self.bin_mappers
         sub.used_features = self.used_features
-        sub.binned = self.binned[idx]
+        sub.binned = self.host_binned()[idx]
         sub.feat_group = self.feat_group
         sub.feat_start = self.feat_start
         sub.num_groups = self.num_groups
@@ -1040,7 +1063,7 @@ class Dataset:
             "used_features": list(map(int, self.used_features)),
             "feature_names": self.feature_names,
             "bin_mappers": [m.to_dict() for m in self.bin_mappers],
-            "dtype": str(self.binned.dtype),
+            "dtype": str(self.host_binned().dtype),
             "feat_group": list(map(int, self.feat_group)),
             "feat_start": list(map(int, self.feat_start)),
             "num_groups": int(self.num_groups),

@@ -153,21 +153,89 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
         ckpt_mgr = CheckpointManager(f"{snapshot_out}.ckpt",
                                      keep_last=snapshot_keep)
 
+    # eval cadence: the reference's OutputMetric loop evaluates every
+    # ``metric_freq`` (alias output_freq) iterations; default 1 keeps the
+    # historical evaluate-every-round behavior
+    mf = max(int(cfg.metric_freq), 1)
+    eval_possible = bool(
+        (valid_sets and booster.boosting.valid_metrics)
+        or feval is not None or cfg.is_provide_training_metric
+        or train_in_valid)
+    # early_stopping's init error moved up front: non-eval iterations no
+    # longer reach the callback's init, so "no eval at all" must be
+    # diagnosed here (dart disables early stopping inside the callback)
+    is_dart = any(params.get(a, "") == "dart"
+                  for a in ("boosting", "boosting_type", "boost"))
+    has_early_stop = any(
+        str(getattr(cb, "_resume_token", "")).startswith("early_stopping")
+        for cb in cbs_after)
+    if has_early_stop and not is_dart and not eval_possible \
+            and num_boost_round > start_iter:
+        raise ValueError(
+            "For early stopping, at least one dataset and eval metric is "
+            "required for evaluation")
+
+    # fused macro-steps (boosting/macro.py): chunk the boosting loop into
+    # lax.scan programs of c iterations each, chunks ending at the next
+    # boundary that genuinely needs the host — eval (metric_freq),
+    # snapshots, end of training.  Per-iteration host logic (DART, CEGB,
+    # forced splits, custom fobj, non-schedule callbacks) forces c=1.
+    from .boosting.macro import chunk_cap, pow2_chunk
+    cap = chunk_cap()
+    lr_cbs = [cb for cb in cbs_before
+              if getattr(cb, "_lr_schedule", None) is not None]
+    lr_lists_ok = all(
+        not isinstance(cb._lr_schedule, list)
+        or len(cb._lr_schedule) == num_boost_round for cb in lr_cbs)
+    can_chunk = (cap > 1 and fobj is None
+                 and booster.boosting.chunk_supported()
+                 and len(lr_cbs) == len(cbs_before) and lr_lists_ok
+                 and all(getattr(cb, "_chunk_safe", False)
+                         for cb in cbs_after))
+
+    def _lr_at(j):
+        v = None
+        for cb in lr_cbs:
+            s = cb._lr_schedule
+            v = s[j] if isinstance(s, list) else s(j)
+        return float(v)
+
     evaluation_result_list = []
-    for i in range(start_iter, num_boost_round):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
-        finished = booster.update(fobj=fobj)
+    i = start_iter
+    while i < num_boost_round:
+        c = 1
+        if can_chunk:
+            d = num_boost_round - i
+            if eval_possible:
+                d = min(d, mf - (i % mf))
+            if ckpt_mgr is not None:
+                d = min(d, snapshot_freq - (i % snapshot_freq))
+            c = pow2_chunk(d, cap)
+        if c > 1:
+            lrs = ([_lr_at(j) for j in range(i, i + c)] if lr_cbs else None)
+            finished = booster.update_chunk(c, lrs)
+            if lrs is not None:
+                # replicate the last reset_parameter side effects so the
+                # post-chunk state matches per-iteration training
+                booster.reset_parameter({"learning_rate": lrs[-1]})
+                params["learning_rate"] = lrs[-1]
+            i += c
+        else:
+            for cb in cbs_before:
+                cb(callback_mod.CallbackEnv(booster, params, i, 0,
+                                            num_boost_round, None))
+            finished = booster.update(fobj=fobj)
+            i += 1
+        j = i - 1        # last iteration trained this turn
         evaluation_result_list = []
-        if (valid_sets and booster.boosting.valid_metrics) or feval is not None \
-                or cfg.is_provide_training_metric or train_in_valid:
+        if eval_possible and (j + 1) % mf == 0:
             if cfg.is_provide_training_metric or train_in_valid:
                 evaluation_result_list.extend(booster.eval_train(feval))
             evaluation_result_list.extend(booster.eval_valid(feval))
         early_stopped = False
         try:
             for cb in cbs_after:
-                cb(callback_mod.CallbackEnv(booster, params, i, 0,
+                cb(callback_mod.CallbackEnv(booster, params, j, 0,
                                             num_boost_round, evaluation_result_list))
         except callback_mod.EarlyStopException as e:
             booster.best_iteration = e.best_iteration + 1
@@ -177,9 +245,9 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
             early_stopped = True
         # snapshot even on the iteration that triggered early stop
         # (reference: GBDT::Train reaches the snapshot write, gbdt.cpp:259-263)
-        if ckpt_mgr is not None and (i + 1) % snapshot_freq == 0:
+        if ckpt_mgr is not None and (j + 1) % snapshot_freq == 0:
             ckpt_mgr.save(
-                booster, iteration=i + 1,
+                booster, iteration=j + 1,
                 engine_state={"callbacks": _collect_callback_states(
                     cbs_before + cbs_after)})
         if early_stopped or finished:

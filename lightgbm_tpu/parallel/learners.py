@@ -36,6 +36,27 @@ DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
 
+def shard_map_compat(f=None, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` with old-jax fallback.
+
+    The repo targets the stable ``jax.shard_map`` API (``check_vma``);
+    jax <= 0.4.x only ships ``jax.experimental.shard_map.shard_map``
+    (``check_rep``).  Every shard_map call site routes through here so
+    the distributed paths work on both.  Usable directly or as a
+    decorator factory (``f=None``)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    else:
+        from jax.experimental.shard_map import shard_map as sm
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+    if f is None:
+        return functools.partial(sm, **kw)
+    return sm(f, **kw)
+
+
 def pad_rows_to(n: int, devices: int) -> int:
     return (n + devices - 1) // devices * devices
 
@@ -66,7 +87,7 @@ def make_sharded_grower(
                    else P(None, data_axis))
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map_compat, mesh=mesh,
         in_specs=(binned_spec, row_spec, row_spec, row_spec),
         out_specs=(P(), row_spec),
         check_vma=False,
@@ -98,6 +119,14 @@ def shard_dataset(mesh: Mesh, binned: np.ndarray, *row_arrays,
         a = np.pad(np.asarray(arr), (0, n_pad - n))
         out.append(jax.device_put(a, NamedSharding(mesh, P(data_axis))))
     return out, n_pad
+
+
+def put_stacked_rows(mesh: Mesh, data_axis: str, stacked: jax.Array) -> jax.Array:
+    """Place a ``[c, n_pad]`` stack of per-iteration row arrays (bagging /
+    GOSS masks for a fused macro-step chunk, boosting/macro.py) with the
+    ROW axis sharded like every other per-row array, so the chunk scan's
+    per-step slices feed shard_map without a cross-device gather."""
+    return jax.device_put(stacked, NamedSharding(mesh, P(None, data_axis)))
 
 
 def make_mesh(n_devices: Optional[int] = None,

@@ -92,9 +92,15 @@ def build_bundle_bytes(booster, iteration: int,
         "engine": dict(engine_state or {}),
     }
     state_pkl = pickle.dumps(state, protocol=4)
+    # provenance only, never validated on restore: resumed runs replay
+    # bit-identically under ANY chunk decomposition (the macro-step loop
+    # body is chunk-size-invariant, boosting/macro.py), so a bundle from
+    # a chunked run restores into a per-iteration run and vice versa
+    from ..boosting.macro import chunk_cap
     manifest = {
         "format": FORMAT,
         "iteration": int(iteration),
+        "chunk_cap": chunk_cap(),
         "members": {
             "model.txt": {"sha256": _sha256(model_txt),
                           "size": len(model_txt)},

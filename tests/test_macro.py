@@ -1,0 +1,302 @@
+"""Fused multi-iteration macro-steps (lightgbm_tpu/boosting/macro.py).
+
+The hard contract: chunked training composes the SAME iter_body in one
+runtime-trip-count loop program, so ``update_chunk(c)`` must produce
+models BYTE-IDENTICAL to per-iteration ``update()`` for every supported
+mode and every chunk decomposition — serial and sharded, eager and
+deferred-host, through checkpoints and early stopping.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+RNG = np.random.RandomState(7)
+N, F = 1200, 10
+X = RNG.randn(N, F)
+Y_BIN = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.2 * RNG.randn(N) > 0).astype(float)
+Y_REG = (X[:, 0] - X[:, 1] + 0.1 * RNG.randn(N))
+Y_MC = np.digitize(X[:, 0] + X[:, 1], [-0.5, 0.5]).astype(float)
+
+XV = RNG.randn(400, F)
+YV_BIN = (XV[:, 0] + 0.5 * XV[:, 1] * XV[:, 2] + 0.2 * RNG.randn(400) > 0).astype(float)
+
+PARITY_CASES = {
+    "gbdt": ({"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.1}, Y_BIN),
+    "bagging": ({"objective": "binary", "num_leaves": 15,
+                 "learning_rate": 0.1, "bagging_fraction": 0.7,
+                 "bagging_freq": 2, "bagging_seed": 11}, Y_BIN),
+    "goss": ({"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "learning_rate": 0.2}, Y_BIN),
+    "rf": ({"objective": "binary", "boosting": "rf", "num_leaves": 15,
+            "bagging_fraction": 0.6, "bagging_freq": 1}, Y_BIN),
+    "monotone": ({"objective": "regression", "num_leaves": 15,
+                  "learning_rate": 0.1,
+                  "monotone_constraints": [1, -1] + [0] * (F - 2)}, Y_REG),
+    "multiclass": ({"objective": "multiclass", "num_class": 3,
+                    "num_leaves": 7, "learning_rate": 0.1}, Y_MC),
+}
+
+
+def _booster(params, y, **ds_kw):
+    params = dict(params, verbosity=-1)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False, **ds_kw)
+    return lgb.Booster(params=params, train_set=ds)
+
+
+def _train(params, y, chunks):
+    b = _booster(params, y)
+    for c in chunks:
+        if c > 1:
+            b.update_chunk(c)
+        else:
+            b.update()
+    return b.model_to_string()
+
+
+@pytest.mark.parametrize("case", sorted(PARITY_CASES))
+def test_chunked_equals_per_iteration(case):
+    params, y = PARITY_CASES[case]
+    per_iter = _train(params, y, [1] * 12)
+    chunked = _train(params, y, [8, 4])
+    mixed = _train(params, y, [2, 1, 4, 2, 2, 1])
+    assert chunked == per_iter, f"{case}: chunk(8,4) != per-iteration"
+    assert mixed == per_iter, f"{case}: mixed chunks != per-iteration"
+
+
+def test_chunked_equals_per_iteration_deferred_host(monkeypatch):
+    """The deferred-host banking path (accelerator default) slices the
+    chunk bundle into per-iteration pending entries; the drain must see
+    exactly what per-iteration training banks."""
+    monkeypatch.setenv("LGBT_DEFER_HOST_TREES", "1")
+    params, y = PARITY_CASES["gbdt"]
+    assert _train(params, y, [8, 4]) == _train(params, y, [1] * 12)
+
+
+def test_chunked_equals_per_iteration_sharded():
+    """Data-parallel over the virtual 8-device CPU mesh: the chunk scan
+    wraps the shard_map'd iter_body; stacked row inputs keep the row
+    sharding (parallel/learners.py put_stacked_rows)."""
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.1, "tree_learner": "data"}
+    assert _train(params, Y_BIN, [8, 4]) == _train(params, Y_BIN, [1] * 12)
+
+
+def test_lr_schedule_parity_via_engine():
+    """reset_parameter learning-rate schedules ride into the chunk as a
+    [c] array; engine-chunked training must equal per-iteration."""
+    sched = [0.1 * (0.97 ** i) for i in range(16)]
+
+    def run(env):
+        os.environ["LGBM_TPU_CHUNK"] = env
+        try:
+            ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+            return lgb.train(
+                {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+                ds, num_boost_round=16, learning_rates=sched,
+                verbose_eval=False).model_to_string()
+        finally:
+            os.environ.pop("LGBM_TPU_CHUNK", None)
+
+    assert run("32") == run("0")
+
+
+def test_early_stopping_parity_via_engine():
+    def run(env):
+        os.environ["LGBM_TPU_CHUNK"] = env
+        try:
+            ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+            vs = lgb.Dataset(XV, label=YV_BIN, reference=ds,
+                             free_raw_data=False)
+            evals = {}
+            bst = lgb.train(
+                {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+                 "metric": "binary_logloss", "metric_freq": 2},
+                ds, num_boost_round=60, valid_sets=[vs],
+                early_stopping_rounds=4, evals_result=evals,
+                verbose_eval=False)
+            return bst.best_iteration, bst.model_to_string(), evals
+        finally:
+            os.environ.pop("LGBM_TPU_CHUNK", None)
+
+    it_on, model_on, ev_on = run("32")
+    it_off, model_off, ev_off = run("0")
+    assert it_on == it_off
+    assert model_on == model_off
+    assert ev_on == ev_off
+
+
+def test_rf_valid_scores_parity_via_engine():
+    """RF's running-mean valid-score renormalization rides the fused
+    valid updater (macro.build_chunk_valid rf mode); eval history and
+    model must match per-iteration training."""
+    def run(env):
+        os.environ["LGBM_TPU_CHUNK"] = env
+        try:
+            ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+            vs = lgb.Dataset(XV, label=YV_BIN, reference=ds,
+                             free_raw_data=False)
+            evals = {}
+            bst = lgb.train(
+                {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+                 "bagging_fraction": 0.6, "bagging_freq": 1,
+                 "verbosity": -1, "metric": "binary_logloss",
+                 "metric_freq": 4},
+                ds, num_boost_round=8, valid_sets=[vs],
+                evals_result=evals, verbose_eval=False)
+            return bst.model_to_string(), evals
+        finally:
+            os.environ.pop("LGBM_TPU_CHUNK", None)
+
+    m_on, ev_on = run("32")
+    m_off, ev_off = run("0")
+    assert m_on == m_off
+    # metric VALUES may differ from the legacy gate-off path by ~1 ulp of
+    # score (docs/PERF.md: RF's running-mean renorm contracts differently
+    # in the legacy eager ops); within the macro path they are exact
+    np.testing.assert_allclose(
+        ev_on["valid_0"]["binary_logloss"],
+        ev_off["valid_0"]["binary_logloss"], rtol=1e-7)
+
+
+def test_resume_from_checkpoint_mid_stream(tmp_path):
+    """A checkpoint written mid-stream by a chunked run must resume to the
+    byte-identical final model — under chunking AND per-iteration."""
+    snap = str(tmp_path / "m.txt")
+
+    def run(env, resume=None):
+        os.environ["LGBM_TPU_CHUNK"] = env
+        try:
+            ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+            return lgb.train(
+                {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                 "bagging_fraction": 0.7, "bagging_freq": 1},
+                ds, num_boost_round=14, verbose_eval=False,
+                snapshot_freq=5, snapshot_out=snap,
+                resume_from=resume).model_to_string()
+        finally:
+            os.environ.pop("LGBM_TPU_CHUNK", None)
+
+    full = run("32")
+    resumed_chunked = run("32", resume=snap + ".ckpt")
+    resumed_periter = run("0", resume=snap + ".ckpt")
+    assert resumed_chunked == full
+    assert resumed_periter == full
+
+
+def test_metric_freq_gates_eval():
+    """config.metric_freq (alias output_freq) was parsed but never read;
+    the engine now evaluates every metric_freq-th iteration like the
+    reference's OutputMetric loop."""
+    ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+    vs = lgb.Dataset(XV, label=YV_BIN, reference=ds, free_raw_data=False)
+    evals = {}
+    lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+               "metric": "binary_logloss", "output_freq": 3},
+              ds, num_boost_round=12, valid_sets=[vs],
+              evals_result=evals, verbose_eval=False)
+    assert len(evals["valid_0"]["binary_logloss"]) == 4
+
+
+def test_early_stopping_without_valid_raises():
+    """The init-time error moved up front (callbacks now skip no-eval
+    iterations); training with early stopping but nothing to evaluate
+    must still fail loudly."""
+    ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+    with pytest.raises(ValueError, match="at least one dataset"):
+        lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "metric": "None"},
+                  ds, num_boost_round=5, early_stopping_rounds=2,
+                  verbose_eval=False)
+
+
+@pytest.mark.parametrize("params", [
+    {"objective": "binary", "boosting": "dart", "num_leaves": 15},
+    {"objective": "binary", "num_leaves": 15, "cegb_penalty_split": 0.1},
+])
+def test_c1_fallback_modes(params):
+    """DART drop/rollback and CEGB bitmaps need per-iteration host logic:
+    chunk_supported is False, update_chunk refuses, and engine training
+    with the chunk gate ON still works through the c=1 path."""
+    b = _booster(params, Y_BIN)
+    assert not b.boosting.chunk_supported()
+    with pytest.raises(RuntimeError, match="per-iteration"):
+        b.update_chunk(4)
+    os.environ["LGBM_TPU_CHUNK"] = "32"
+    try:
+        ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+        bst = lgb.train(dict(params, verbosity=-1), ds, num_boost_round=4,
+                        verbose_eval=False)
+        assert bst.current_iteration() == 4
+    finally:
+        os.environ.pop("LGBM_TPU_CHUNK", None)
+
+
+def test_custom_fobj_not_chunk_supported():
+    ds = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+    bst = lgb.train({"num_leaves": 15, "verbosity": -1}, ds,
+                    num_boost_round=3, verbose_eval=False,
+                    fobj=lambda preds, d: (
+                        1.0 / (1.0 + np.exp(-preds)) - d.get_label(),
+                        np.full(len(preds), 0.25)))
+    assert bst.num_trees() == 3
+    assert not bst.boosting.chunk_supported()
+
+
+def test_chunk_stop_on_unsplittable():
+    """A chunk whose early iteration produces no splittable leaves must
+    truncate exactly like per-iteration training (constant labels stop
+    at iteration 0 with the boost-from-average constant tree)."""
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    y_const = np.full(N, 3.25)
+    ds = lgb.Dataset(X, label=y_const, free_raw_data=False)
+    b = lgb.Booster(params=params, train_set=ds)
+    stopped = b.update_chunk(4)
+    assert stopped
+    assert b.current_iteration() == 0
+    assert b.num_trees() == 1          # the constant AsConstantTree stump
+    pred = b.predict(X[:5])
+    np.testing.assert_allclose(pred, 3.25, rtol=1e-6)
+
+
+def test_release_host_binned(monkeypatch):
+    """free_raw_data + LGBM_TPU_FREE_BINNED=1 drops the host binned
+    matrix after device upload; reuse fails with the informative error
+    while prediction and training keep working."""
+    monkeypatch.setenv("LGBM_TPU_FREE_BINNED", "1")
+    ds = lgb.Dataset(X, label=Y_BIN)          # free_raw_data default True
+    b = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                            "verbosity": -1}, train_set=ds)
+    assert ds.binned is None
+    for _ in range(3):
+        b.update()
+    assert b.num_trees() == 3
+    assert np.isfinite(b.predict(X[:8])).all()
+    with pytest.raises(RuntimeError, match="released"):
+        lgb.Booster(params={"objective": "binary", "verbosity": -1},
+                    train_set=ds)
+    # free_raw_data=False keeps the host copy regardless
+    ds2 = lgb.Dataset(X, label=Y_BIN, free_raw_data=False)
+    lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                        "verbosity": -1}, train_set=ds2)
+    assert ds2.binned is not None
+
+
+@pytest.mark.perf
+def test_dispatch_probe_json():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from dispatch_probe import run_probe
+    out = run_probe(rows=4000, features=8, leaves=15, iters=4, chunks=(4,))
+    assert out["dispatch_ms"] > 0
+    assert out["per_iter"]["iters_per_sec"] > 0
+    assert out["fused"]["4"]["iters_per_sec"] > 0
+    assert "speedup_vs_per_iter" in out["fused"]["4"]

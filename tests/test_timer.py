@@ -38,9 +38,12 @@ def test_timer_disabled_is_noop():
     assert t.items() == {}
 
 
-def test_training_tags_hot_paths():
+def test_training_tags_hot_paths(monkeypatch):
     """The tagged sections mirror the reference's global_timer tags
-    (gbdt.cpp:153,211; serial_tree_learner.cpp:150)."""
+    (gbdt.cpp:153,211; serial_tree_learner.cpp:150).  Pinned to the
+    legacy per-iteration path (LGBM_TPU_CHUNK=0); fused macro-steps
+    amortize rounds over chunks and are checked separately below."""
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "0")
     global_timer.reset()
     global_timer.enable()
     try:
@@ -57,6 +60,29 @@ def test_training_tags_hot_paths():
                     "GBDT::FinishIter(host trees)", "Booster::Predict"):
             assert key in items, (key, sorted(items))
         assert items["GBDT::TrainOneIter"][0] == 3
+    finally:
+        global_timer.disable()
+        global_timer.reset()
+
+
+def test_training_tags_chunked():
+    """The fused macro-step path keeps the dispatch/finish tags: 3 rounds
+    under the default chunk gate = one c=2 chunk + one c=1 step, each
+    tagged once."""
+    global_timer.reset()
+    global_timer.enable()
+    try:
+        rng = np.random.RandomState(0)
+        X = rng.rand(500, 4)
+        y = (X[:, 0] > 0.5).astype(np.float32)
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+        items = global_timer.items()
+        for key in ("TreeLearner::Train(dispatch)",
+                    "GBDT::FinishIter(host trees)"):
+            assert key in items, (key, sorted(items))
+        assert items["TreeLearner::Train(dispatch)"][0] == 2
     finally:
         global_timer.disable()
         global_timer.reset()

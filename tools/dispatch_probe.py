@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Dispatch-overhead micro-bench: per-iteration dispatch cost vs. fused
+macro-step throughput (boosting/macro.py).
+
+Measures, on the live backend:
+
+- ``dispatch_ms``: the fixed cost of launching a trivial jitted program
+  (the floor every per-iteration training round pays from Python);
+- ``per_iter``: iters/sec training one jitted program per boosting round
+  (``LGBM_TPU_CHUNK=0`` legacy path semantics, via ``update_chunk(1)``
+  so the compiled loop body is identical and only the DISPATCH COUNT
+  differs);
+- ``fused[c]``: iters/sec with ``update_chunk(c)`` for each chunk size
+  in the ladder — same trees, 1/c as many dispatches.
+
+The LAST stdout line is a single JSON object so bench.py's worker can
+bank it as a stage (``stage: dispatch_probe``); the probe-backed test in
+tests/test_macro.py is registered under the ``perf`` pytest marker.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/dispatch_probe.py \
+        [--rows 100000] [--features 28] [--leaves 63] [--iters 24] \
+        [--chunks 8,16,32]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_dispatch_ms(reps: int = 50) -> float:
+    """Fixed per-program dispatch cost: a trivial donated jitted program
+    on a tiny buffer, timed end-to-end including the host round-trip."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((8,), jnp.float32)
+    f(x).block_until_ready()            # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        f(x).block_until_ready()
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def run_probe(rows=100_000, features=28, leaves=63, iters=24,
+              chunks=(8, 16, 32), max_bin=63) -> dict:
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, features).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": leaves,
+              "max_bin": max_bin, "learning_rate": 0.1, "verbosity": -1}
+    train_set = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    train_set.construct()
+    del X
+
+    def sync(b):
+        jax.block_until_ready(b.boosting.train_score)
+
+    out = {
+        "rows": rows, "features": features, "leaves": leaves,
+        "iters_per_mode": iters,
+        "platform": jax.devices()[0].platform,
+        "dispatch_ms": round(measure_dispatch_ms(), 3),
+    }
+
+    # per-iteration path: one dispatch per boosting round (same compiled
+    # loop body as the fused path — only the dispatch count differs)
+    booster = lgb.Booster(params=params, train_set=train_set)
+    booster.update()                    # compile outside the clock
+    sync(booster)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        booster.update()
+    sync(booster)
+    per_iter_s = time.perf_counter() - t0
+    out["per_iter"] = {"iters_per_sec": round(iters / per_iter_s, 2),
+                       "ms_per_iter": round(per_iter_s / iters * 1e3, 2)}
+
+    # fused macro-steps: whole chunks only, so exactly one program shape
+    # compiles (outside the clock) and the timed loop is pure dispatch+run
+    out["fused"] = {}
+    for c in chunks:
+        c = min(c, iters)
+        n_chunks = max(iters // c, 1)
+        fused_iters = n_chunks * c
+        booster = lgb.Booster(params=params, train_set=train_set)
+        booster.update_chunk(c)                # compile outside the clock
+        sync(booster)
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            booster.update_chunk(c)
+        sync(booster)
+        fused_s = time.perf_counter() - t0
+        ms_per_iter = fused_s / fused_iters * 1e3
+        out["fused"][str(c)] = {
+            "iters": fused_iters,
+            "iters_per_sec": round(fused_iters / fused_s, 2),
+            "ms_per_iter": round(ms_per_iter, 2),
+            "speedup_vs_per_iter": round(
+                (per_iter_s / iters * 1e3) / ms_per_iter, 3),
+        }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--leaves", type=int, default=63)
+    ap.add_argument("--max-bin", type=int, default=63)
+    ap.add_argument("--iters", type=int, default=24)
+    ap.add_argument("--chunks", default="8,16,32")
+    args = ap.parse_args()
+    chunks = tuple(int(c) for c in args.chunks.split(",") if c)
+    out = run_probe(args.rows, args.features, args.leaves, args.iters,
+                    chunks, args.max_bin)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
