@@ -52,7 +52,13 @@ Env overrides: BENCH_ROWS, BENCH_TREES, BENCH_LEAVES, BENCH_BIN,
 BENCH_FORCE_CPU=1 (skip TPU entirely), BENCH_PROFILE=1 (jax.profiler trace
 to ./bench_trace), BENCH_TOTAL_BUDGET (s, default 6600),
 BENCH_CPU_ROWS / BENCH_CPU_TREES, BENCH_SMOKE_ROWS / BENCH_SMOKE_TREES,
-BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1.
+BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1, BENCH_SKIP_HIST_PROBE=1.
+
+Stage journal: every completed worker stage persists its result to
+BENCH_JOURNAL (default ./bench_journal.json, atomic writes) under a
+workload fingerprint; a rerun after a mid-run crash replays the banked
+stages and executes only the missing ones.  BENCH_ONLY=<stage[,stage]>
+selects exactly those worker stages.  BENCH_JOURNAL=0 disables.
 """
 import json
 import os
@@ -605,8 +611,124 @@ COMPILE_VARIANT_ENVS = [e for i, e in enumerate(_VARIANT_LADDER)
 
 # --------------------------------------------------------------- TPU worker
 
+# ---- stage journal ------------------------------------------------------
+# Every completed worker stage persists its result JSON incrementally
+# (atomic via file_io.write_atomic), keyed under a workload fingerprint.
+# A rerun — or a retry attempt after a TPU kernel fault killed the worker
+# mid-run (round 5: ranking and epsilon crashed and were never retried) —
+# re-emits the banked results and executes ONLY the missing stages.
+# Errors are emitted but never journaled, so failed stages retry.
+# BENCH_JOURNAL=<path> overrides the location (default
+# ./bench_journal.json next to this file); BENCH_JOURNAL=0 disables.
+# BENCH_ONLY=<stage[,stage]> runs exactly those worker stages (budget
+# gates are bypassed for explicitly selected stages).
+
+
+def _journal_path():
+    p = os.environ.get("BENCH_JOURNAL",
+                       os.path.join(REPO, "bench_journal.json"))
+    return None if str(p).strip().lower() in ("", "0", "off", "none") else p
+
+
+_JOURNAL_FP_EXTRA = None
+
+
+def _journal_fingerprint():
+    """Workload shape + BACKEND + code revision: a banked result must
+    never replay for a different platform (CPU-allowed CI run masking a
+    later TPU bench) or after the kernels changed underneath it."""
+    global _JOURNAL_FP_EXTRA
+    if _JOURNAL_FP_EXTRA is None:
+        plat = "unknown"
+        try:
+            import jax
+            plat = jax.default_backend()   # journal use is post-init only
+        except Exception:
+            pass
+        rev = ""
+        try:
+            r = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                               cwd=REPO, capture_output=True, text=True,
+                               timeout=10)
+            rev = r.stdout.strip()
+        except Exception:
+            pass
+        _JOURNAL_FP_EXTRA = {"platform": plat, "code": rev}
+    return {"rows": N, "trees": TREES, "leaves": LEAVES, "max_bin": MAX_BIN,
+            "extra_params": os.environ.get("BENCH_EXTRA_PARAMS", ""),
+            **_JOURNAL_FP_EXTRA}
+
+
+def journal_stages() -> dict:
+    """Banked stage results for THIS workload fingerprint ({} otherwise)."""
+    path = _journal_path()
+    if not path:
+        return {}
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if d.get("fingerprint") != _journal_fingerprint():
+        return {}
+    stages = d.get("stages", {})
+    return stages if isinstance(stages, dict) else {}
+
+
+def journal_put(key, result) -> None:
+    path = _journal_path()
+    if not path:
+        return
+    from lightgbm_tpu.utils.file_io import write_atomic
+    payload = {"fingerprint": _journal_fingerprint(),
+               "stages": dict(journal_stages(), **{key: result})}
+    try:
+        write_atomic(path, json.dumps(payload, indent=1))
+    except OSError as e:
+        log(f"journal write failed ({e}); continuing without journal")
+
+
+def bench_only():
+    v = os.environ.get("BENCH_ONLY", "").strip()
+    if not v:
+        return None
+    return {s.strip() for s in v.split(",") if s.strip()} or None
+
+
+def run_stage(name, fn, key=None, budget_floor=0.0):
+    """Run one worker stage through the journal + BENCH_ONLY selector.
+
+    Returns the stage dict (fresh or journal-replayed), ``None`` when the
+    stage was skipped (deselected / budget floor / skip env), or a dict
+    with ``"error"`` when it raised (emitted, not journaled)."""
+    only = bench_only()
+    if only is not None and name not in only:
+        return None
+    key = key or name
+    saved = journal_stages().get(key)
+    if saved is not None and "error" not in saved:
+        emit(dict(saved, stage=name, journal=True))
+        return saved
+    if only is None and budget_floor and remaining_budget() <= budget_floor:
+        return None
+    t1 = time.time()
+    try:
+        r = dict(fn())
+    except Exception as e:
+        err = {"stage": name, "error": str(e)[-800:],
+               "traceback_tail": traceback.format_exc()[-800:]}
+        emit(err)
+        return err
+    r["stage"] = name
+    r["elapsed"] = round(time.time() - t1, 1)
+    journal_put(key, r)
+    emit(r)
+    return r
+
+
 def tpu_worker():
-    """One warmed process: backend init -> kernel probe -> smoke -> full.
+    """One warmed process: backend init -> probes -> smoke -> full ->
+    telemetry stages, each routed through the stage journal above.
 
     Emits a JSON line per stage so the parent banks partial telemetry even
     if a later stage wedges or the process dies.  Exit codes: 0 full run
@@ -635,92 +757,70 @@ def tpu_worker():
         return 3
 
     if os.environ.get("BENCH_SKIP_KERNEL_PROBE") != "1":
-        try:
-            t1 = time.time()
-            probe = kernel_probe(min(N, 1_000_000), F, MAX_BIN)
-            probe.update({"stage": "kernel_probe",
-                          "elapsed": round(time.time() - t1, 1)})
-            emit(probe)
-        except Exception as e:
-            emit({"stage": "kernel_probe", "error": str(e)[-500:]})
+        run_stage("kernel_probe",
+                  lambda: kernel_probe(min(N, 1_000_000), F, MAX_BIN))
 
     if os.environ.get("BENCH_SKIP_DISPATCH_PROBE") != "1":
-        try:
-            t1 = time.time()
-            sys.path.insert(0, os.path.join(REPO, "tools"))
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _dispatch():
             from dispatch_probe import run_probe
-            dp = run_probe(rows=min(N, 100_000), iters=12, chunks=(8, 32))
-            dp.update({"stage": "dispatch_probe",
-                       "elapsed": round(time.time() - t1, 1)})
-            emit(dp)
-        except Exception as e:
-            emit({"stage": "dispatch_probe", "error": str(e)[-500:]})
+            return run_probe(rows=min(N, 100_000), iters=12, chunks=(8, 32))
+        run_stage("dispatch_probe", _dispatch)
+
+    # f32-vs-quantized histogram throughput + psum payload accounting
+    # (tools/hist_probe.py) — cheap, banked before the long stages
+    if os.environ.get("BENCH_SKIP_HIST_PROBE") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _hist():
+            from hist_probe import run_probe as hist_run
+            return hist_run(rows=min(N, 1_000_000), features=F,
+                            max_bin=MAX_BIN, leaves=LEAVES)
+        run_stage("hist_probe", _hist)
 
     if os.environ.get("BENCH_SKIP_SMOKE") != "1":
-        try:
-            t1 = time.time()
-            smoke = run_bench(min(SMOKE_N, N), min(SMOKE_TREES, TREES),
-                              LEAVES, MAX_BIN, tag="-smoke")
-            smoke["stage"] = "smoke"
-            smoke["elapsed"] = round(time.time() - t1, 1)
-            emit(smoke)
-        except Exception as e:
-            emit({"stage": "smoke", "error": str(e)[-800:],
-                  "traceback_tail": traceback.format_exc()[-800:]})
+        smoke = run_stage(
+            "smoke", lambda: run_bench(min(SMOKE_N, N),
+                                       min(SMOKE_TREES, TREES),
+                                       LEAVES, MAX_BIN, tag="-smoke"))
+        if smoke is not None and "error" in smoke:
             return 4
 
-    try:
-        n_full = int(os.environ.get("BENCH_WORKER_ROWS", N))
-        full = run_bench(n_full, TREES, LEAVES, MAX_BIN,
-                         tag="" if n_full == N else "-reduced")
-        full["stage"] = "full"
+    n_full = int(os.environ.get("BENCH_WORKER_ROWS", N))
+
+    def _full():
+        r = run_bench(n_full, TREES, LEAVES, MAX_BIN,
+                      tag="" if n_full == N else "-reduced")
         if n_full != N:
-            full["note"] = (f"row count reduced from {N} to {n_full}: the "
-                            "remote compile service hung on the full-size "
-                            "program (largest compilable scale banked)")
-        emit(full)
-    except Exception as e:
-        emit({"stage": "full", "error": str(e)[-800:],
-              "traceback_tail": traceback.format_exc()[-800:]})
+            r["note"] = (f"row count reduced from {N} to {n_full}: the "
+                         "remote compile service hung on the full-size "
+                         "program (largest compilable scale banked)")
+        return r
+
+    # journal key carries the row count: retry attempts at halved rows
+    # must not replay a different scale's banked result
+    full = run_stage("full", _full, key=f"full@{n_full}")
+    if full is not None and "error" in full:
         return 4
 
     # MSLR-side benchmark (lambdarank + NDCG@10, BASELINE.md) with the
     # leftover budget — strictly after the headline number is banked
-    if os.environ.get("BENCH_SKIP_RANKING") != "1" and remaining_budget() > 900:
-        try:
-            t1 = time.time()
-            r = run_ranking_bench(RANK_QUERIES, RANK_DOCS, RANK_TREES,
-                                  LEAVES, MAX_BIN)
-            r["stage"] = "ranking"
-            r["elapsed"] = round(time.time() - t1, 1)
-            emit(r)
-        except Exception as e:
-            emit({"stage": "ranking", "error": str(e)[-500:]})
+    if os.environ.get("BENCH_SKIP_RANKING") != "1":
+        run_stage("ranking",
+                  lambda: run_ranking_bench(RANK_QUERIES, RANK_DOCS,
+                                            RANK_TREES, LEAVES, MAX_BIN),
+                  budget_floor=900)
 
     # serving-throughput metric (lightgbm_tpu/serving/): the request-path
     # half of the north star, after every training number is banked
-    if os.environ.get("BENCH_SKIP_SERVING") != "1" and remaining_budget() > 300:
-        try:
-            t1 = time.time()
-            r = run_serving_bench()
-            r["stage"] = "serving"
-            r["elapsed"] = round(time.time() - t1, 1)
-            emit(r)
-        except Exception as e:
-            emit({"stage": "serving", "error": str(e)[-500:]})
+    if os.environ.get("BENCH_SKIP_SERVING") != "1":
+        run_stage("serving", run_serving_bench, budget_floor=300)
 
     # fault-tolerance overhead (lightgbm_tpu/resilience/): checkpoint
     # save/load cost + resume bit-parity on the live backend
-    if os.environ.get("BENCH_SKIP_RESILIENCE") != "1" \
-            and remaining_budget() > 240:
-        try:
-            t1 = time.time()
-            r = run_resilience_bench()
-            r["stage"] = "resilience"
-            r["elapsed"] = round(time.time() - t1, 1)
-            emit(r)
-        except Exception as e:
-            emit({"stage": "resilience", "error": str(e)[-500:]})
+    if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
+        run_stage("resilience", run_resilience_bench, budget_floor=240)
     return 0
 
 
@@ -833,6 +933,10 @@ def _annotate(line, tpu_stages, cpu_result):
     if probe:
         line["hist_kernel_probe_ms"] = {
             k: v for k, v in probe.items() if k not in ("stage", "elapsed")}
+    hp = collect_ok(tpu_stages, "hist_probe")
+    if hp:
+        line["hist_probe"] = {k: v for k, v in hp.items()
+                              if k not in ("stage", "elapsed")}
     init = collect_ok(tpu_stages, "init")
     if init:
         line["backend_init_seconds"] = init.get("elapsed")

@@ -36,6 +36,9 @@ class DART(GBDT):
     _defer_host_ok = False   # per-iteration host drop & rescale of models
     _macro_ok = False        # same reason: no fused macro-steps (the chunk
     # scheduler in engine.py falls back to c=1 per-iteration training)
+    _quant_ok = False        # use_quantized_grad falls back to f32 here:
+    # the drop & rescale re-weights trees whose outputs carry round-local
+    # quantization scales (gbdt.py warn-once explains the fallback)
 
     def __init__(self, config, train_set, objective):
         super().__init__(config, train_set, objective)

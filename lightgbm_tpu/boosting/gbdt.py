@@ -29,7 +29,8 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset, FeatureMeta
-from ..ops.histogram import on_accelerator, take_from_table
+from ..ops.histogram import (on_accelerator, quantize_gradients,
+                             take_from_table)
 from ..grower import GrowerConfig, TreeArrays, grow_tree, predict_tree_binned
 from ..objectives import ObjectiveFunction
 from ..ops.renew import leaf_percentile
@@ -70,6 +71,11 @@ class GBDT:
     # fused multi-iteration macro-steps (boosting/macro.py): DART's
     # per-iteration host drop & rescale cannot ride inside a lax.scan
     _macro_ok = True
+    # quantized-gradient training (use_quantized_grad): DART overrides to
+    # False — its host-side drop & rescale re-weights trees whose leaf
+    # outputs came from round-local quantization scales, compounding the
+    # discretization error in a way the reference never ships
+    _quant_ok = True
 
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[ObjectiveFunction]):
@@ -173,6 +179,12 @@ class GBDT:
 
         self._rng = np.random.RandomState(config.bagging_seed)
         self._goss_rng_key = jax.random.PRNGKey(config.bagging_seed)
+        # last round's per-class (g_scale, h_scale) quantization factors —
+        # device [K, 2] (zeros when quantized training is off); carried
+        # alongside the score state, through macro chunk outputs and
+        # checkpoint capture/restore (telemetry + the hist_probe payload
+        # accounting read it)
+        self._quant_scales = None
 
         # device-resident history of this run's stacked TreeArrays, so DART
         # drops and rollback re-evaluate trees on device instead of host
@@ -517,6 +529,31 @@ class GBDT:
             if lazy:
                 self._cegb_lazy_pen = _pen_device_layout(lazy)
         self._cegb_enabled = cegb_enabled
+        # quantized-gradient training (use_quantized_grad): automatic f32
+        # fallback with a warn-once for the combos the integer pipeline
+        # does not cover (reference: quantized training is likewise gated
+        # out of DART-style reweighting and constraint-coupled searches)
+        quant_on = bool(cc.use_quantized_grad)
+        if quant_on:
+            blockers = []
+            if not type(self)._quant_ok:
+                blockers.append(f"boosting={self.boosting_type}")
+            if cegb_enabled:
+                blockers.append("CEGB")
+            if cc.monotone_constraints:
+                blockers.append("monotone_constraints")
+            if cc.extra_trees:
+                blockers.append("extra_trees (random thresholds)")
+            if blockers:
+                quant_on = False
+                if not getattr(self, "_quant_warned", False):
+                    self._quant_warned = True
+                    log_warning(
+                        "use_quantized_grad=true is not supported with "
+                        + ", ".join(blockers)
+                        + "; falling back to f32 histograms for this "
+                        "booster (training proceeds unquantized)")
+        self._quant_on = quant_on
         forced_plan = self._build_forced_plan()
         if forced_plan is not None and self._feat_perm is not None:
             # the grower under sharded-EFB feature layout numbers features
@@ -560,6 +597,9 @@ class GBDT:
             cegb_lazy=bool(lazy),
             n_forced=0 if forced_plan is None else len(forced_plan[0]),
             forced_exact_parity=self.config.tpu_forced_split_parity,
+            quant=quant_on,
+            quant_bins=cc.num_grad_quant_bins,
+            quant_renew=cc.quant_train_renew_leaf,
         )
         # cross-tree CEGB device state (reference keeps it in the learner),
         # indexed by the grower's GLOBAL feature id (device slots under
@@ -648,6 +688,9 @@ class GBDT:
         rf_const_init = getattr(self, "_rf_renew_const_init", False)
         init_scores_c = tuple(float(s) for s in self.init_scores)
 
+        stoch_round = bool(cc.stochastic_rounding)
+        quant_bins = int(cc.num_grad_quant_bins)
+
         def iter_body(binned, score, row_mask, grad, hess, fmask, lr, rng,
                       label_r, weight_r, cegb_used, cegb_rows,
                       axis_name, feature_axis_name,
@@ -660,12 +703,34 @@ class GBDT:
             per-feature bin metadata as RUNTIME inputs (shared-program
             mode) — default to the closed-over constants otherwise.
             Returns (new_score, stacked trees, leaf_ids, cegb_used,
-            cegb_rows)."""
+            cegb_rows, qscales [K, 2] — per-class quantization scales,
+            zeros when quantized training is off)."""
             mc_in = mc if mc_arr is None else mc_arr
             trees = []
             leaf_ids = []
+            qscale_rows = []
             new_score = score
             for k in range(K):
+                # quantized-gradient mode: per-round discretization with
+                # stochastic rounding seeded from the SAME per-round key
+                # stream the node randomness rides (so chunked and
+                # per-iteration training replay identical draws); under
+                # data sharding each shard folds its axis index in so the
+                # rounding noise is i.i.d. across shards while the scales
+                # (pmax inside quantize_gradients) stay replicated
+                if quant_on:
+                    qkey = jax.random.fold_in(
+                        jax.random.fold_in(rng, 0x51475442), k)
+                    if axis_name is not None:
+                        qkey = jax.random.fold_in(
+                            qkey, jax.lax.axis_index(axis_name))
+                    quant_vals = quantize_gradients(
+                        grad[k], hess[k], row_mask, quant_bins, qkey,
+                        stochastic=stoch_round, axis_name=axis_name)
+                    qscale_rows.append(jnp.stack([quant_vals[2],
+                                                  quant_vals[3]]))
+                else:
+                    quant_vals = None
                 if cegb_on:
                     tree, leaf_id, (cegb_used, cegb_rows) = grow_tree(
                         binned, grad[k], hess[k], row_mask, meta, cfg,
@@ -686,7 +751,7 @@ class GBDT:
                         feature_mask=fmask[k], monotone_constraints=mc_in,
                         axis_name=axis_name,
                         rng_key=jax.random.fold_in(rng, k),
-                        meta_arrays=meta_args)
+                        meta_arrays=meta_args, quant_vals=quant_vals)
                 else:
                     tree, leaf_id = grow_tree(binned, grad[k], hess[k],
                                               row_mask, meta, cfg,
@@ -696,7 +761,8 @@ class GBDT:
                                               feature_axis_name=feature_axis_name,
                                               rng_key=jax.random.fold_in(rng, k),
                                               forced_plan=forced_plan,
-                                              meta_arrays=meta_args)
+                                              meta_arrays=meta_args,
+                                              quant_vals=quant_vals)
                 if feat_perm_j is not None:
                     tree = tree._replace(
                         split_feature=feat_perm_j[tree.split_feature])
@@ -736,7 +802,10 @@ class GBDT:
                 trees.append(tree)
                 leaf_ids.append(leaf_id)
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
-            return new_score, stacked, jnp.stack(leaf_ids), cegb_used, cegb_rows
+            qscales = (jnp.stack(qscale_rows) if quant_on
+                       else jnp.zeros((K, 2), jnp.float32))
+            return (new_score, stacked, jnp.stack(leaf_ids), cegb_used,
+                    cegb_rows, qscales)
 
         if self._mesh is None:
             # binned rides as an explicit jit argument: a closed-over
@@ -770,7 +839,8 @@ class GBDT:
                     renew_pct, obj is None, mc is None,
                     mr.has_bundles, int(mr.max_group_bin),
                     len(mr.num_bin), int(mr.num_groups),
-                    bool(mr.is_categorical.any()), env_gates)
+                    bool(mr.is_categorical.any()), env_gates,
+                    stoch_round)
             shared = _shared_program(cache_key)
             if shared is None:
                 def one_iter_full(binned, score, row_mask, grad, hess,
@@ -815,7 +885,7 @@ class GBDT:
                 core, mesh=self._mesh,
                 in_specs=(P(ax_f, ax_d), krow, row, krow, krow, P(), P(),
                           P(), row, row, P(), rows_spec),
-                out_specs=(krow, P(), krow, P(), rows_spec),
+                out_specs=(krow, P(), krow, P(), rows_spec, P()),
                 check_vma=False)
 
             def one_iter(binned, score, row_mask, grad, hess, fmask, lr,
@@ -1055,11 +1125,12 @@ class GBDT:
             mask = self._bagging_mask(self.iter)
 
         with global_timer.section("TreeLearner::Train(dispatch)"):
-            (self.train_score, stacked, leaf_ids,
-             *self._cegb_state) = self._iter_fn(
+            (self.train_score, stacked, leaf_ids, cu, cr,
+             self._quant_scales) = self._iter_fn(
                 self.binned, self.train_score, mask, grad, hess,
                 self._feature_masks(), jnp.float32(self.shrinkage_rate),
                 self._node_key(), *self._cegb_state)
+            self._cegb_state = (cu, cr)
         return self._finish_iter(stacked)
 
     def _node_key(self):
@@ -1406,6 +1477,11 @@ class GBDT:
             # again after resume
             "cegb_state": tuple(np.asarray(jax.device_get(a))
                                 for a in self._cegb_state),
+            # last round's gradient-quantization scales (use_quantized_grad
+            # telemetry; rides the checkpoint so a resumed run reports the
+            # same payload accounting it left off with)
+            "quant_scales": (np.asarray(jax.device_get(self._quant_scales))
+                             if self._quant_scales is not None else None),
         }
 
     def restore_state(self, st: dict) -> None:
@@ -1455,6 +1531,8 @@ class GBDT:
                 np.asarray(st["cegb_state"][1]),
                 NamedSharding(self._mesh, P(None, self._data_axis)))
         self._cegb_state = (jnp.asarray(used0), rows0)
+        qs = st.get("quant_scales")
+        self._quant_scales = jnp.asarray(qs) if qs is not None else None
         self.models_version += 1
 
     def refit_leaf_values(self, leaf_preds: np.ndarray,

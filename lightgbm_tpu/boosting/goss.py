@@ -95,9 +95,10 @@ class GOSS(GBDT):
         return jnp.stack(keys), jnp.asarray(np.asarray(flags))
 
     def _train_with(self, grad, hess, mask):
-        (self.train_score, stacked, leaf_ids,
-         *self._cegb_state) = self._iter_fn(
+        (self.train_score, stacked, leaf_ids, cu, cr,
+         self._quant_scales) = self._iter_fn(
             self.binned, self.train_score, mask, grad, hess,
             self._feature_masks(), jnp.float32(self.shrinkage_rate),
             self._node_key(), *self._cegb_state)
+        self._cegb_state = (cu, cr)
         return self._finish_iter(stacked)

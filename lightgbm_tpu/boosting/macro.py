@@ -116,9 +116,15 @@ def build_chunk_program(b):
         tmpl = TreeArrays.empty(L)
         ys0 = jax.tree_util.tree_map(
             lambda a: jnp.zeros((c, K) + a.shape, a.dtype), tmpl)
+        # per-iteration gradient-quantization scales (use_quantized_grad)
+        # ride out as a stacked [c, K, 2] buffer alongside the trees —
+        # the in-loop quantization recomputes them from the carried score
+        # exactly as per-iteration training does (the stochastic-rounding
+        # keys derive from the stacked per-round key stream `keys`)
+        qss0 = jnp.zeros((c, K, 2), jnp.float32)
 
         def body(j, state):
-            score, cu, cr, ys = state
+            score, cu, cr, ys, qss = state
             mask = _ix(masks, j)
             it = _ix(its, j)
             if kind == "rf":
@@ -132,7 +138,7 @@ def build_chunk_program(b):
             if kind == "goss":
                 gm = goss_mask(g, h, _ix(gkeys, j), mask)
                 mask = jnp.where(_ix(gons, j), gm, mask)
-            new_score, stacked, _leaf_ids, cu, cr = core(
+            new_score, stacked, _leaf_ids, cu, cr, qsc = core(
                 binned, score_in, mask, g, h, _ix(fmasks, j), _ix(lrs, j),
                 _ix(keys, j), cu, cr, label_r, weight_r)
             if kind == "rf":
@@ -141,11 +147,12 @@ def build_chunk_program(b):
             ys = jax.tree_util.tree_map(
                 lambda buf, v: lax.dynamic_update_index_in_dim(buf, v, j, 0),
                 ys, stacked)
-            return new_score, cu, cr, ys
+            qss = lax.dynamic_update_index_in_dim(qss, qsc, j, 0)
+            return new_score, cu, cr, ys, qss
 
-        score, cegb_used, cegb_rows, ys = lax.fori_loop(
-            0, n_steps, body, (score, cegb_used, cegb_rows, ys0))
-        return score, cegb_used, cegb_rows, ys
+        score, cegb_used, cegb_rows, ys, qss = lax.fori_loop(
+            0, n_steps, body, (score, cegb_used, cegb_rows, ys0, qss0))
+        return score, cegb_used, cegb_rows, ys, qss
 
     return jax.jit(chunk, donate_argnums=(1,))
 
@@ -241,8 +248,10 @@ def run_chunk(b, c: int, lrs: Optional[Sequence[float]] = None) -> bool:
     cu, cr = b._cegb_state
     from ..utils.timer import global_timer
     with global_timer.section("TreeLearner::Train(dispatch)"):
-        (b.train_score, cu, cr, stacked_seq) = b._macro_chunk_jit(
+        (b.train_score, cu, cr, stacked_seq, qss) = b._macro_chunk_jit(
             b.binned, b.train_score, cu, cr, np.int32(c), xs,
             b._macro_ctx["label"], b._macro_ctx["weight"], grad_c, hess_c)
     b._cegb_state = (cu, cr)
+    if getattr(b, "_quant_on", False):
+        b._quant_scales = qss[c - 1]   # last round's per-class scales
     return b._finish_chunk(stacked_seq, c, lr_list, it0)

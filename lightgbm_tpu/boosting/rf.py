@@ -109,10 +109,11 @@ class RF(GBDT):
         # run the shared step on it*mean (so "+ tree" keeps the sum), then
         # renormalize to the running mean including the per-tree bias
         s1 = self.train_score * it
-        s2, stacked, _, *self._cegb_state = self._iter_fn(
+        s2, stacked, _, cu, cr, self._quant_scales = self._iter_fn(
             self.binned, s1, mask, self._grad, self._hess,
             self._feature_masks(), jnp.float32(1.0),
             self._node_key(), *self._cegb_state)
+        self._cegb_state = (cu, cr)
         init_col = jnp.asarray(self.init_scores, jnp.float32)[:, None]
         self.train_score = (s2 + init_col) / (it + 1)
         return self._finish_iter(stacked)

@@ -34,11 +34,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from .dataset import FeatureMeta
-from .ops.histogram import (build_histogram, capacity_schedule,
-                            compacted_histogram, take_from_table)
+from .ops.histogram import (build_histogram, build_histogram_int,
+                            capacity_schedule, compacted_histogram,
+                            compacted_histogram_int, psum_quant_hist,
+                            quant_levels, take_from_table)
 from .ops.split import (K_EPSILON, MAX_CAT_WORDS, PerFeatureBest,
                         SplitHyperparams, SplitResult, best_split_for_leaf,
-                        feature_best_splits, leaf_gain, leaf_output)
+                        feature_best_splits, leaf_gain, leaf_output,
+                        quant_rescale_hist)
 
 
 class TreeArrays(NamedTuple):
@@ -219,6 +222,16 @@ class GrowerConfig(NamedTuple):
                                    # feature_histogram.hpp:527 — one bin off
                                    # vs its own DataPartition::Split) so
                                    # forced-split trees match bit-for-bit
+    quant: bool = False            # quantized-gradient training: integer
+                                   # [2, F, B] i32 histograms, int8 MXU
+                                   # matmul, gains from rescaled int sums
+                                   # (config use_quantized_grad; the GBDT
+                                   # layer falls back to f32 for DART/CEGB/
+                                   # monotone/extra_trees)
+    quant_bins: int = 4            # num_grad_quant_bins (signed levels)
+    quant_renew: bool = False      # quant_train_renew_leaf: re-fit leaf
+                                   # outputs from TRUE f32 sums via the
+                                   # ops/renew.py seam
 
 
 def _psum(x, axis_name):
@@ -289,6 +302,12 @@ def grow_tree(
                                                 # RUNTIME arrays -> the
                                                 # compiled program is shared
                                                 # across same-shaped datasets
+    quant_vals: Optional[tuple] = None,         # cfg.quant: (gq [n] i8,
+                                                # hq [n] i8, g_scale, h_scale)
+                                                # from ops.histogram.
+                                                # quantize_gradients; grad/
+                                                # hess stay the TRUE f32
+                                                # values (leaf renewal)
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32).
 
@@ -381,7 +400,37 @@ def grow_tree(
         feat_start = feat_start_g
     has_cat = bool(meta.is_categorical.any())
 
-    hist_fn = functools.partial(build_histogram, num_bins=Bg, method=cfg.hist_method)
+    # quantized-gradient mode: integer [2, G, Bg] i32 histograms built
+    # from pre-discretized int8 grad/hess (weights folded at quantization
+    # time, ops/histogram.py quantize_gradients); the int->f32 rescale
+    # happens ONCE per leaf search (quant_rescale_hist), everything
+    # upstream of the search — cache, psum, sibling subtraction — stays
+    # exact integer arithmetic
+    quant = cfg.quant
+    if quant:
+        if quant_vals is None:
+            raise ValueError("cfg.quant requires quant_vals="
+                             "(gq, hq, g_scale, h_scale)")
+        q_grad, q_hess, g_scale, h_scale = quant_vals
+        q_levels = quant_levels(cfg.quant_bins)
+
+        def hist_pass(w):
+            return build_histogram_int(binned_t, q_grad, q_hess, w > 0, Bg,
+                                       method=cfg.hist_method,
+                                       levels=q_levels)
+
+        def split_conv(ghist, cnt, cnt_factor=None):
+            return quant_rescale_hist(ghist, g_scale, h_scale, cnt,
+                                      cnt_factor=cnt_factor)
+    else:
+        hist_fn = functools.partial(build_histogram, num_bins=Bg,
+                                    method=cfg.hist_method)
+
+        def hist_pass(w):
+            return hist_fn(binned_t, grad, hess, w)
+
+        def split_conv(ghist, cnt, cnt_factor=None):
+            return ghist
     # full-n first capacity: the "smaller" child is chosen by WEIGHTED count
     # (GOSS amplifies weights), so its raw row count may exceed n/2
     caps = capacity_schedule(n) if cfg.compact else [n]
@@ -403,9 +452,23 @@ def grow_tree(
             h = jnp.where(valid[None, :, :], taken, 0.0)
             totals = jnp.stack([sg, sh, cnt])                       # [3]
             return h.at[:, :, 0].set(totals[:, None] - h.sum(axis=2))
+
+        def expand_hist_int(ghist_i, tot_i):
+            """Integer twin of expand_hist for the quantized voting path:
+            same gather, bin 0 reconstructed from the [2] i32 leaf totals
+            — linear, so it commutes with the elected-features psum."""
+            gather_bins = jnp.clip(feat_start[:, None] + b_idx[None, :] - 1,
+                                   0, Bg - 1)
+            taken = ghist_i[:, feat_group[:, None], gather_bins]
+            valid = (b_idx[None, :] >= 1) & (b_idx[None, :] < num_bin[:, None])
+            h = jnp.where(valid[None, :, :], taken, 0)
+            return h.at[:, :, 0].set(tot_i[:, None] - h.sum(axis=2))
     else:
         def expand_hist(ghist, sg, sh, cnt):
             return ghist   # identity groups: group hist IS the feature hist
+
+        def expand_hist_int(ghist_i, tot_i):
+            return ghist_i
 
     voting = (cfg.voting_top_k > 0 and axis_name is not None)
     if voting and feature_axis_name is not None:
@@ -434,6 +497,12 @@ def grow_tree(
     # per-shard views are sliced at the use sites below.
     cegb_enabled = (cfg.cegb_penalty_split > 0.0 or cfg.cegb_coupled
                     or cfg.cegb_lazy)
+    if quant and cegb_enabled:
+        # the GBDT layer falls back to f32 for CEGB (warn-once); reaching
+        # here means a caller bypassed it
+        raise NotImplementedError(
+            "quantized-gradient training does not support CEGB; the "
+            "booster falls back to f32 histograms for this combination")
     F_glob = len(meta.num_bin)    # global feature count (== F when unsharded)
     if cegb_enabled and voting:
         # recorded design exclusion: this build's CEGB is EXACT — it keeps
@@ -540,10 +609,24 @@ def grow_tree(
         hp_local = hp._replace(
             min_data_in_leaf=max(1, hp.min_data_in_leaf // ndev),
             min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / ndev)
-        loc = ghist_local[:, 0, :].sum(axis=1)   # local (sg, sh, cnt):
-        # every row lands in exactly one bin of group 0, so its totals are
-        # the local leaf totals
-        hist_loc = expand_hist(ghist_local, loc[0], loc[1], loc[2])
+        if quant:
+            # local INTEGER totals from group 0 (its bins partition the
+            # local rows); counts are estimated with the GLOBAL factor —
+            # sh was produced as int_total * h_scale, so sh / h_scale
+            # round-trips the global hessian-int total
+            loc_i = ghist_local[:, 0, :].sum(axis=1)        # [2] i32
+            cnt_f = cnt / jnp.maximum(jnp.round(sh / h_scale), 1.0)
+            loc = (loc_i[0].astype(jnp.float32) * g_scale,
+                   loc_i[1].astype(jnp.float32) * h_scale,
+                   loc_i[1].astype(jnp.float32) * cnt_f)
+            hist_loc = expand_hist(
+                split_conv(ghist_local, cnt, cnt_factor=cnt_f),
+                loc[0], loc[1], loc[2])
+        else:
+            loc = ghist_local[:, 0, :].sum(axis=1)   # local (sg, sh, cnt):
+            # every row lands in exactly one bin of group 0, so its totals
+            # are the local leaf totals
+            hist_loc = expand_hist(ghist_local, loc[0], loc[1], loc[2])
         pf = feature_best_splits(
             hist_loc, loc[0], loc[1], loc[2], num_bin, missing_type,
             default_bin, is_cat, hp_local, feature_mask=fm,
@@ -563,8 +646,19 @@ def grow_tree(
         votes = jnp.full(F, -jnp.inf, jnp.float32).at[all_i].max(
             jnp.where(jnp.isfinite(all_g), all_g, -jnp.inf))
         _, elected = lax.top_k(votes, k)
-        sub = lax.psum(hist_loc[:, elected], axis_name)  # [3, k, B]: the
-        # only O(bins) collective — k*B*3 words vs data-parallel's F*B*3
+        if quant:
+            # the elected-features collective moves INTEGER histograms
+            # ([2, k, B] i32, int16-narrowed when the static bound
+            # allows) — the quantization-width payload shrink applies to
+            # voting's only O(bins) collective too
+            sub_i = psum_quant_hist(
+                expand_hist_int(ghist_local, loc_i)[:, elected],
+                axis_name, rows_global, cfg.quant_bins)
+            sub = split_conv(sub_i, cnt)
+        else:
+            sub = lax.psum(hist_loc[:, elected], axis_name)  # [3, k, B]:
+            # the only O(bins) collective — k*B*3 words vs data-parallel's
+            # F*B*3
         r = best_split_for_leaf(
             sub, sg, sh, cnt, num_bin[elected], missing_type[elected],
             default_bin[elected], is_cat[elected], hp,
@@ -587,7 +681,7 @@ def grow_tree(
                 r = r._replace(gain=jnp.where(depth >= cfg.max_depth,
                                               -jnp.inf, r.gain))
             return r
-        hist = expand_hist(ghist, sg, sh, cnt)
+        hist = expand_hist(split_conv(ghist, cnt), sg, sh, cnt)
         r = best_split_for_leaf(
             hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
             hp, feature_mask=fm,
@@ -615,7 +709,7 @@ def grow_tree(
         fm = feature_mask
         if fm_bn is not None:
             fm = fm_bn if fm is None else fm * fm_bn
-        hist = expand_hist(ghist, sg, sh, cnt)
+        hist = expand_hist(split_conv(ghist, cnt), sg, sh, cnt)
         pf = feature_best_splits(
             hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
             hp, feature_mask=fm, monotone_constraints=monotone_constraints,
@@ -629,15 +723,36 @@ def grow_tree(
     # ---- root ----
     # voting mode: the histogram cache holds LOCAL (per-shard) histograms;
     # only elected features are ever psum'd (inside leaf_best_voting).
-    # Scalars stay global either way.
-    hist_sync = (lambda h: h) if voting else (lambda h: _psum(h, axis_name))
-    root_hist = hist_sync(hist_fn(binned_t, grad, hess, row_mask))
-    root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
-    root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
-    root_cnt = _psum(jnp.sum(row_mask), axis_name)
+    # Scalars stay global either way.  Quantized histograms psum as
+    # integers with a statically-narrowed payload (psum_quant_hist) —
+    # the data-parallel ICI traffic shrinks with the quantization width.
+    rows_global = n * max(cfg.num_machines, 1)
+    if voting:
+        hist_sync = (lambda h: h)
+    elif quant:
+        hist_sync = (lambda h: psum_quant_hist(h, axis_name, rows_global,
+                                               cfg.quant_bins))
+    else:
+        hist_sync = (lambda h: _psum(h, axis_name))
+    root_hist = hist_sync(hist_pass(row_mask))
+    if quant:
+        member = row_mask > 0
+        root_sg = _psum(jnp.sum(jnp.where(member, q_grad, 0).astype(
+            jnp.int32)), axis_name).astype(jnp.float32) * g_scale
+        root_sh = _psum(jnp.sum(jnp.where(member, q_hess, 0).astype(
+            jnp.int32)), axis_name).astype(jnp.float32) * h_scale
+        # counts are plain member-row counts in quantized mode (the
+        # reference's bagging semantics; weights live in the int values)
+        root_cnt = _psum(jnp.sum(member.astype(jnp.float32)), axis_name)
+    else:
+        root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
+        root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
+        root_cnt = _psum(jnp.sum(row_mask), axis_name)
 
     tree = TreeArrays.empty(L)
-    hist_cache = jnp.zeros((L, 3, G, Bg), jnp.float32).at[0].set(root_hist)
+    hist_cache = jnp.zeros((L, 2, G, Bg), jnp.int32).at[0].set(root_hist) \
+        if quant else \
+        jnp.zeros((L, 3, G, Bg), jnp.float32).at[0].set(root_hist)
     leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
     leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
     leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
@@ -768,7 +883,10 @@ def grow_tree(
             sg, sh, cnt = c.leaf_sg[leaf], c.leaf_sh[leaf], c.leaf_cnt[leaf]
             h_leaf = c.hist[leaf]
             if voting:
-                h_leaf = _psum(h_leaf, axis_name)   # local -> global hist
+                # local -> global hist (integer psum in quantized mode)
+                h_leaf = (psum_quant_hist(h_leaf, axis_name, rows_global,
+                                          cfg.quant_bins) if quant
+                          else _psum(h_leaf, axis_name))
             if feature_axis_name is not None:
                 lf_raw = feat - f_offset
                 owns = (lf_raw >= 0) & (lf_raw < F)
@@ -776,7 +894,8 @@ def grow_tree(
             else:
                 owns = jnp.bool_(True)
                 lf = feat
-            hist_f = expand_hist(h_leaf, sg, sh, cnt)[:, lf]  # [3, B]
+            hist_f = expand_hist(split_conv(h_leaf, cnt),
+                                 sg, sh, cnt)[:, lf]          # [3, B]
             b = jnp.arange(B, dtype=jnp.int32)
             nb = num_bin[lf]
             mt = missing_type[lf]
@@ -937,13 +1056,17 @@ def grow_tree(
         parent_hist = c.hist[leaf]
         small_member = leaf_id == small_leaf
         if cfg.compact and len(caps) > 1:
-            small_hist = hist_sync(
-                compacted_histogram(binned_t, grad, hess, row_mask,
-                                    small_member, Bg, caps,
-                                    method=cfg.hist_method))
+            if quant:
+                small_hist = hist_sync(compacted_histogram_int(
+                    binned_t, q_grad, q_hess, row_mask, small_member, Bg,
+                    caps, method=cfg.hist_method, levels=q_levels))
+            else:
+                small_hist = hist_sync(
+                    compacted_histogram(binned_t, grad, hess, row_mask,
+                                        small_member, Bg, caps,
+                                        method=cfg.hist_method))
         else:
-            small_hist = hist_sync(
-                hist_fn(binned_t, grad, hess, row_mask * small_member))
+            small_hist = hist_sync(hist_pass(row_mask * small_member))
         large_hist = parent_hist - small_hist
         hist_l = jnp.where(left_smaller, small_hist, large_hist)
         hist_r = jnp.where(left_smaller, large_hist, small_hist)
@@ -1055,16 +1178,31 @@ def grow_tree(
     out = lax.while_loop(cond, body, init)
 
     # finalize leaf values (clamped to monotone bounds, reference:
-    # CalculateSplittedLeafOutput USE_MC, feature_histogram.hpp:697-711)
+    # CalculateSplittedLeafOutput USE_MC, feature_histogram.hpp:697-711).
+    # Quantized mode with quant_train_renew_leaf re-fits the outputs from
+    # the TRUE f32 gradient sums (ops/renew.py seam), so the committed
+    # leaves carry no discretization bias — only the SPLITS came from the
+    # integer histograms (reference: RenewIntGradTreeOutput lineage).
     tree = out.tree
-    lv = leaf_output(out.leaf_sg, out.leaf_sh, hp.lambda_l1, hp.lambda_l2,
-                     hp.max_delta_step)
+    leaf_sh_out = out.leaf_sh
+    if quant and cfg.quant_renew:
+        from .ops.renew import quant_train_renew_leaf
+        sg_t, sh_t = quant_train_renew_leaf(out.leaf_id, grad, hess,
+                                            row_mask, L)
+        sg_t = _psum(sg_t, axis_name)
+        sh_t = _psum(sh_t, axis_name)
+        lv = leaf_output(sg_t, sh_t, hp.lambda_l1, hp.lambda_l2,
+                         hp.max_delta_step)
+        leaf_sh_out = sh_t
+    else:
+        lv = leaf_output(out.leaf_sg, out.leaf_sh, hp.lambda_l1,
+                         hp.lambda_l2, hp.max_delta_step)
     if use_mc:
         lv = jnp.clip(lv, out.leaf_min, out.leaf_max)
     active = jnp.arange(L) < tree.num_leaves
     tree = tree._replace(
         leaf_value=jnp.where(active, lv, 0.0),
-        leaf_weight=jnp.where(active, out.leaf_sh, 0.0),
+        leaf_weight=jnp.where(active, leaf_sh_out, 0.0),
         leaf_count=jnp.where(active, out.leaf_cnt, 0.0),
     )
     if cegb_enabled:

@@ -50,12 +50,14 @@ from jax import lax
 
 from .dataset import FeatureMeta
 from .grower import GrowerConfig, TreeArrays, _LeafBest, _psum, row_goes_left
-from .ops.histogram import (build_histogram, capacity_schedule,
-                            compacted_segment_histogram, pack_cols_u32,
-                            resolve_hist_method, take_from_table,
-                            use_sorted_seghist)
+from .ops.histogram import (build_histogram, build_histogram_int,
+                            capacity_schedule, compacted_segment_histogram,
+                            compacted_segment_histogram_int, pack_cols_u32,
+                            pack_cols_u32_quant, psum_quant_hist,
+                            quant_levels, resolve_hist_method,
+                            take_from_table, use_sorted_seghist)
 from .ops.split import (MAX_CAT_WORDS, SplitResult, best_split_for_leaf,
-                        leaf_output)
+                        leaf_output, quant_rescale_hist)
 
 
 def _pad_scatter(arr: jax.Array, idx: jax.Array, val: jax.Array,
@@ -85,6 +87,8 @@ def grow_tree_rounds(
                                             # feat_start) — shares the
                                             # compiled program across
                                             # same-shaped datasets
+    quant_vals: Optional[tuple] = None,     # cfg.quant: (gq, hq, g_scale,
+                                            # h_scale) — see grower.grow_tree
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [n] i32)."""
     meta = meta.resolved()
@@ -108,17 +112,40 @@ def grow_tree_rounds(
         feat_start = jnp.asarray(meta.feat_start)
     has_cat = bool(meta.is_categorical.any())
 
-    hist_fn = functools.partial(build_histogram, num_bins=Bg,
-                                method=cfg.hist_method)
+    # quantized-gradient mode (see grower.grow_tree): integer [2, *, Bg]
+    # i32 histogram cache + int8 segment kernels; the int->f32 rescale
+    # happens once per leaf search (quant_rescale_hist)
+    quant = cfg.quant
+    rows_global = n * max(cfg.num_machines, 1)
+    if quant:
+        if quant_vals is None:
+            raise ValueError("cfg.quant requires quant_vals="
+                             "(gq, hq, g_scale, h_scale)")
+        q_grad, q_hess, g_scale, h_scale = quant_vals
+        q_levels = quant_levels(cfg.quant_bins)
+
+        def split_conv(ghist, cnt):
+            return quant_rescale_hist(ghist, g_scale, h_scale, cnt)
+    else:
+        hist_fn = functools.partial(build_histogram, num_bins=Bg,
+                                    method=cfg.hist_method)
+
+        def split_conv(ghist, cnt):
+            return ghist
     caps = capacity_schedule(n) if cfg.compact else [n]
     # fused u32 column records for the arena's single gather (sorted-path
-    # only: gather cost scales with element count — pack_cols_u32).
-    # LGBM_TPU_PACK=0 falls back to the four separate gathers
+    # only: gather cost scales with element count — pack_cols_u32; the
+    # quantized record fuses (gq, hq, member) into ONE word, Wb+1 vs
+    # Wb+3).  LGBM_TPU_PACK=0 falls back to the separate gathers
     # (compile-cost bisect hook)
     use_pack = (use_sorted_seghist()
                 and os.environ.get("LGBM_TPU_PACK") != "0")
-    packed = (pack_cols_u32(binned_t, grad, hess, row_mask)
-              if use_pack else None)
+    if not use_pack:
+        packed = None
+    elif quant:
+        packed = pack_cols_u32_quant(binned_t, q_grad, q_hess, row_mask > 0)
+    else:
+        packed = pack_cols_u32(binned_t, grad, hess, row_mask)
     # router-matmul candidate routing (see body): O(n)/round instead of
     # the scan's O(k*n); numeric-only (categorical bitsets don't ride an
     # f32 table) and accelerator-shaped.  LGBM_TPU_ROUTER=0 forces the
@@ -179,7 +206,7 @@ def grow_tree_rounds(
             if hp.extra_trees:
                 eru = jax.random.uniform(jax.random.fold_in(key, 1), (F, 2))
         bounds = (bmin, bmax) if use_mc else None
-        hist = expand_hist(ghist, sg, sh, cnt)
+        hist = expand_hist(split_conv(ghist, cnt), sg, sh, cnt)
         r = best_split_for_leaf(
             hist, sg, sh, cnt, num_bin, missing_type, default_bin, is_cat,
             hp, feature_mask=fm, monotone_constraints=mc_j,
@@ -203,13 +230,27 @@ def grow_tree_rounds(
             is_categorical=sr.is_categorical, cat_bitset=sr.cat_bitset)
 
     # ---- root ----------------------------------------------------------
-    root_hist = _psum(hist_fn(binned_t, grad, hess, row_mask), axis_name)
-    root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
-    root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
-    root_cnt = _psum(jnp.sum(row_mask), axis_name)
+    if quant:
+        member = row_mask > 0
+        root_hist = psum_quant_hist(
+            build_histogram_int(binned_t, q_grad, q_hess, member, Bg,
+                                method=cfg.hist_method, levels=q_levels),
+            axis_name, rows_global, cfg.quant_bins)
+        root_sg = _psum(jnp.sum(jnp.where(member, q_grad, 0).astype(
+            jnp.int32)), axis_name).astype(jnp.float32) * g_scale
+        root_sh = _psum(jnp.sum(jnp.where(member, q_hess, 0).astype(
+            jnp.int32)), axis_name).astype(jnp.float32) * h_scale
+        root_cnt = _psum(jnp.sum(member.astype(jnp.float32)), axis_name)
+    else:
+        root_hist = _psum(hist_fn(binned_t, grad, hess, row_mask), axis_name)
+        root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
+        root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
+        root_cnt = _psum(jnp.sum(row_mask), axis_name)
 
     tree = TreeArrays.empty(L)
-    hist_cache = jnp.zeros((L, 3, G, Bg), jnp.float32).at[0].set(root_hist)
+    hist_cache = jnp.zeros((L, 2, G, Bg), jnp.int32).at[0].set(root_hist) \
+        if quant else \
+        jnp.zeros((L, 3, G, Bg), jnp.float32).at[0].set(root_hist)
     leaf_sg = jnp.zeros(L, jnp.float32).at[0].set(root_sg)
     leaf_sh = jnp.zeros(L, jnp.float32).at[0].set(root_sh)
     leaf_cnt = jnp.zeros(L, jnp.float32).at[0].set(root_cnt)
@@ -465,9 +506,15 @@ def grow_tree_rounds(
         # whole candidate batch (slot r = the round's r-th candidate)
         small_left = b.left_count <= b.right_count
         slot = jnp.where(row_small, crank, KCAP)
-        seg = _psum(compacted_segment_histogram(
-            binned_t, grad, hess, row_mask, slot, KCAP, Bg, caps,
-            f32_vals=seg_f32, num_live=k, packed=packed), axis_name)
+        if quant:
+            seg = psum_quant_hist(compacted_segment_histogram_int(
+                binned_t, q_grad, q_hess, row_mask, slot, KCAP, Bg, caps,
+                num_live=k, packed=packed, levels=q_levels),
+                axis_name, rows_global, cfg.quant_bins)
+        else:
+            seg = _psum(compacted_segment_histogram(
+                binned_t, grad, hess, row_mask, slot, KCAP, Bg, caps,
+                f32_vals=seg_f32, num_live=k, packed=packed), axis_name)
 
         # -- candidate children's best splits, BEFORE committing anything:
         # per-leaf candidates are independent, so lane i's results are
@@ -535,16 +582,28 @@ def grow_tree_rounds(
     out = lax.while_loop(cond, body, init)
 
     # finalize leaf values (reference: CalculateSplittedLeafOutput; clamped
-    # to monotone bounds like grower.py)
+    # to monotone bounds like grower.py; quantized renewal re-fits from
+    # TRUE f32 sums — see grower.grow_tree's finalize)
     tree = out.tree
-    lv = leaf_output(out.leaf_sg, out.leaf_sh, hp.lambda_l1, hp.lambda_l2,
-                     hp.max_delta_step)
+    leaf_sh_out = out.leaf_sh
+    if quant and cfg.quant_renew:
+        from .ops.renew import quant_train_renew_leaf
+        sg_t, sh_t = quant_train_renew_leaf(out.leaf_id, grad, hess,
+                                            row_mask, L)
+        sg_t = _psum(sg_t, axis_name)
+        sh_t = _psum(sh_t, axis_name)
+        lv = leaf_output(sg_t, sh_t, hp.lambda_l1, hp.lambda_l2,
+                         hp.max_delta_step)
+        leaf_sh_out = sh_t
+    else:
+        lv = leaf_output(out.leaf_sg, out.leaf_sh, hp.lambda_l1,
+                         hp.lambda_l2, hp.max_delta_step)
     if use_mc:
         lv = jnp.clip(lv, out.leaf_min, out.leaf_max)
     active = iota_L < tree.num_leaves
     tree = tree._replace(
         leaf_value=jnp.where(active, lv, 0.0),
-        leaf_weight=jnp.where(active, out.leaf_sh, 0.0),
+        leaf_weight=jnp.where(active, leaf_sh_out, 0.0),
         leaf_count=jnp.where(active, out.leaf_cnt, 0.0),
     )
     return tree, out.leaf_id

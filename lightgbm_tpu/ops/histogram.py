@@ -62,13 +62,29 @@ def use_sorted_seghist() -> bool:
     return on_accelerator()
 
 
-def resolve_hist_method(method: str) -> str:
+def resolve_hist_method(method: str, quantized: bool = False) -> str:
     """The concrete kernel ``method='auto'`` resolves to on this backend.
 
     Kept in ONE place so the grower's segment-histogram precision choice
     (bf16 one-hot vs f32-exact) can never disagree with the parent
     histogram kernel it subtracts from.
+
+    ``quantized=True`` resolves within the INTEGER kernel family
+    (use_quantized_grad): int8 one-hot matmul with int32 accumulation on
+    accelerators, packed scatter on CPU.  A forced f32-family name maps
+    to its integer analogue so ``tpu_hist_method`` keeps steering the
+    matmul-vs-scatter axis in either mode.
     """
+    if quantized:
+        if method in ("matmul_int8", "scatter_int"):
+            return method
+        if method == "auto":
+            return "matmul_int8" if on_accelerator() else "scatter_int"
+        if method in ("matmul", "matmul_f32", "pallas"):
+            return "matmul_int8"
+        if method == "scatter":
+            return "scatter_int"
+        raise ValueError(f"unknown histogram method {method!r}")
     if method == "auto":
         return "matmul" if on_accelerator() else "scatter"
     return method
@@ -917,5 +933,576 @@ def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """The subtraction trick: sibling = parent - child.
 
     reference: FeatureHistogram::Subtract (feature_histogram.hpp:79-84).
+
+    Works unchanged on the quantized integer histograms below — and there
+    it is EXACT: int32 subtraction has no rounding, so the sibling
+    histogram carries no accumulated float error (the quantized-training
+    selling point the reference's gradient_discretizer.hpp exploits).
     """
     return parent - child
+
+
+# ======================================================================
+# Quantized-gradient integer histogram family (use_quantized_grad)
+#
+# LightGBM 4.x lineage (src/treelearner/gradient_discretizer.{hpp,cpp}):
+# per-round discretization of grad/hess to a few signed integer levels
+# with stochastic rounding, integer histogram accumulation, and split
+# gains computed from the integer sums rescaled in high precision.  On
+# this backend the wins compound:
+#
+# - the one-hot matmul runs int8 x int8 -> int32 on the MXU
+#   (``preferred_element_type=int32``), halving the one-hot operand
+#   bytes vs bf16 and producing EXACT integer sums — no
+#   accumulation-order nondeterminism, so parent - child subtraction
+#   (``subtract_histogram``) is exact;
+# - histograms shrink to TWO channels ([2, F, B] i32: grad, hess) —
+#   per-bin COUNTS are estimated from the hessian channel at split time
+#   exactly like the reference's main path
+#   (``Common::RoundInt(sum_hess * cnt_factor)``,
+#   feature_histogram.hpp:813), which is what lets the data-parallel
+#   psum payload drop from 12 bytes/cell (3 x f32) to 8 (2 x i32), and
+#   to 4 (2 x i16) when the static row x level bound allows
+#   (``psum_quant_hist``);
+# - per-row values ride as int8 [2, n] blocks (LAYOUT DOCTRINE: tiny
+#   component axis leading, minor dim n unpadded).
+#
+# Accumulator width: per-cell |sum| <= n * level_bound; with
+# num_grad_quant_bins <= 64 (config-validated) that stays inside int32
+# up to ~34M rows — above every shape this repo targets (11M HIGGS).
+# ======================================================================
+
+
+def quant_levels(num_bins: int):
+    """(grad level bound, hess level bound) for ``num_grad_quant_bins``.
+
+    reference: gradient_discretizer.cpp — gradients take signed levels in
+    [-bins/2 + 1, bins/2 - 1], hessians (non-negative) [0, bins - 1]."""
+    return max(num_bins // 2 - 1, 1), max(num_bins - 1, 1)
+
+
+def quantize_gradients(grad: jax.Array, hess: jax.Array, weights: jax.Array,
+                       num_bins: int, key: jax.Array,
+                       stochastic: bool = True,
+                       axis_name: Optional[str] = None):
+    """Discretize one class's grad/hess to signed integer levels.
+
+    Bagging/GOSS weights are FOLDED INTO the values before discretization
+    (the reference amplifies sampled gradients before discretizing,
+    goss.hpp:94-98 + gradient_discretizer); the histogram mask is then
+    binary membership, which is what keeps the histogram updates integer.
+    Scales are the per-round max-abs over the GLOBAL rows (``lax.pmax``
+    under data sharding) divided by the level bound; stochastic rounding
+    is ``floor(x + u)`` (unbiased), round-to-nearest otherwise.
+
+    Returns ``(gq int8 [n], hq int8 [n], g_scale f32, h_scale f32)`` with
+    ``value ~= q * scale``.  Zero-weight rows quantize to exactly 0.
+    """
+    qg, qh = quant_levels(num_bins)
+    gw = grad * weights
+    hw = hess * weights
+    gmax = jnp.max(jnp.abs(gw))
+    hmax = jnp.max(jnp.abs(hw))
+    if axis_name is not None:
+        gmax = lax.pmax(gmax, axis_name)
+        hmax = lax.pmax(hmax, axis_name)
+    g_scale = (jnp.maximum(gmax, 1e-30) / qg).astype(jnp.float32)
+    h_scale = (jnp.maximum(hmax, 1e-30) / qh).astype(jnp.float32)
+    if stochastic:
+        u = jax.random.uniform(key, (2,) + gw.shape)
+        gq = jnp.floor(gw / g_scale + u[0])
+        hq = jnp.floor(hw / h_scale + u[1])
+    else:
+        gq = jnp.round(gw / g_scale)
+        hq = jnp.round(hw / h_scale)
+    gq = jnp.clip(gq, -qg, qg).astype(jnp.int8)
+    hq = jnp.clip(hq, 0, qh).astype(jnp.int8)
+    return gq, hq, g_scale, h_scale
+
+
+def quant_psum_narrow(rows_global: int, num_bins: int) -> bool:
+    """True when the STATIC bound rows * hess_levels fits int16, so the
+    cross-device histogram psum can ride a half-width payload.  The bound
+    covers every partial AND the global sum, so no reduction order can
+    overflow.  This is the "payload shrinks with the quantization width"
+    lever: fewer levels => smaller bound => narrower psum."""
+    _, qh = quant_levels(num_bins)
+    return rows_global * qh < (1 << 15)
+
+
+def psum_quant_hist(hist: jax.Array, axis_name: Optional[str],
+                    rows_global: int, num_bins: int) -> jax.Array:
+    """psum an integer histogram across the data axis, narrowed to int16
+    when ``quant_psum_narrow`` proves it safe.  The ICI payload is
+    2 channels x {2,4} bytes vs the f32 path's 3 x 4
+    (``hist_payload_bytes`` is the accounting twin used by
+    tools/hist_probe.py and the bench stage)."""
+    if axis_name is None:
+        return hist
+    if quant_psum_narrow(rows_global, num_bins):
+        return lax.psum(hist.astype(jnp.int16), axis_name).astype(hist.dtype)
+    return lax.psum(hist, axis_name)
+
+
+def hist_payload_bytes(num_features: int, num_bins: int,
+                       rows_global: int = 0,
+                       quant_bins: Optional[int] = None) -> int:
+    """Per-psum histogram payload bytes for one [*, F, B] histogram.
+
+    ``quant_bins=None`` = the f32 pipeline (3 channels x f32); otherwise
+    the integer pipeline (2 channels, int16 when the static bound
+    narrows, else int32).  Pure accounting — shared by the growers'
+    documentation, tools/hist_probe.py and tests so the claimed payload
+    can never drift from the psum'd dtypes."""
+    if quant_bins is None:
+        return 3 * num_features * num_bins * 4
+    item = 2 if quant_psum_narrow(rows_global, quant_bins) else 4
+    return 2 * num_features * num_bins * item
+
+
+def _vals_t_int(gq, hq, member):
+    """[2, n] int8 value block (g, h) * member — the integer twin of
+    ``_vals_t`` (no count row: counts are hessian-estimated at split
+    time, reference feature_histogram.hpp:813 cnt_factor)."""
+    return jnp.stack([gq, hq]) * member.astype(jnp.int8)
+
+
+def histogram_matmul_int(
+    binned_t: jax.Array,   # [F, n] uint8/uint16 feature-major
+    vals_t: jax.Array,     # [2, n] int8 (g, h) * member
+    num_bins: int,
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> jax.Array:
+    """Integer histogram via int8 one-hot matmul. Returns [2, F, B] i32.
+
+    The MXU's s8 x s8 -> s32 path: one-hot operands are int8 (half the
+    bytes of the bf16 f32-path one-hot) and accumulation is exact int32
+    (``preferred_element_type``), so there is no bf16 mantissa loss and
+    no accumulation-order wobble to re-verify per backend."""
+    F, n = binned_t.shape
+    B = num_bins
+    nb = max(1, _pad_rows(n, block_rows) // block_rows)
+    n_pad = nb * block_rows
+    if n_pad != n:
+        binned_t = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+        vals_t = jnp.pad(vals_t, ((0, 0), (0, n_pad - n)))
+    iota = jnp.arange(B, dtype=binned_t.dtype)
+    C = block_rows
+
+    def body(acc, i):
+        b = lax.dynamic_slice(binned_t, (0, i * C), (F, C))   # [F, C]
+        v = lax.dynamic_slice(vals_t, (0, i * C), (2, C))     # [2, C]
+        onehot2d = (b.T[:, :, None] == iota).astype(jnp.int8).reshape(
+            C, F * B)
+        part = lax.dot(v, onehot2d, preferred_element_type=jnp.int32)
+        return acc + part, None
+
+    init = jnp.zeros((2, F * B), dtype=jnp.int32)
+    acc, _ = lax.scan(body, init, jnp.arange(nb, dtype=jnp.int32))
+    return acc.reshape(2, F, B)
+
+
+def _pack_modulus(n: int, levels) -> int:
+    """Static modulus for the packed-scatter trick, or 0 when unsafe.
+
+    Per-bin field bounds: hess sum in [0, n*qh], grad sum in
+    [-n*qg, n*qg].  Packing word = g * M + h with M > n*qh keeps the two
+    sums separable after accumulation (h never borrows into g because it
+    is non-negative and < M); the whole packed value must stay inside
+    int32."""
+    if levels is None:
+        return 0
+    qg, qh = levels
+    bound_h = n * qh
+    M = 1
+    while M <= bound_h:
+        M <<= 1
+    if n * qg * M + M < (1 << 31):
+        return M
+    return 0
+
+
+def histogram_scatter_int(
+    binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
+    levels: Optional[tuple] = None,
+) -> jax.Array:
+    """Integer scatter-add histogram (CPU semantics path) — [2, F, B] i32.
+
+    When the static bound allows, the two channels are PACKED into one
+    i32 word per row (``g * M + h``), halving the scatter update traffic;
+    the fields are split back apart arithmetically after accumulation."""
+    F, n = binned_t.shape
+    B = num_bins
+    binned = binned_t.T                                    # [n, F]
+    offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    flat_idx = binned.astype(jnp.int32) + offsets          # [n, F]
+    M = _pack_modulus(n, levels)
+    if M:
+        word = (vals_t[0].astype(jnp.int32) * M
+                + vals_t[1].astype(jnp.int32))             # [n]
+        hist = jnp.zeros((F * B,), jnp.int32)
+        hist = hist.at[flat_idx.reshape(-1)].add(
+            jnp.broadcast_to(word[:, None], (n, F)).reshape(-1))
+        h = jnp.mod(hist, M)
+        g = (hist - h) // M
+        return jnp.stack([g, h]).reshape(2, F, B)
+    vals = vals_t.T.astype(jnp.int32)                      # [n, 2]
+    hist = jnp.zeros((F * B, 2), jnp.int32)
+    updates = jnp.broadcast_to(vals[:, None, :], (n, F, 2))
+    hist = hist.at[flat_idx.reshape(-1)].add(updates.reshape(-1, 2))
+    return hist.reshape(F, B, 2).transpose(2, 0, 1)
+
+
+def build_histogram_int(
+    binned_t: jax.Array,   # [F, n] feature-major
+    gq: jax.Array,         # [n] int8 quantized grad (weights folded)
+    hq: jax.Array,         # [n] int8 quantized hess
+    member: jax.Array,     # [n] bool leaf membership
+    num_bins: int,
+    method: str = "auto",
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+    levels: Optional[tuple] = None,
+) -> jax.Array:
+    """Masked integer histogram [2, F, B] i32 = per-bin (sum gq, sum hq)
+    over ``member`` rows — the quantized twin of ``build_histogram``,
+    dispatched through the same ``resolve_hist_method`` seam."""
+    vals_t = _vals_t_int(gq, hq, member)
+    method = resolve_hist_method(method, quantized=True)
+    if method == "matmul_int8":
+        return histogram_matmul_int(binned_t, vals_t, num_bins, block_rows)
+    if method == "scatter_int":
+        return histogram_scatter_int(binned_t, vals_t, num_bins, levels)
+    raise ValueError(f"unknown quantized histogram method {method!r}")
+
+
+def compacted_histogram_int(
+    binned_t: jax.Array, gq: jax.Array, hq: jax.Array,
+    weights: jax.Array,    # [n] f32 bagging/GOSS weights (0 = excluded)
+    member: jax.Array,     # [n] bool leaf membership
+    num_bins: int,
+    caps: list,
+    method: str = "auto",
+    levels: Optional[tuple] = None,
+) -> jax.Array:
+    """Integer twin of ``compacted_histogram``: gather the member rows
+    into the smallest static capacity that fits, then run the integer
+    kernel over ``cap`` rows instead of n."""
+    F, n = binned_t.shape
+    member = member & (weights > 0)
+    count = jnp.sum(member)
+
+    def branch(cap: int):
+        def run():
+            idx = jnp.nonzero(member, size=cap, fill_value=n)[0]
+            valid = idx < n
+            idxc = jnp.minimum(idx, n - 1)
+            cols = jnp.take(binned_t, idxc, axis=1)        # [F, cap]
+            g = jnp.take(gq, idxc)
+            h = jnp.take(hq, idxc)
+            return build_histogram_int(cols, g, h, valid, num_bins,
+                                       method=method, levels=levels)
+        return run
+
+    if len(caps) == 1:
+        return build_histogram_int(binned_t, gq, hq, member, num_bins,
+                                   method=method, levels=levels)
+    caps_arr = jnp.asarray(caps, jnp.int32)
+    bucket = jnp.sum(caps_arr >= count) - 1
+    return lax.switch(bucket, [branch(c) for c in caps])
+
+
+def segment_histogram_int(
+    binned_t: jax.Array, gq: jax.Array, hq: jax.Array,
+    member: jax.Array,     # [n] bool; non-members land in the dummy slot
+    slot: jax.Array,       # [n] i32 in [0, num_slots]
+    num_slots: int,
+    num_bins: int,
+    levels: Optional[tuple] = None,
+) -> jax.Array:
+    """Per-slot integer histogram [S, 2, F, B] i32 (scatter formulation,
+    CPU semantics path) — the quantized twin of ``segment_histogram``,
+    with the same packed-word shrink as ``histogram_scatter_int``."""
+    F, n = binned_t.shape
+    B = num_bins
+    S = num_slots
+    binned = binned_t.T
+    slot_m = jnp.where(member, slot.astype(jnp.int32), S)
+    offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    flat = (slot_m[:, None] * (F * B)
+            + binned.astype(jnp.int32) + offsets)          # [n, F]
+    M = _pack_modulus(n, levels)
+    if M:
+        word = (gq.astype(jnp.int32) * M + hq.astype(jnp.int32)) \
+            * member.astype(jnp.int32)
+        hist = jnp.zeros(((S + 1) * F * B,), jnp.int32)
+        hist = hist.at[flat.reshape(-1)].add(
+            jnp.broadcast_to(word[:, None], (n, F)).reshape(-1))
+        h = jnp.mod(hist, M)
+        g = (hist - h) // M
+        return jnp.stack([g, h]).reshape(2, S + 1, F, B).transpose(
+            1, 0, 2, 3)[:S]
+    vals = _vals_t_int(gq, hq, member).T.astype(jnp.int32)  # [n, 2]
+    hist = jnp.zeros(((S + 1) * F * B, 2), jnp.int32)
+    updates = jnp.broadcast_to(vals[:, None, :], (n, F, 2))
+    hist = hist.at[flat.reshape(-1)].add(updates.reshape(-1, 2))
+    return hist.reshape(S + 1, F, B, 2)[:S].transpose(0, 3, 1, 2)
+
+
+def pack_cols_u32_quant(binned_t: jax.Array, gq: jax.Array, hq: jax.Array,
+                        member: jax.Array):
+    """Quantized twin of ``pack_cols_u32``: bins pack 4-per-u32 as before,
+    and the THREE f32 value words collapse into ONE
+    (``(gq+128) | hq<<8 | member<<16``) — the arena's single fused gather
+    moves Wb+1 words per row instead of Wb+3."""
+    F, n = binned_t.shape
+    if binned_t.dtype != jnp.uint8:
+        return None, 0          # u16 bins (max_bin > 256): no packing
+    Wb = (F + 3) // 4
+    pad = Wb * 4 - F
+    bt = jnp.pad(binned_t, ((0, pad), (0, 0))) if pad else binned_t
+    b32 = bt.astype(jnp.uint32).reshape(Wb, 4, n)
+    bin_words = (b32[:, 0] | (b32[:, 1] << 8)
+                 | (b32[:, 2] << 16) | (b32[:, 3] << 24))   # [Wb, n]
+    val_word = ((gq.astype(jnp.int32) + 128).astype(jnp.uint32)
+                | (hq.astype(jnp.uint32) << 8)
+                | (member.astype(jnp.uint32) << 16))        # [1, n]
+    return jnp.concatenate([bin_words, val_word[None, :]], axis=0), Wb
+
+
+def segment_histogram_sorted_int(
+    binned_t: jax.Array,   # [F, n] uint8/16 feature-major
+    gq: jax.Array,         # [n] int8
+    hq: jax.Array,         # [n] int8
+    slot: jax.Array,       # [n] i32 in [0, num_slots]; dummies pre-slotted
+    num_slots: int,
+    num_bins: int,
+    block_rows: int = 1024,
+    caps: Optional[list] = None,
+    packed: Optional[tuple] = None,    # pack_cols_u32_quant output
+) -> jax.Array:
+    """Integer sorted-arena segment histogram: same sort + block-aligned
+    arena as ``segment_histogram_sorted`` but the per-block one-hot
+    matmul runs int8 -> int32 and the block->slot fold is an exact
+    integer ``segment_sum`` (the f32 path's slot-fold matmul would lose
+    integer exactness past 2^24).  Returns [S, 2, F, B] i32."""
+    F, n = binned_t.shape
+    B = num_bins
+    S = num_slots
+    if caps is None:
+        caps = [n]
+
+    if n < (1 << 24) and num_slots < 256:
+        key = ((slot.astype(jnp.uint32) << 24)
+               | jnp.arange(n, dtype=jnp.uint32))
+        skey = lax.sort(key)
+        sorted_slot = (skey >> 24).astype(jnp.int32)
+        order = (skey & jnp.uint32(0x00FFFFFF)).astype(jnp.int32)
+    else:
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+        sorted_slot, order = lax.sort((slot, row_ids), is_stable=True,
+                                      num_keys=1)
+    bounds = jnp.searchsorted(sorted_slot,
+                              jnp.arange(S + 1, dtype=sorted_slot.dtype))
+    row_start = bounds[:S].astype(jnp.int32)
+    counts = (bounds[1:] - bounds[:S]).astype(jnp.int32)
+
+    iota = jnp.arange(B, dtype=binned_t.dtype)
+
+    def arena(cap: int):
+        C = max(128, min(block_rows,
+                         1 << max(0, (max(cap, 1) // (4 * max(S, 1))
+                                      ).bit_length() - 1)))
+        NB = _pad_rows(max(cap, 1), C) // C + S
+
+        def run():
+            nblk = (counts + C - 1) // C
+            blk_end = jnp.cumsum(nblk)
+            blk_start = (blk_end - nblk).astype(jnp.int32)
+            j_idx = jnp.arange(NB, dtype=blk_end.dtype)
+            blk_slot = jnp.searchsorted(blk_end, j_idx,
+                                        side="right").astype(jnp.int32)
+            blk_slot = jnp.minimum(blk_slot, S)
+
+            q = jnp.arange(NB * C, dtype=jnp.int32)
+            s_of = blk_slot[q // C]
+            s_c = jnp.minimum(s_of, S - 1)
+            o = q - blk_start[s_c] * C
+            valid = (s_of < S) & (o < counts[s_c])
+            src_sorted = jnp.minimum(row_start[s_c] + o, n - 1)
+            src = order[src_sorted]
+
+            def block_partial(rows, vals):
+                """[F, C] bins x [2, C] int8 vals -> [2, F*B] i32."""
+                onehot2d = (rows.T[:, :, None] == iota.astype(rows.dtype)
+                            ).astype(jnp.int8).reshape(C, F * B)
+                return lax.dot(vals, onehot2d,
+                               preferred_element_type=jnp.int32)
+
+            if packed is not None and packed[0] is not None:
+                words_t, Wb = packed
+                rec = jnp.take(words_t, src, axis=1)    # [Wb+1, NBC] u32
+                recb = rec.reshape(Wb + 1, NB, C).transpose(1, 0, 2)
+                vmask = valid.reshape(NB, 1, C)
+
+                def body(_, xs):
+                    blk_rec, vm = xs
+                    bw = blk_rec[:Wb]                   # [Wb, C] u32
+                    rows = jnp.concatenate(
+                        [((bw >> (8 * j)) & 0xFF) for j in range(4)],
+                        axis=0).reshape(4, Wb, C).transpose(
+                            1, 0, 2).reshape(Wb * 4, C)[:F]   # [F, C]
+                    vw = blk_rec[Wb]                    # [C] u32
+                    g = (vw & 0xFF).astype(jnp.int32) - 128
+                    h = ((vw >> 8) & 0xFF).astype(jnp.int32)
+                    m = ((vw >> 16) & 1).astype(jnp.int32)
+                    sel = vm[0] & (m == 1)
+                    vals = jnp.where(sel, jnp.stack([g, h]), 0
+                                     ).astype(jnp.int8)
+                    return _, block_partial(rows.astype(jnp.int32), vals)
+
+                _, parts = lax.scan(body, None, (recb, vmask))
+            else:
+                cols = jnp.take(binned_t, src, axis=1)  # [F, NBC]
+                g = jnp.where(valid, jnp.take(gq, src), 0)
+                h = jnp.where(valid, jnp.take(hq, src), 0)
+                vt = jnp.stack([g, h]).astype(jnp.int8)
+                colsb = cols.reshape(F, NB, C).transpose(1, 0, 2)
+                vtb = vt.reshape(2, NB, C).transpose(1, 0, 2)
+
+                def body(_, xs):
+                    b, v = xs
+                    return _, block_partial(b, v)
+
+                _, parts = lax.scan(body, None, (colsb, vtb))
+
+            # blocks -> slots: exact integer fold (parts are i32; a
+            # tiny [NB]-segment scatter, NB is a few hundred at most)
+            hist = jax.ops.segment_sum(parts.reshape(NB, 2 * F * B),
+                                       blk_slot, num_segments=S + 1)[:S]
+            return hist.reshape(S, 2, F, B)
+        return run
+
+    if len(caps) == 1:
+        return arena(caps[0])()
+    total = bounds[S].astype(jnp.int32)
+    caps_arr = jnp.asarray(caps, jnp.int32)
+    bucket = jnp.sum(caps_arr >= total) - 1
+    return lax.switch(bucket, [arena(c) for c in caps])
+
+
+# 2 int channels instead of 3 f32: 2 * 64 = 128 rows fill the MXU tile,
+# so the quantized expanded pass covers 64 live slots for the cycles the
+# f32 path spends on 42
+_EXPAND_SLOTS_QUANT = 64
+
+
+def segment_histogram_expanded_int(
+    binned_t: jax.Array,   # [F, n] feature-major
+    gq: jax.Array,
+    hq: jax.Array,
+    member: jax.Array,     # [n] bool
+    slot: jax.Array,       # [n] i32; values >= live_cap contribute nothing
+    num_bins: int,
+    live_cap: int = _EXPAND_SLOTS_QUANT,
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> jax.Array:
+    """Integer slot-expanded full-matrix pass: LHS [2*live_cap, C] int8
+    (row j*cap+s carries vals[j] where slot == s), one s8 MXU tile per
+    block.  Returns [live_cap, 2, F, B] i32."""
+    F, n = binned_t.shape
+    B = num_bins
+    SE = live_cap
+    nb = max(1, _pad_rows(n, block_rows) // block_rows)
+    n_pad = nb * block_rows
+    vals_t = _vals_t_int(gq, hq, member)
+    slot_i = slot.astype(jnp.int32)
+    if n_pad != n:
+        binned_t = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+        vals_t = jnp.pad(vals_t, ((0, 0), (0, n_pad - n)))
+        slot_i = jnp.pad(slot_i, (0, n_pad - n), constant_values=SE)
+    iota_b = jnp.arange(B, dtype=binned_t.dtype)
+    iota_s = jnp.arange(SE, dtype=jnp.int32)
+    C = block_rows
+
+    def body(acc, i):
+        b = lax.dynamic_slice(binned_t, (0, i * C), (F, C))   # [F, C]
+        v = lax.dynamic_slice(vals_t, (0, i * C), (2, C))     # [2, C]
+        sl = lax.dynamic_slice(slot_i, (i * C,), (C,))        # [C]
+        oh_s = (sl[None, :] == iota_s[:, None]).astype(jnp.int8)  # [SE, C]
+        lhs = (v[:, None, :] * oh_s[None, :, :]).reshape(2 * SE, C)
+        onehot2d = (b.T[:, :, None] == iota_b).astype(jnp.int8).reshape(
+            C, F * B)
+        part = lax.dot(lhs, onehot2d, preferred_element_type=jnp.int32)
+        return acc + part, None
+
+    init = jnp.zeros((2 * SE, F * B), dtype=jnp.int32)
+    acc, _ = lax.scan(body, init, jnp.arange(nb, dtype=jnp.int32))
+    return acc.reshape(2, SE, F, B).transpose(1, 0, 2, 3)
+
+
+def compacted_segment_histogram_int(
+    binned_t: jax.Array,   # [F, n] feature-major
+    gq: jax.Array,
+    hq: jax.Array,
+    weights: jax.Array,    # [n] f32 (0 = excluded)
+    slot: jax.Array,       # [n] i32 in [0, num_slots]
+    num_slots: int,
+    num_bins: int,
+    caps: list,
+    num_live: Optional[jax.Array] = None,
+    packed: Optional[tuple] = None,     # pack_cols_u32_quant output
+    levels: Optional[tuple] = None,
+) -> jax.Array:
+    """Integer twin of ``compacted_segment_histogram`` with the same
+    backend dispatch: sorted int arena / expanded int pass on
+    accelerators (LGBM_TPU_SEGHIST overrides), packed scatter with
+    nonzero compaction on CPU.  Returns [S, 2, F, B] i32."""
+    F, n = binned_t.shape
+    member = weights > 0
+    if use_sorted_seghist():
+        slot_w = jnp.where(member, slot, num_slots)
+
+        def arena_path(_):
+            return segment_histogram_sorted_int(
+                binned_t, gq, hq, slot_w, num_slots, num_bins,
+                caps=caps, packed=packed)
+
+        small_enabled = os.environ.get("LGBM_TPU_SMALL_ROUNDS") != "0"
+        if num_live is None or num_slots <= _SMALL_ROUND_SLOTS \
+                or not small_enabled:
+            return arena_path(None)
+        se = min(_EXPAND_SLOTS_QUANT, num_slots)
+
+        def expanded_path(_):
+            hist = segment_histogram_expanded_int(
+                binned_t, gq, hq, member, slot_w, num_bins, live_cap=se)
+            if num_slots > se:
+                hist = jnp.concatenate(
+                    [hist, jnp.zeros((num_slots - se, 2, F, num_bins),
+                                     jnp.int32)], axis=0)
+            return hist
+
+        return lax.cond(num_live <= se, expanded_path, arena_path, None)
+
+    in_play = (slot < num_slots) & member
+    count = jnp.sum(in_play)
+
+    def branch(cap: int):
+        def run():
+            idx = jnp.nonzero(in_play, size=cap, fill_value=n)[0]
+            valid = idx < n
+            idxc = jnp.minimum(idx, n - 1)
+            cols = jnp.take(binned_t, idxc, axis=1)
+            g = jnp.take(gq, idxc)
+            h = jnp.take(hq, idxc)
+            s = jnp.where(valid, jnp.take(slot, idxc), num_slots)
+            return segment_histogram_int(cols, g, h, valid, s, num_slots,
+                                         num_bins, levels=levels)
+        return run
+
+    if len(caps) == 1:
+        return segment_histogram_int(binned_t, gq, hq, in_play, slot,
+                                     num_slots, num_bins, levels=levels)
+    caps_arr = jnp.asarray(caps, jnp.int32)
+    bucket = jnp.sum(caps_arr >= count) - 1
+    return lax.switch(bucket, [branch(c) for c in caps])

@@ -68,3 +68,28 @@ def leaf_percentile(
     out = out.at[jnp.where(need_last, seg_sorted, L)].set(
         jnp.where(need_last, res_sorted, 0.0).astype(jnp.float32), mode="drop")
     return out[:L]
+
+
+def quant_train_renew_leaf(
+    leaf_id: jax.Array,    # [n] i32 final leaf assignment
+    grad: jax.Array,       # [n] f32 TRUE (un-quantized) gradients
+    hess: jax.Array,       # [n] f32 TRUE hessians
+    weight: jax.Array,     # [n] f32 bagging/GOSS weights (0 = excluded)
+    num_leaves: int,
+):
+    """True-f32 per-leaf gradient/hessian sums for quantized training's
+    leaf renewal (config ``quant_train_renew_leaf``).
+
+    reference: CUDASingleGPUTreeLearner::RenewDiscretizedTreeLeaves /
+    GradientDiscretizer::RenewIntGradTreeOutput — with
+    ``use_quantized_grad`` the tree STRUCTURE comes from the integer
+    histograms, but the committed leaf outputs are re-fit from the true
+    float gradient sums, removing the discretization bias from the
+    scores the next round boosts against.  Returns ``(sg [L], sh [L])``
+    f32; the grower turns them into outputs via ``ops.split.leaf_output``
+    (and psums them under data sharding).
+    """
+    w = weight
+    sg = jax.ops.segment_sum(grad * w, leaf_id, num_segments=num_leaves)
+    sh = jax.ops.segment_sum(hess * w, leaf_id, num_segments=num_leaves)
+    return sg.astype(jnp.float32), sh.astype(jnp.float32)

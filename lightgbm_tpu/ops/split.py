@@ -490,3 +490,45 @@ def _best_categorical(hist, sum_grad, sum_hess, num_data, num_bin, valid_bin,
     bitset = bitset.at[:, word].add(bit)  # each word gets OR'd via add (bits disjoint)
 
     return cat_gain, mm_k.astype(jnp.int32), cat_lg, cat_lh, cat_lc, bitset
+
+
+# ======================================================================
+# Quantized-gradient training (use_quantized_grad) rescaling
+# ======================================================================
+
+
+def quant_rescale_hist(hist_int: jax.Array, g_scale, h_scale, num_data,
+                       cnt_factor=None) -> jax.Array:
+    """[2, F, B] (or [2, G, Bg]) integer histogram -> the [3, F, B] f32
+    histogram every split kernel above consumes.
+
+    reference: the quantized-training split path converts int32/int64
+    bin sums to double before the gain math
+    (feature_histogram.hpp GET_GRAD/GET_HESS int-hist specializations);
+    here the rescale runs in jnp.float64 — true f64 under
+    ``jax_enable_x64``, f32 otherwise — then lands in f32 for the
+    vectorized scan.  Per-bin COUNTS are estimated from the hessian
+    channel with the leaf's count factor
+    (``Common::RoundInt(sum_hess * cnt_factor)``,
+    feature_histogram.hpp:813): the count channel is deliberately NOT
+    accumulated in quantized mode — dropping it is what shrinks the
+    integer histogram to 2 channels and the data-parallel psum payload
+    with it (ops/histogram.py ``hist_payload_bytes``).
+
+    ``cnt_factor`` defaults to ``num_data / hess_int_total`` with the
+    total read from axis-0 feature/group 0, whose bins partition the
+    leaf's rows (every row has exactly one bin per feature).  Voting's
+    local-candidate pass overrides it with the globally-derived factor
+    (grower.py ``leaf_best_voting``).
+    """
+    # true f64 only when the session enabled x64 (requesting f64 under
+    # the default x64-off config would just warn and truncate to f32)
+    wide = jnp.float64 if jax.config.x64_enabled else jnp.float32
+    hi = hist_int.astype(wide)
+    g = hi[0] * jnp.asarray(g_scale, wide)
+    h = hi[1] * jnp.asarray(h_scale, wide)
+    if cnt_factor is None:
+        tot = jnp.sum(hist_int[1, 0, :]).astype(jnp.float32)
+        cnt_factor = num_data / jnp.maximum(tot, 1.0)
+    c = jnp.round(hi[1] * jnp.asarray(cnt_factor, wide))
+    return jnp.stack([g, h, c]).astype(jnp.float32)

@@ -16,6 +16,13 @@ by how the Mesh axes shard the data:
   parallel_tree_learner.h:190), partition mask broadcast by psum.
 - 2-D: both at once (not expressible in the reference at all).
 
+With ``use_quantized_grad`` the data- and voting-parallel reductions move
+INTEGER histograms (``ops.histogram.psum_quant_hist`` inside the growers):
+[2, F, B] i32 — 8 bytes/cell vs the f32 path's 12 — narrowed to int16
+(4 bytes/cell) when the static rows x quant-level bound proves overflow
+impossible, so the ICI payload shrinks with the quantization width
+(``ops.histogram.hist_payload_bytes`` is the accounting twin).
+
 The factory mirrors CreateTreeLearner (src/treelearner/tree_learner.cpp:13).
 """
 

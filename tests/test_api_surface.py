@@ -158,3 +158,41 @@ def test_parameters_doc_current():
         [sys.executable, os.path.join(root, "tools", "gen_parameters_doc.py"),
          "--check"], capture_output=True, text=True)
     assert r.returncode == 0, r.stderr + r.stdout
+
+
+def test_parameters_doc_lists_every_config_field():
+    """Every Config field — including the quantized-training keys — must
+    appear in docs/Parameters.rst, and the check mode must FAIL BY NAME
+    when one is removed (config surface can't drift undocumented)."""
+    import dataclasses
+    import os
+    import subprocess
+    import sys
+
+    from lightgbm_tpu.config import Config
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rst = open(os.path.join(root, "docs", "Parameters.rst")).read()
+    for f in dataclasses.fields(Config):
+        assert f"``{f.name}``" in rst, f"{f.name} missing from Parameters.rst"
+    for key in ("use_quantized_grad", "num_grad_quant_bins",
+                "quant_train_renew_leaf", "stochastic_rounding"):
+        assert f"``{key}``" in rst
+
+    # simulate drift: drop the use_quantized_grad line from a copy and
+    # assert --check --out fails naming the field
+    import tempfile
+    broken = "\n".join(ln for ln in rst.splitlines()
+                       if "``use_quantized_grad``" not in ln) + "\n"
+    with tempfile.NamedTemporaryFile("w", suffix=".rst",
+                                     delete=False) as fh:
+        fh.write(broken)
+        path = fh.name
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "tools", "gen_parameters_doc.py"),
+             "--check", "--out", path], capture_output=True, text=True)
+        assert r.returncode == 1
+        assert "use_quantized_grad" in r.stderr
+    finally:
+        os.unlink(path)

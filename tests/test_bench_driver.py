@@ -30,3 +30,75 @@ def test_bench_cpu_pipeline_emits_parseable_result():
         assert key in last, last
     assert last.get("sec_per_tree", 0) > 0, last
     assert "cpu" in last["metric"].lower(), last["metric"]
+
+
+def _run_worker(env_extra, timeout=240):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "BENCH_STAGE": "tpu-worker",
+        "BENCH_WORKER_ALLOW_CPU": "1",
+        "BENCH_ROWS": "5000",
+        "BENCH_TREES": "3",
+        "BENCH_LEAVES": "15",
+        "BENCH_BIN": "63",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=repo)
+    stages = []
+    for ln in proc.stdout.strip().splitlines():
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and obj.get("stage"):
+            stages.append(obj)
+    return stages
+
+
+def test_bench_journal_resume_after_crash(tmp_path):
+    """Stage-journal contract: a run that dies after banking a stage must
+    NOT re-execute it on rerun — the journal replays it and only the
+    missing stages run (round 5 lost ranking+epsilon to exactly this)."""
+    journal = str(tmp_path / "journal.json")
+    # first run "crashes" after kernel_probe (only that stage selected)
+    s1 = _run_worker({"BENCH_JOURNAL": journal,
+                      "BENCH_ONLY": "kernel_probe"})
+    assert any(s["stage"] == "kernel_probe" and "error" not in s
+               for s in s1), s1
+    d = json.load(open(journal))
+    assert "kernel_probe" in d["stages"]
+
+    # rerun wants kernel_probe + hist_probe: the first must come from the
+    # journal (no re-execution), the second runs fresh and is banked
+    s2 = _run_worker({"BENCH_JOURNAL": journal,
+                      "BENCH_ONLY": "kernel_probe,hist_probe"})
+    kp = [s for s in s2 if s["stage"] == "kernel_probe"]
+    hp = [s for s in s2 if s["stage"] == "hist_probe"]
+    assert kp and kp[0].get("journal") is True, kp
+    assert hp and "error" not in hp[0] and "journal" not in hp[0], hp
+    d = json.load(open(journal))
+    assert set(d["stages"]) == {"kernel_probe", "hist_probe"}
+
+
+def test_bench_journal_fingerprint_invalidation(tmp_path, monkeypatch):
+    """A journal written under a different workload shape must not be
+    replayed (stale telemetry masquerading as current is worse than a
+    rerun)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    journal = str(tmp_path / "j.json")
+    monkeypatch.setenv("BENCH_JOURNAL", journal)
+    monkeypatch.setenv("BENCH_ROWS", "1000")
+    import importlib
+    import bench
+    importlib.reload(bench)
+    bench.journal_put("smoke", {"value": 1.0})
+    assert bench.journal_stages() == {"smoke": {"value": 1.0}}
+    monkeypatch.setenv("BENCH_ROWS", "2000")
+    importlib.reload(bench)
+    assert bench.journal_stages() == {}
+    importlib.reload(bench)  # leave module state consistent for others

@@ -39,6 +39,16 @@ PARITY_CASES = {
                   "monotone_constraints": [1, -1] + [0] * (F - 2)}, Y_REG),
     "multiclass": ({"objective": "multiclass", "num_class": 3,
                     "num_leaves": 7, "learning_rate": 0.1}, Y_MC),
+    # quantized-gradient mode: the in-loop discretization (stochastic
+    # rounding keys ride the stacked per-round key stream) must keep
+    # chunked == per-iteration byte-identical WITHIN the mode
+    "quant": ({"objective": "binary", "num_leaves": 15,
+               "learning_rate": 0.1, "use_quantized_grad": True}, Y_BIN),
+    "quant_renew": ({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.1, "use_quantized_grad": True,
+                     "quant_train_renew_leaf": True,
+                     "bagging_fraction": 0.7, "bagging_freq": 2,
+                     "bagging_seed": 11}, Y_BIN),
 }
 
 

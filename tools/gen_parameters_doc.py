@@ -81,18 +81,35 @@ def generate() -> str:
 
 
 def main():
+    out_path = OUT
+    if "--out" in sys.argv:
+        i = sys.argv.index("--out")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+            print("--out requires a path argument", file=sys.stderr)
+            return 2
+        out_path = sys.argv[i + 1]
     text = generate()
     if "--check" in sys.argv:
-        on_disk = open(OUT).read() if os.path.exists(OUT) else ""
-        if on_disk != text:
-            print("docs/Parameters.rst is stale: regenerate with "
+        on_disk = open(out_path).read() if os.path.exists(out_path) else ""
+        # name the missing fields FIRST: "stale" alone sends people
+        # diffing; a missing config key (the usual drift: a field added
+        # without regenerating) should fail by name
+        missing = [f.name for f in dataclasses.fields(Config)
+                   if f"``{f.name}``" not in on_disk]
+        if missing:
+            print(f"{out_path} is missing Config fields: "
+                  f"{', '.join(missing)}; regenerate with "
                   "python tools/gen_parameters_doc.py", file=sys.stderr)
             return 1
-        print("docs/Parameters.rst is current")
+        if on_disk != text:
+            print(f"{out_path} is stale: regenerate with "
+                  "python tools/gen_parameters_doc.py", file=sys.stderr)
+            return 1
+        print(f"{out_path} is current")
         return 0
-    with open(OUT, "w") as fh:
+    with open(out_path, "w") as fh:
         fh.write(text)
-    print(f"wrote {OUT} ({len(text.splitlines())} lines)")
+    print(f"wrote {out_path} ({len(text.splitlines())} lines)")
     return 0
 
 

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Histogram-pipeline micro-bench: f32 vs quantized-gradient throughput
+plus per-round psum payload accounting (use_quantized_grad).
+
+Measures, on the live backend:
+
+- ``f32``: the resolved f32 histogram kernel (matmul/bf16 on
+  accelerators, scatter on CPU) over a synthetic [F, n] binned matrix;
+- ``quant``: gradient discretization (``quantize_gradients``) + the
+  resolved integer kernel (int8 one-hot matmul with int32 accumulation
+  on accelerators — ``matmul_int8`` — packed scatter on CPU);
+- payload accounting per histogram psum for both modes
+  (``hist_payload_bytes``: 3 x f32 channels vs 2 integer channels,
+  int16-narrowed when the static rows x level bound allows) and the
+  per-tree estimate (one masked pass per frontier level,
+  ~log2(leaves) levels);
+- a rescale sanity check: the integer histogram rescaled by the
+  quantization scales must track the f32 histogram within the
+  discretization step.
+
+The LAST stdout line is a single JSON object so bench.py's worker can
+bank it as a stage (``stage: hist_probe``, wired next to
+``dispatch_probe``).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/hist_probe.py \
+        [--rows 1000000] [--features 28] [--max-bin 63] \
+        [--quant-bins 4] [--leaves 255] [--reps 5]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_probe(rows=1_000_000, features=28, max_bin=63, quant_bins=4,
+              leaves=255, reps=5) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import histogram as H
+
+    B = max_bin + 1
+    rng = np.random.RandomState(0)
+    binned_t = jnp.asarray(
+        rng.randint(0, max_bin, (features, rows), dtype=np.int64), jnp.uint8)
+    grad = jnp.asarray(rng.randn(rows), jnp.float32)
+    hess = jnp.abs(grad) + 0.1
+    ones = jnp.ones((rows,), jnp.float32)
+    member = jnp.ones((rows,), bool)
+
+    def sync(x):
+        # block_until_ready is a no-op on the tunneled axon backend
+        # (docs/PERFORMANCE.md): sync via a dependent host copy instead
+        return float(np.asarray(jnp.sum(x.astype(jnp.float32))))
+
+    out = {
+        "rows": rows, "features": features, "max_bin": max_bin,
+        "quant_bins": quant_bins,
+        "platform": jax.devices()[0].platform,
+        "f32_method": H.resolve_hist_method("auto"),
+        "quant_method": H.resolve_hist_method("auto", quantized=True),
+    }
+
+    # ---- f32 pipeline -------------------------------------------------
+    f32_fn = jax.jit(lambda b, g, h, m: H.build_histogram(b, g, h, m, B))
+    sync(f32_fn(binned_t, grad, hess, ones))            # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sync(f32_fn(binned_t, grad, hess, ones))
+    f32_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    # ---- quantized pipeline (discretize + integer histogram) ----------
+    levels = H.quant_levels(quant_bins)
+    key = jax.random.PRNGKey(0)
+
+    def quant_pass(b, g, h, w):
+        gq, hq, gs, hs = H.quantize_gradients(g, h, w, quant_bins, key)
+        hist = H.build_histogram_int(b, gq, hq, w > 0, B, levels=levels)
+        return hist, gs, hs
+
+    q_fn = jax.jit(quant_pass)
+    hist_i, gs, hs = q_fn(binned_t, grad, hess, ones)
+    sync(hist_i)                                        # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sync(q_fn(binned_t, grad, hess, ones)[0])
+    quant_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    # ---- rescale sanity: int sums * scale tracks the f32 sums ---------
+    ref = np.asarray(f32_fn(binned_t, grad, hess, ones))
+    hi = np.asarray(hist_i)
+    g_err = np.abs(hi[0] * float(gs) - ref[0]).max()
+    h_err = np.abs(hi[1] * float(hs) - ref[1]).max()
+    # stochastic rounding error per row is < 1 level; per bin it grows
+    # ~sqrt(rows_in_bin) — bound loosely by a few levels * sqrt(n/B)
+    tol = 8.0 * max(float(gs), float(hs)) * max((rows / B) ** 0.5, 1.0)
+
+    # ---- payload accounting -------------------------------------------
+    f32_payload = H.hist_payload_bytes(features, B)
+    quant_payload = H.hist_payload_bytes(features, B, rows, quant_bins)
+    levels_per_tree = max(1.0, float(np.log2(leaves)))
+    out.update({
+        "reps": reps,
+        "f32": {"ms_per_pass": round(f32_ms, 2),
+                "psum_payload_bytes": f32_payload,
+                "psum_payload_bytes_per_tree":
+                    int(f32_payload * levels_per_tree)},
+        "quant": {"ms_per_pass": round(quant_ms, 2),
+                  "psum_payload_bytes": quant_payload,
+                  "psum_payload_bytes_per_tree":
+                      int(quant_payload * levels_per_tree),
+                  "psum_narrowed_int16":
+                      H.quant_psum_narrow(rows, quant_bins),
+                  "g_scale": float(gs), "h_scale": float(hs)},
+        "payload_shrink": round(f32_payload / max(quant_payload, 1), 3),
+        "speedup_vs_f32": round(f32_ms / max(quant_ms, 1e-9), 3),
+        "rescale_abs_err": {"grad": round(float(g_err), 6),
+                            "hess": round(float(h_err), 6),
+                            "tol": round(tol, 6),
+                            "ok": bool(g_err <= tol and h_err <= tol)},
+    })
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--max-bin", type=int, default=63)
+    ap.add_argument("--quant-bins", type=int, default=4)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+    out = run_probe(args.rows, args.features, args.max_bin, args.quant_bins,
+                    args.leaves, args.reps)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
