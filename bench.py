@@ -53,6 +53,12 @@ BENCH_FORCE_CPU=1 (skip TPU entirely), BENCH_PROFILE=1 (jax.profiler trace
 to ./bench_trace), BENCH_TOTAL_BUDGET (s, default 6600),
 BENCH_CPU_ROWS / BENCH_CPU_TREES, BENCH_SMOKE_ROWS / BENCH_SMOKE_TREES,
 BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1, BENCH_SKIP_HIST_PROBE=1.
+Memory/caching: LGBM_TPU_TILE_ROWS / LGBM_TPU_HBM_BYTES steer the HBM
+budget planner (ops/planner.py; the >=10M-row stage is gated on its
+feasibility verdict and degrades to smaller row tiles instead of
+crashing — the decision is journaled as the "hbm_plan" stage);
+LGBM_TPU_COMPILE_CACHE=<dir> wires the persistent XLA compile cache
+(cold-vs-warm compile_seconds recorded per stage under "compile_cache").
 
 Stage journal: every completed worker stage persists its result to
 BENCH_JOURNAL (default ./bench_journal.json, atomic writes) under a
@@ -263,15 +269,35 @@ def peak_flops_for(device):
 
 
 def device_memory_stats():
+    """peak/limit HBM from the device allocator; planner fallback.
+
+    r5 shipped ``peak_hbm_bytes``/``hbm_limit_bytes`` as constant 0 —
+    the axon plugin returns no ``memory_stats()``.  Try every key the
+    PJRT allocators use, and when the device reports nothing, fall back
+    to the planner's limit model (``hbm_limit_source`` says which)."""
     import jax
+    out = {}
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
-        return {
-            "peak_hbm_bytes": int(stats.get("peak_bytes_in_use", 0)),
-            "hbm_limit_bytes": int(stats.get("bytes_limit", 0)),
-        }
     except Exception:
-        return {}
+        stats = {}
+    peak = 0
+    for k in ("peak_bytes_in_use", "peak_bytes", "bytes_in_use"):
+        if int(stats.get(k, 0)) > 0:
+            peak = int(stats[k])
+            break
+    limit = int(stats.get("bytes_limit", 0) or stats.get("bytes_limit_in_use", 0))
+    if peak:
+        out["peak_hbm_bytes"] = peak
+    if limit:
+        out["hbm_limit_bytes"] = limit
+        out["hbm_limit_source"] = "memory_stats"
+    else:
+        from lightgbm_tpu.ops.planner import hbm_limit_bytes
+        lim, src = hbm_limit_bytes()
+        out["hbm_limit_bytes"] = lim
+        out["hbm_limit_source"] = src
+    return out
 
 
 def kernel_probe(n_rows=1_000_000, f=F, max_bin=MAX_BIN, reps=3):
@@ -339,6 +365,34 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
 
     device = jax.devices()[0]
     platform = device.platform
+
+    # HBM budget verdict BEFORE any allocation: the >=10M-row stage died
+    # in compile in r5 (157.7 GB requested vs 17.2 GB HBM); the planner
+    # now degrades to a smaller row tile instead, and the decision is
+    # journaled with the stage result.  An infeasible verdict aborts the
+    # stage up front (cheap, retriable) rather than wedging the chip.
+    from lightgbm_tpu.ops.planner import plan_histograms
+    plan = plan_histograms(rows=n, features=F, num_bins=max_bin + 1,
+                           num_leaves=leaves)
+    if not plan.feasible:
+        raise RuntimeError(
+            f"HBM planner: {n} rows infeasible on this device even at "
+            f"tile_rows={plan.tile_rows} (predicted "
+            f"{plan.predicted_peak_bytes / 1e9:.1f} GB vs budget "
+            f"{plan.budget_bytes / 1e9:.1f} GB)")
+    if plan.degraded:
+        log(f"hbm planner degraded to tile_rows={plan.tile_rows} "
+            f"(untiled predicted {plan.untiled_peak_bytes / 1e9:.1f} GB "
+            f"> budget {plan.budget_bytes / 1e9:.1f} GB)")
+
+    from lightgbm_tpu.utils.platform import (compile_cache_entries,
+                                             enable_compile_cache)
+    # the reported dir must be the one the entries are counted in: with
+    # LGBM_TPU_COMPILE_CACHE unset, the worker's JAX_COMPILATION_CACHE_DIR
+    # default is still an active cache
+    cache_dir = (enable_compile_cache()
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR") or None)
+    cache_before = compile_cache_entries(cache_dir)
 
     X, y = make_higgs_like(n, F)
     params = {
@@ -431,14 +485,29 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
         "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
         "platform": platform,
         "device_kind": getattr(device, "device_kind", ""),
+        # sec_per_tree is TRAIN-ONLY (clock starts after iteration 1);
+        # _total folds the one-time compile back in — r5's 7.77 s/tree
+        # headline was the total being read as the train rate
         "sec_per_tree": round(sec_per_tree, 4),
+        "sec_per_tree_train": round(sec_per_tree, 4),
+        "sec_per_tree_total": round((elapsed + compile_seconds) / trees, 4),
         "iters_per_sec": round(1.0 / max(sec_per_tree, 1e-9), 3),
         "compile_seconds": round(compile_seconds, 2),
+        "compile_cache": {
+            "dir": cache_dir,
+            "entries_before": cache_before,
+            "entries_after": compile_cache_entries(cache_dir),
+            "warm_start": bool(cache_dir) and cache_before > 0,
+        },
         "bin_seconds": round(bin_seconds, 2),
         "holdout_auc": round(float(auc), 5),
         "rows": n,
         "trees": trees,
+        "hbm_plan": plan.summary(),
     }
+    train_plan = getattr(booster.boosting, "hist_plan", None)
+    if train_plan is not None:
+        result["hbm_plan"] = train_plan.summary()
     if chunk_result is not None:
         result.update(chunk_result)
     peak = peak_flops_for(device)
@@ -734,8 +803,9 @@ def tpu_worker():
     if a later stage wedges or the process dies.  Exit codes: 0 full run
     done, 3 backend init failed, 4 init ok but a later stage failed.
     """
-    from lightgbm_tpu.utils.platform import _cache_dir
+    from lightgbm_tpu.utils.platform import _cache_dir, enable_compile_cache
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
+    enable_compile_cache()          # LGBM_TPU_COMPILE_CACHE=<dir> honored
     t0 = time.time()
     try:
         import jax
@@ -788,6 +858,18 @@ def tpu_worker():
             return 4
 
     n_full = int(os.environ.get("BENCH_WORKER_ROWS", N))
+
+    # HBM budget verdict for the >=10M-row stage, banked as its own stage
+    # so the planner's tile/feasibility decision is journaled even if the
+    # run itself later dies.  The stage is restored (not skipped): an
+    # infeasible verdict aborts cheaply; a degraded one RUNS with the
+    # smaller tile instead of crashing in compile as in r5.
+    def _plan():
+        from lightgbm_tpu.ops.planner import plan_histograms
+        return plan_histograms(rows=n_full, features=F,
+                               num_bins=MAX_BIN + 1,
+                               num_leaves=LEAVES).summary()
+    run_stage("hbm_plan", _plan, key=f"hbm_plan@{n_full}")
 
     def _full():
         r = run_bench(n_full, TREES, LEAVES, MAX_BIN,
@@ -937,6 +1019,10 @@ def _annotate(line, tpu_stages, cpu_result):
     if hp:
         line["hist_probe"] = {k: v for k, v in hp.items()
                               if k not in ("stage", "elapsed")}
+    planl = collect_ok(tpu_stages, "hbm_plan")
+    if planl and "hbm_plan" not in line:
+        line["hbm_plan"] = {k: v for k, v in planl.items()
+                            if k not in ("stage", "elapsed")}
     init = collect_ok(tpu_stages, "init")
     if init:
         line["backend_init_seconds"] = init.get("elapsed")

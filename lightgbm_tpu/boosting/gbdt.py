@@ -601,6 +601,39 @@ class GBDT:
             quant_bins=cc.num_grad_quant_bins,
             quant_renew=cc.quant_train_renew_leaf,
         )
+        # HBM budget plan (ops/planner.py): model per-variant peak bytes
+        # for THIS shape against the device limit and pick {tile_rows,
+        # record-arena hoisting, psum narrowing} at trace time.  Planned
+        # with PER-SHARD rows so the same verdict governs serial and
+        # sharded training (the r5 lesson: an unplanned [n*F, 3] arena
+        # requested 157.7 GB against 17.2 GB of HBM).
+        from ..ops.planner import apply_plan
+        shard_rows = self._n_pad
+        if self._mesh is not None and self._data_axis is not None:
+            shard_rows = self._n_pad // max(nmach, 1)
+        shard_feats = int(self.binned.shape[0])
+        if self._feature_axis is not None:
+            # the sharded array keeps its GLOBAL shape; each device's
+            # kernels see only its feature slice
+            shard_feats //= max(int(self._mesh.shape[self._feature_axis]), 1)
+        self.grower_cfg, self.hist_plan = apply_plan(
+            self.grower_cfg, shard_rows, shard_feats)
+        if not self.hist_plan.feasible:
+            log_warning(
+                "HBM planner: predicted peak "
+                f"{self.hist_plan.predicted_peak_bytes / 1e9:.2f} GB "
+                f"exceeds the {self.hist_plan.budget_bytes / 1e9:.2f} GB "
+                f"budget even at tile_rows={self.hist_plan.tile_rows}; "
+                "training may OOM (set LGBM_TPU_HBM_BYTES / "
+                "LGBM_TPU_TILE_ROWS to override)")
+        elif self.hist_plan.degraded:
+            log_info(
+                "HBM planner: untiled peak "
+                f"{self.hist_plan.untiled_peak_bytes / 1e9:.2f} GB > "
+                f"budget {self.hist_plan.budget_bytes / 1e9:.2f} GB "
+                f"({self.hist_plan.limit_source}); streaming row tiles of "
+                f"{self.hist_plan.tile_rows} (predicted peak "
+                f"{self.hist_plan.predicted_peak_bytes / 1e9:.2f} GB)")
         # cross-tree CEGB device state (reference keeps it in the learner),
         # indexed by the grower's GLOBAL feature id (device slots under
         # feature sharding)

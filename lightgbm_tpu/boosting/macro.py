@@ -27,6 +27,14 @@ drain).  Chunked training is bit-identical to per-iteration training —
 the scanned program composes the same ``iter_body`` — which
 tests/test_macro.py asserts byte-for-byte on saved model text.
 
+Memory: the chunk program composes ``iter_body`` over the booster's
+``grower_cfg``, so the HBM budget plan (ops/planner.py ``tile_rows`` /
+``hist_pack``, chosen at ``_build_jit_fns`` time with per-shard rows)
+governs the fused program exactly as it governs per-iteration training —
+histogram transients inside the scan stay O(tile), and tiled chunked
+training is byte-identical to untiled per-iteration training
+(tests/test_macro.py tiled parity rows).
+
 Env gate: ``LGBM_TPU_CHUNK`` — unset/"on"/"auto" = default cap (32),
 "0"/"off" disables, a positive integer sets the cap (1 disables fusion).
 The chunk SCHEDULER (engine.py) picks the distance to the next boundary

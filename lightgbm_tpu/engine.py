@@ -41,7 +41,13 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     the captured state so the continued run produces a model
     BIT-IDENTICAL to the uninterrupted one; corrupt newest bundles are
     skipped in favor of the previous verified one (docs/RESILIENCE.md).
+
+    ``LGBM_TPU_COMPILE_CACHE=<dir>`` enables the persistent XLA
+    compilation cache at engine init (docs/PERF.md): repeated trainings
+    of same-shaped programs skip XLA entirely on the warm path.
     """
+    from .utils.platform import enable_compile_cache
+    enable_compile_cache()
     params = dict(params)
     cfg = Config.from_params(params)
     if "num_iterations" in {Config.canonical_key(k) for k in params}:
@@ -391,6 +397,8 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
        eval_train_metric: bool = False,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
     """reference: engine.py:375."""
+    from .utils.platform import enable_compile_cache
+    enable_compile_cache()
     params = dict(params)
     if fobj is not None:
         # custom objective: no built-in objective, hence no default metric

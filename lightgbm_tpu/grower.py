@@ -232,6 +232,18 @@ class GrowerConfig(NamedTuple):
     quant_renew: bool = False      # quant_train_renew_leaf: re-fit leaf
                                    # outputs from TRUE f32 sums via the
                                    # ops/renew.py seam
+    tile_rows: int = 0             # >0: stream every histogram pass
+                                   # through row tiles of this size —
+                                   # peak transient HBM O(tile), not
+                                   # O(n*F).  Chosen by the ops/planner
+                                   # HBM budget planner (LGBM_TPU_
+                                   # TILE_ROWS overrides); 0 = untiled
+    hist_pack: bool = True         # hoist the whole-dataset fused u32
+                                   # record arena (pack_cols_u32) for
+                                   # the sorted-arena gather; the
+                                   # planner clears it when tiling is
+                                   # active (records are then assembled
+                                   # per tile inside the kernel loops)
 
 
 def _psum(x, axis_name):
@@ -407,6 +419,9 @@ def grow_tree(
     # upstream of the search — cache, psum, sibling subtraction — stays
     # exact integer arithmetic
     quant = cfg.quant
+    # planner-selected row tiling (ops/planner.py): every histogram pass
+    # below streams tiles of this many rows; 0/None = untiled
+    tile = cfg.tile_rows if cfg.tile_rows > 0 else None
     if quant:
         if quant_vals is None:
             raise ValueError("cfg.quant requires quant_vals="
@@ -417,14 +432,15 @@ def grow_tree(
         def hist_pass(w):
             return build_histogram_int(binned_t, q_grad, q_hess, w > 0, Bg,
                                        method=cfg.hist_method,
-                                       levels=q_levels)
+                                       levels=q_levels, tile_rows=tile)
 
         def split_conv(ghist, cnt, cnt_factor=None):
             return quant_rescale_hist(ghist, g_scale, h_scale, cnt,
                                       cnt_factor=cnt_factor)
     else:
         hist_fn = functools.partial(build_histogram, num_bins=Bg,
-                                    method=cfg.hist_method)
+                                    method=cfg.hist_method,
+                                    tile_rows=tile)
 
         def hist_pass(w):
             return hist_fn(binned_t, grad, hess, w)
@@ -1059,12 +1075,14 @@ def grow_tree(
             if quant:
                 small_hist = hist_sync(compacted_histogram_int(
                     binned_t, q_grad, q_hess, row_mask, small_member, Bg,
-                    caps, method=cfg.hist_method, levels=q_levels))
+                    caps, method=cfg.hist_method, levels=q_levels,
+                    tile_rows=tile))
             else:
                 small_hist = hist_sync(
                     compacted_histogram(binned_t, grad, hess, row_mask,
                                         small_member, Bg, caps,
-                                        method=cfg.hist_method))
+                                        method=cfg.hist_method,
+                                        tile_rows=tile))
         else:
             small_hist = hist_sync(hist_pass(row_mask * small_member))
         large_hist = parent_hist - small_hist

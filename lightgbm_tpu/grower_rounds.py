@@ -117,6 +117,9 @@ def grow_tree_rounds(
     # happens once per leaf search (quant_rescale_hist)
     quant = cfg.quant
     rows_global = n * max(cfg.num_machines, 1)
+    # planner-selected row tiling (ops/planner.py): all histogram passes
+    # stream tiles of this many rows; 0/None = untiled
+    tile = cfg.tile_rows if cfg.tile_rows > 0 else None
     if quant:
         if quant_vals is None:
             raise ValueError("cfg.quant requires quant_vals="
@@ -128,7 +131,8 @@ def grow_tree_rounds(
             return quant_rescale_hist(ghist, g_scale, h_scale, cnt)
     else:
         hist_fn = functools.partial(build_histogram, num_bins=Bg,
-                                    method=cfg.hist_method)
+                                    method=cfg.hist_method,
+                                    tile_rows=tile)
 
         def split_conv(ghist, cnt):
             return ghist
@@ -137,8 +141,10 @@ def grow_tree_rounds(
     # only: gather cost scales with element count — pack_cols_u32; the
     # quantized record fuses (gq, hq, member) into ONE word, Wb+1 vs
     # Wb+3).  LGBM_TPU_PACK=0 falls back to the separate gathers
-    # (compile-cost bisect hook)
-    use_pack = (use_sorted_seghist()
+    # (compile-cost bisect hook).  Under planner tiling the whole-dataset
+    # record arena is NOT hoisted (cfg.hist_pack cleared / tile set):
+    # the kernels assemble records per tile inside their loops instead.
+    use_pack = (use_sorted_seghist() and cfg.hist_pack and tile is None
                 and os.environ.get("LGBM_TPU_PACK") != "0")
     if not use_pack:
         packed = None
@@ -234,7 +240,8 @@ def grow_tree_rounds(
         member = row_mask > 0
         root_hist = psum_quant_hist(
             build_histogram_int(binned_t, q_grad, q_hess, member, Bg,
-                                method=cfg.hist_method, levels=q_levels),
+                                method=cfg.hist_method, levels=q_levels,
+                                tile_rows=tile),
             axis_name, rows_global, cfg.quant_bins)
         root_sg = _psum(jnp.sum(jnp.where(member, q_grad, 0).astype(
             jnp.int32)), axis_name).astype(jnp.float32) * g_scale
@@ -509,12 +516,14 @@ def grow_tree_rounds(
         if quant:
             seg = psum_quant_hist(compacted_segment_histogram_int(
                 binned_t, q_grad, q_hess, row_mask, slot, KCAP, Bg, caps,
-                num_live=k, packed=packed, levels=q_levels),
+                num_live=k, packed=packed, levels=q_levels,
+                tile_rows=tile),
                 axis_name, rows_global, cfg.quant_bins)
         else:
             seg = _psum(compacted_segment_histogram(
                 binned_t, grad, hess, row_mask, slot, KCAP, Bg, caps,
-                f32_vals=seg_f32, num_live=k, packed=packed), axis_name)
+                f32_vals=seg_f32, num_live=k, packed=packed,
+                tile_rows=tile), axis_name)
 
         # -- candidate children's best splits, BEFORE committing anything:
         # per-leaf candidates are independent, so lane i's results are

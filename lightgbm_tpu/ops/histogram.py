@@ -99,16 +99,43 @@ def _vals_t(grad, hess, mask):
     return jnp.stack([grad, hess, jnp.ones_like(grad)]) * mask[None, :]
 
 
+def resolve_tile_rows(tile_rows, n: int):
+    """Normalize a ``tile_rows`` request: None/0/>=n means untiled."""
+    if tile_rows is None or tile_rows <= 0 or tile_rows >= n:
+        return None
+    return int(tile_rows)
+
+
+def _tile_block(block_rows: int, tile_rows, lane: int = 128) -> int:
+    """Streaming block size under a tile budget.
+
+    The matmul-family kernels were ALWAYS streamed (a ``lax.scan`` over
+    ``block_rows``-row blocks with an O(block) one-hot transient), so for
+    them ``tile_rows`` simply CAPS the block: peak transient bytes track
+    min(block, tile).  Rounded to the lane width so the one-hot stays
+    tile-aligned.  TILE-MAJOR ORDER PIN: blocks accumulate into one shared
+    f32 accumulator in ascending row order at every block size, so any
+    ``tile_rows >= block_rows`` is bit-identical to untiled (the block
+    partition is unchanged); a smaller tile refines the partition — still
+    deterministic, exact for the int family (associative), and within
+    f32 reassociation for the bf16/f32 matmuls."""
+    if tile_rows is None:
+        return block_rows
+    return max(lane, min(block_rows, _pad_rows(tile_rows, lane)))
+
+
 def histogram_matmul(
     binned_t: jax.Array,  # [F, n] uint8/uint16/int32 (feature-major)
     vals_t: jax.Array,    # [3, n] f32 rows already masked: (g, h, 1)*mask
     num_bins: int,        # padded bin axis B (static)
     block_rows: int = _DEFAULT_BLOCK_ROWS,
     onehot_dtype=jnp.bfloat16,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Histogram via one-hot matmul over row blocks. Returns [3, F, B] f32."""
     F, n = binned_t.shape
     B = num_bins
+    block_rows = _tile_block(block_rows, resolve_tile_rows(tile_rows, n))
     nb = max(1, _pad_rows(n, block_rows) // block_rows)
     n_pad = nb * block_rows
     if n_pad != n:
@@ -136,10 +163,11 @@ def histogram_matmul(
 def histogram_matmul_f32(
     binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
     block_rows: int = _DEFAULT_BLOCK_ROWS,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Like histogram_matmul but f32 one-hot (exact grads; ~2x slower MXU)."""
     return histogram_matmul(binned_t, vals_t, num_bins, block_rows,
-                            onehot_dtype=jnp.float32)
+                            onehot_dtype=jnp.float32, tile_rows=tile_rows)
 
 
 def histogram_pallas(
@@ -227,20 +255,45 @@ def histogram_pallas(
 
 def histogram_scatter(
     binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Scatter-add histogram (XLA scatter). Reference semantics check path
-    (CPU-oriented: the [n, F, 3] update buffer lane-pads on TPU)."""
+    (CPU-oriented: the [n, F, 3] update buffer lane-pads on TPU).
+
+    ``tile_rows`` streams row tiles through a ``fori_loop``: the update
+    buffer shrinks from [n, F, 3] to [tile, F, 3] — THE r5 OOM class
+    (f32[n*F, 3] lane-padded 42x at 11M rows).  Tiles accumulate into one
+    shared histogram in ascending row order, so per-bin adds happen in
+    the same sequence as the untiled scatter: tiled == untiled
+    bit-identical (padded tail rows carry +0 values into bin 0)."""
     F, n = binned_t.shape
     B = num_bins
-    binned = binned_t.T                                    # [n, F]
-    vals = vals_t.T                                        # [n, 3]
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
-    flat_idx = binned.astype(jnp.int32) + offsets          # [n, F]
-    hist = jnp.zeros((F * B, 3), dtype=jnp.float32)
-    # vals broadcast across features: updates [n, F, 3]
-    updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
-    hist = hist.at[flat_idx.reshape(-1)].add(updates.reshape(-1, 3))
-    return hist.reshape(F, B, 3).transpose(2, 0, 1)        # [3, F, B]
+    T = resolve_tile_rows(tile_rows, n)
+    if T is None:
+        binned = binned_t.T                                # [n, F]
+        vals = vals_t.T                                    # [n, 3]
+        flat_idx = binned.astype(jnp.int32) + offsets      # [n, F]
+        hist = jnp.zeros((F * B, 3), dtype=jnp.float32)
+        # vals broadcast across features: updates [n, F, 3]
+        updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
+        hist = hist.at[flat_idx.reshape(-1)].add(updates.reshape(-1, 3))
+        return hist.reshape(F, B, 3).transpose(2, 0, 1)    # [3, F, B]
+    nt = _pad_rows(n, T) // T
+    n_pad = nt * T
+    bt = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+    vt = jnp.pad(vals_t, ((0, 0), (0, n_pad - n)))
+
+    def body(t, hist):
+        b = lax.dynamic_slice(bt, (0, t * T), (F, T)).T    # [T, F]
+        v = lax.dynamic_slice(vt, (0, t * T), (3, T)).T    # [T, 3]
+        flat = b.astype(jnp.int32) + offsets               # [T, F]
+        upd = jnp.broadcast_to(v[:, None, :], (T, F, 3))
+        return hist.at[flat.reshape(-1)].add(upd.reshape(-1, 3))
+
+    hist = lax.fori_loop(0, nt, body,
+                         jnp.zeros((F * B, 3), dtype=jnp.float32))
+    return hist.reshape(F, B, 3).transpose(2, 0, 1)
 
 
 def build_histogram(
@@ -251,20 +304,26 @@ def build_histogram(
     num_bins: int,
     method: str = "auto",
     block_rows: int = _DEFAULT_BLOCK_ROWS,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Masked histogram [3, F, B] = sum over rows with mask of (g, h, 1).
 
     ``mask`` is f32 and may carry bagging weights; leaf membership is encoded
-    by zeroing non-member rows.
+    by zeroing non-member rows.  ``tile_rows`` streams the pass through
+    row tiles so peak transient HBM is O(tile), not O(n) (planner-selected;
+    see ops/planner.py).
     """
     vals_t = _vals_t(grad, hess, mask)
     method = resolve_hist_method(method)
     if method == "matmul":
-        return histogram_matmul(binned_t, vals_t, num_bins, block_rows)
+        return histogram_matmul(binned_t, vals_t, num_bins, block_rows,
+                                tile_rows=tile_rows)
     if method == "matmul_f32":
-        return histogram_matmul_f32(binned_t, vals_t, num_bins, block_rows)
+        return histogram_matmul_f32(binned_t, vals_t, num_bins, block_rows,
+                                    tile_rows=tile_rows)
     if method == "scatter":
-        return histogram_scatter(binned_t, vals_t, num_bins)
+        return histogram_scatter(binned_t, vals_t, num_bins,
+                                 tile_rows=tile_rows)
     if method == "pallas":
         return histogram_pallas(binned_t, vals_t, num_bins)
     raise ValueError(f"unknown histogram method {method!r}")
@@ -381,6 +440,7 @@ def compacted_histogram(
     num_bins: int,
     caps: list,              # static descending capacities from capacity_schedule
     method: str = "auto",
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Masked histogram restricted to `member` rows via gather compaction.
 
@@ -403,12 +463,14 @@ def compacted_histogram(
             w = jnp.where(valid, jnp.take(weights, idxc), 0.0)
             g = jnp.take(grad, idxc)
             h = jnp.take(hess, idxc)
-            return build_histogram(cols, g, h, w, num_bins, method=method)
+            return build_histogram(cols, g, h, w, num_bins, method=method,
+                                   tile_rows=tile_rows)
         return run
 
     if len(caps) == 1:
         return build_histogram(binned_t, grad, hess,
-                               weights * member, num_bins, method=method)
+                               weights * member, num_bins, method=method,
+                               tile_rows=tile_rows)
     caps_arr = jnp.asarray(caps, jnp.int32)
     # smallest capacity >= count (caps[0] >= n covers everything)
     bucket = jnp.sum(caps_arr >= count) - 1
@@ -423,6 +485,7 @@ def segment_histogram(
     slot: jax.Array,         # [n] i32 in [0, num_slots]; num_slots = dropped
     num_slots: int,
     num_bins: int,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Per-slot masked histogram: [S, 3, F, B] where row r contributes its
     (g, h, 1)*w to slot[r]'s histogram.  Rows with slot == num_slots are
@@ -435,18 +498,43 @@ def segment_histogram(
     Scatter-add formulation (CPU semantics-reference path): the work is
     O(n*F) independent of S, unlike a one-hot matmul over (slot, bin) which
     would cost O(n*F*B*S).
+
+    ``tile_rows`` streams the [n, F, 3] update buffer — the EXACT
+    f32[n*F, 3] allocation that OOM'd the r5 >=10M-row stage — through
+    [tile, F, 3] pieces; tiles scatter sequentially in ascending row
+    order, so tiled == untiled bit-identical (tail rows pad into the
+    dummy slot with +0 values).
     """
     F, n = binned_t.shape
     B = num_bins
     S = num_slots
-    binned = binned_t.T
-    vals = _vals_t(grad, hess, weights).T                  # [n, 3]
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
-    flat = (slot[:, None].astype(jnp.int32) * (F * B)
-            + binned.astype(jnp.int32) + offsets)          # [n, F]
-    hist = jnp.zeros(((S + 1) * F * B, 3), dtype=jnp.float32)
-    updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
-    hist = hist.at[flat.reshape(-1)].add(updates.reshape(-1, 3))
+    T = resolve_tile_rows(tile_rows, n)
+    if T is None:
+        binned = binned_t.T
+        vals = _vals_t(grad, hess, weights).T              # [n, 3]
+        flat = (slot[:, None].astype(jnp.int32) * (F * B)
+                + binned.astype(jnp.int32) + offsets)      # [n, F]
+        hist = jnp.zeros(((S + 1) * F * B, 3), dtype=jnp.float32)
+        updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
+        hist = hist.at[flat.reshape(-1)].add(updates.reshape(-1, 3))
+        return hist.reshape(S + 1, F, B, 3)[:S].transpose(0, 3, 1, 2)
+    nt = _pad_rows(n, T) // T
+    n_pad = nt * T
+    bt = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+    vt = jnp.pad(_vals_t(grad, hess, weights), ((0, 0), (0, n_pad - n)))
+    st = jnp.pad(slot.astype(jnp.int32), (0, n_pad - n), constant_values=S)
+
+    def body(t, hist):
+        b = lax.dynamic_slice(bt, (0, t * T), (F, T)).T    # [T, F]
+        v = lax.dynamic_slice(vt, (0, t * T), (3, T)).T    # [T, 3]
+        s = lax.dynamic_slice(st, (t * T,), (T,))
+        flat = (s[:, None] * (F * B) + b.astype(jnp.int32) + offsets)
+        upd = jnp.broadcast_to(v[:, None, :], (T, F, 3))
+        return hist.at[flat.reshape(-1)].add(upd.reshape(-1, 3))
+
+    hist = lax.fori_loop(0, nt, body,
+                         jnp.zeros(((S + 1) * F * B, 3), jnp.float32))
     return hist.reshape(S + 1, F, B, 3)[:S].transpose(0, 3, 1, 2)
 
 
@@ -626,6 +714,7 @@ def segment_histogram_sorted(
     caps: Optional[list] = None,   # static descending arena capacities
     packed: Optional[tuple] = None,   # (words_t [Wb+3, n] u32, Wb) from
                                       # pack_cols_u32 — hoisted per tree
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """TPU-native segment histogram: sort-by-slot + block-aligned matmuls.
 
@@ -657,6 +746,26 @@ def segment_histogram_sorted(
     [S, 3, F, B] f32.  reference analogue: ordered-gradient per-leaf
     histograms (src/io/dataset.cpp:1318-1333) built from a DataPartition
     that keeps leaves contiguous (src/treelearner/data_partition.hpp).
+
+    Accumulation-order pin (tiling discipline): per-block partials fold
+    into their slot INSIDE the block scan, in ascending block order — the
+    same order whether the arena records were gathered up front (untiled:
+    one big [W, cap] gather, fastest dispatch when it fits HBM) or
+    per block inside the loop (``tile_rows`` set: O(block) transients, no
+    whole-arena record materialization — the planner's O(tile) mode).
+    Both modes therefore produce BIT-IDENTICAL histograms; the sort
+    (n u32 words) is the only O(n) device state either way.
+
+    DELIBERATE f32 reassociation vs the pre-tiling code: the old fold
+    was one HIGHEST-precision ``slot_onehot @ parts`` dot; pinning the
+    in-scan order (required for tiled == untiled parity) reassociates
+    the per-slot f32 sums, so multi-block slots can differ from the
+    previous release in the last bit.  Same class of difference as the
+    reference's CPU-vs-GPU histograms (module docstring of
+    grower_rounds.py); the int kernel's fold is associative and exact
+    either way.  CPU defaults (scatter) and the golden guard are
+    untouched — this kernel only runs on accelerators or when
+    LGBM_TPU_SEGHIST=sorted forces it.
     """
     F, n = binned_t.shape
     B = num_bins
@@ -728,48 +837,85 @@ def segment_histogram_sorted(
                                precision=prec,
                                preferred_element_type=jnp.float32)
 
-            if packed is not None and packed[0] is not None:
-                # ONE fused word gather (~3x fewer elements; see
-                # pack_cols_u32) then split the record back apart
-                words_t, Wb = packed
-                rec = jnp.take(words_t, src, axis=1)    # [Wb+3, NBC] u32
-                recb = rec.reshape(Wb + 3, NB, C).transpose(1, 0, 2)
-                vmask = valid.reshape(NB, 1, C)
+            use_packed = packed is not None and packed[0] is not None
 
-                def body(_, xs):
-                    blk_rec, vm = xs
-                    bw = blk_rec[:Wb]                   # [Wb, C] u32
-                    rows = jnp.concatenate(
-                        [((bw >> (8 * j)) & 0xFF) for j in range(4)],
-                        axis=0).reshape(4, Wb, C).transpose(
-                            1, 0, 2).reshape(Wb * 4, C)[:F]   # [F, C]
-                    vals = lax.bitcast_convert_type(blk_rec[Wb:],
-                                                    jnp.float32)
-                    vals = jnp.where(vm, vals, 0.0)     # [3, C]
-                    return _, block_partial(rows.astype(jnp.int32), vals)
+            def part_from_packed(blk_rec, vm):
+                """[Wb+3, C] u32 fused record block -> [3, F*B] partial."""
+                Wb = packed[1]
+                bw = blk_rec[:Wb]                       # [Wb, C] u32
+                rows = jnp.concatenate(
+                    [((bw >> (8 * j)) & 0xFF) for j in range(4)],
+                    axis=0).reshape(4, Wb, C).transpose(
+                        1, 0, 2).reshape(Wb * 4, C)[:F]   # [F, C]
+                vals = lax.bitcast_convert_type(blk_rec[Wb:], jnp.float32)
+                vals = jnp.where(vm, vals, 0.0)         # [3, C]
+                return block_partial(rows.astype(jnp.int32), vals)
 
-                _, parts = lax.scan(body, None, (recb, vmask))
+            def part_from_raw(cols, g, h, w, vm):
+                vt = (jnp.stack([g, h, jnp.ones_like(g)])
+                      * jnp.where(vm, w, 0.0)[None, :])
+                return block_partial(cols, vt)
+
+            # the block -> slot fold happens INSIDE the scan (ascending
+            # block order, one shared f32 accumulator): the pinned order
+            # that makes the hoisted and in-loop gather modes — and hence
+            # tiled vs untiled — bit-identical
+            acc0 = jnp.zeros((S + 1, 3 * F * B), jnp.float32)
+            j_arange = jnp.arange(NB, dtype=jnp.int32)
+
+            if resolve_tile_rows(tile_rows, n) is None:
+                # untiled: ONE whole-arena gather up front (fastest
+                # dispatch; O(cap) transient the planner must afford)
+                if use_packed:
+                    words_t, Wb = packed
+                    rec = jnp.take(words_t, src, axis=1)  # [Wb+3, NBC] u32
+                    recb = rec.reshape(Wb + 3, NB, C).transpose(1, 0, 2)
+                    vmask = valid.reshape(NB, 1, C)
+
+                    def body(acc, xs):
+                        j, blk_rec, vm = xs
+                        return acc.at[blk_slot[j]].add(
+                            part_from_packed(blk_rec, vm).reshape(-1)), None
+
+                    acc, _ = lax.scan(body, acc0, (j_arange, recb, vmask))
+                else:
+                    cols = jnp.take(binned_t, src, axis=1)  # [F, NBC]
+                    w = jnp.take(weights, src)
+                    g = jnp.take(grad, src)
+                    h = jnp.take(hess, src)
+                    colsb = cols.reshape(F, NB, C).transpose(1, 0, 2)
+                    gb = g.reshape(NB, C)
+                    hb = h.reshape(NB, C)
+                    wb = w.reshape(NB, C)
+                    vmask = valid.reshape(NB, C)
+
+                    def body(acc, xs):
+                        j, b, gg, hh, ww, vm = xs
+                        return acc.at[blk_slot[j]].add(
+                            part_from_raw(b, gg, hh, ww, vm).reshape(-1)), \
+                            None
+
+                    acc, _ = lax.scan(body, acc0,
+                                      (j_arange, colsb, gb, hb, wb, vmask))
             else:
-                cols = jnp.take(binned_t, src, axis=1)  # [F, NBC]
-                w = jnp.where(valid, jnp.take(weights, src), 0.0)
-                g = jnp.take(grad, src)
-                h = jnp.take(hess, src)
-                vt = (jnp.stack([g, h, jnp.ones_like(g)]) * w[None, :])
-                colsb = cols.reshape(F, NB, C).transpose(1, 0, 2)
-                vtb = vt.reshape(3, NB, C).transpose(1, 0, 2)
+                # tiled: records are gathered/assembled PER BLOCK inside
+                # the loop — no whole-arena (or whole-dataset) record
+                # materialization; peak transient is O(block)
+                def body(acc, j):
+                    sb = lax.dynamic_slice(src, (j * C,), (C,))
+                    vm = lax.dynamic_slice(valid, (j * C,), (C,))
+                    if use_packed:
+                        rec = jnp.take(packed[0], sb, axis=1)  # [Wb+3, C]
+                        part = part_from_packed(rec, vm[None, :])
+                    else:
+                        cols = jnp.take(binned_t, sb, axis=1)  # [F, C]
+                        part = part_from_raw(cols, jnp.take(grad, sb),
+                                             jnp.take(hess, sb),
+                                             jnp.take(weights, sb), vm)
+                    return acc.at[blk_slot[j]].add(part.reshape(-1)), None
 
-                def body(_, xs):
-                    b, v = xs
-                    return _, block_partial(b, v)
-
-                _, parts = lax.scan(body, None, (colsb, vtb))
-
-            # [NB, 3, F*B] -> fold blocks into slots
-            slot_onehot = (jnp.arange(S, dtype=jnp.int32)[:, None]
-                           == blk_slot[None, :]).astype(jnp.float32)
-            hist = lax.dot(slot_onehot, parts.reshape(NB, 3 * F * B),
-                           precision=lax.Precision.HIGHEST)
-            return hist.reshape(S, 3, F, B)
+                acc, _ = lax.scan(body, acc0, j_arange)
+            return acc[:S].reshape(S, 3, F, B)
         return run
 
     if len(caps) == 1:
@@ -796,6 +942,7 @@ def segment_histogram_expanded(
     live_cap: int = _EXPAND_SLOTS,
     block_rows: int = _DEFAULT_BLOCK_ROWS,
     f32_vals: bool = False,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Histograms of slots [0, live_cap) in ONE streamed full-matrix pass.
 
@@ -813,6 +960,7 @@ def segment_histogram_expanded(
     F, n = binned_t.shape
     B = num_bins
     SE = live_cap
+    block_rows = _tile_block(block_rows, resolve_tile_rows(tile_rows, n))
     nb = max(1, _pad_rows(n, block_rows) // block_rows)
     n_pad = nb * block_rows
     vals_t = _vals_t(grad, hess, weights)
@@ -857,6 +1005,7 @@ def compacted_segment_histogram(
     f32_vals: bool = False,
     num_live: Optional[jax.Array] = None,   # traced count of live slots
     packed: Optional[tuple] = None,         # pack_cols_u32 output, hoisted
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Segment histogram over only the rows with a real slot, with the
     work bounded by the smallest static capacity that fits (see
@@ -882,7 +1031,8 @@ def compacted_segment_histogram(
         def arena_path(_):
             return segment_histogram_sorted(
                 binned_t, grad, hess, weights, slot_w, num_slots, num_bins,
-                f32_vals=f32_vals, caps=caps, packed=packed)
+                f32_vals=f32_vals, caps=caps, packed=packed,
+                tile_rows=tile_rows)
 
         # LGBM_TPU_SMALL_ROUNDS=0 drops the expanded-pass branch (and its
         # lax.cond program duplication) — compile-cost bisect hook
@@ -895,7 +1045,7 @@ def compacted_segment_histogram(
         def expanded_path(_):
             hist = segment_histogram_expanded(
                 binned_t, grad, hess, weights, slot_w, num_bins,
-                live_cap=se, f32_vals=f32_vals)
+                live_cap=se, f32_vals=f32_vals, tile_rows=tile_rows)
             if num_slots > se:
                 hist = jnp.concatenate(
                     [hist, jnp.zeros((num_slots - se, 3, F, num_bins),
@@ -917,13 +1067,14 @@ def compacted_segment_histogram(
             g = jnp.take(grad, idxc)
             h = jnp.take(hess, idxc)
             s = jnp.where(valid, jnp.take(slot, idxc), num_slots)
-            return segment_histogram(cols, g, h, w, s, num_slots, num_bins)
+            return segment_histogram(cols, g, h, w, s, num_slots, num_bins,
+                                     tile_rows=tile_rows)
         return run
 
     if len(caps) == 1:
         return segment_histogram(binned_t, grad, hess, weights,
                                  jnp.where(member, slot, num_slots),
-                                 num_slots, num_bins)
+                                 num_slots, num_bins, tile_rows=tile_rows)
     caps_arr = jnp.asarray(caps, jnp.int32)
     bucket = jnp.sum(caps_arr >= count) - 1
     return lax.switch(bucket, [branch(c) for c in caps])
@@ -1072,15 +1223,19 @@ def histogram_matmul_int(
     vals_t: jax.Array,     # [2, n] int8 (g, h) * member
     num_bins: int,
     block_rows: int = _DEFAULT_BLOCK_ROWS,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Integer histogram via int8 one-hot matmul. Returns [2, F, B] i32.
 
     The MXU's s8 x s8 -> s32 path: one-hot operands are int8 (half the
     bytes of the bf16 f32-path one-hot) and accumulation is exact int32
     (``preferred_element_type``), so there is no bf16 mantissa loss and
-    no accumulation-order wobble to re-verify per backend."""
+    no accumulation-order wobble to re-verify per backend.  ``tile_rows``
+    caps the streaming block — int32 accumulation is associative, so
+    EVERY tile size is exactly equal to untiled."""
     F, n = binned_t.shape
     B = num_bins
+    block_rows = _tile_block(block_rows, resolve_tile_rows(tile_rows, n))
     nb = max(1, _pad_rows(n, block_rows) // block_rows)
     n_pad = nb * block_rows
     if n_pad != n:
@@ -1125,18 +1280,52 @@ def _pack_modulus(n: int, levels) -> int:
 def histogram_scatter_int(
     binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
     levels: Optional[tuple] = None,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Integer scatter-add histogram (CPU semantics path) — [2, F, B] i32.
 
     When the static bound allows, the two channels are PACKED into one
     i32 word per row (``g * M + h``), halving the scatter update traffic;
-    the fields are split back apart arithmetically after accumulation."""
+    the fields are split back apart arithmetically after accumulation.
+    ``tile_rows`` streams the update buffer in [tile, F] pieces
+    (exact under any tiling: int32 adds are associative)."""
     F, n = binned_t.shape
     B = num_bins
-    binned = binned_t.T                                    # [n, F]
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
-    flat_idx = binned.astype(jnp.int32) + offsets          # [n, F]
     M = _pack_modulus(n, levels)
+    T = resolve_tile_rows(tile_rows, n)
+    if T is not None:
+        nt = _pad_rows(n, T) // T
+        n_pad = nt * T
+        bt = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+        vt = jnp.pad(vals_t, ((0, 0), (0, n_pad - n)))
+        if M:
+            word_all = (vt[0].astype(jnp.int32) * M
+                        + vt[1].astype(jnp.int32))         # [n_pad]
+
+            def body(t, hist):
+                b = lax.dynamic_slice(bt, (0, t * T), (F, T)).T  # [T, F]
+                wd = lax.dynamic_slice(word_all, (t * T,), (T,))
+                flat = b.astype(jnp.int32) + offsets
+                return hist.at[flat.reshape(-1)].add(
+                    jnp.broadcast_to(wd[:, None], (T, F)).reshape(-1))
+
+            hist = lax.fori_loop(0, nt, body, jnp.zeros((F * B,), jnp.int32))
+            h = jnp.mod(hist, M)
+            g = (hist - h) // M
+            return jnp.stack([g, h]).reshape(2, F, B)
+
+        def body(t, hist):
+            b = lax.dynamic_slice(bt, (0, t * T), (F, T)).T      # [T, F]
+            v = lax.dynamic_slice(vt, (0, t * T), (2, T)).T.astype(jnp.int32)
+            flat = b.astype(jnp.int32) + offsets
+            upd = jnp.broadcast_to(v[:, None, :], (T, F, 2))
+            return hist.at[flat.reshape(-1)].add(upd.reshape(-1, 2))
+
+        hist = lax.fori_loop(0, nt, body, jnp.zeros((F * B, 2), jnp.int32))
+        return hist.reshape(F, B, 2).transpose(2, 0, 1)
+    binned = binned_t.T                                    # [n, F]
+    flat_idx = binned.astype(jnp.int32) + offsets          # [n, F]
     if M:
         word = (vals_t[0].astype(jnp.int32) * M
                 + vals_t[1].astype(jnp.int32))             # [n]
@@ -1162,6 +1351,7 @@ def build_histogram_int(
     method: str = "auto",
     block_rows: int = _DEFAULT_BLOCK_ROWS,
     levels: Optional[tuple] = None,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Masked integer histogram [2, F, B] i32 = per-bin (sum gq, sum hq)
     over ``member`` rows — the quantized twin of ``build_histogram``,
@@ -1169,9 +1359,11 @@ def build_histogram_int(
     vals_t = _vals_t_int(gq, hq, member)
     method = resolve_hist_method(method, quantized=True)
     if method == "matmul_int8":
-        return histogram_matmul_int(binned_t, vals_t, num_bins, block_rows)
+        return histogram_matmul_int(binned_t, vals_t, num_bins, block_rows,
+                                    tile_rows=tile_rows)
     if method == "scatter_int":
-        return histogram_scatter_int(binned_t, vals_t, num_bins, levels)
+        return histogram_scatter_int(binned_t, vals_t, num_bins, levels,
+                                     tile_rows=tile_rows)
     raise ValueError(f"unknown quantized histogram method {method!r}")
 
 
@@ -1183,6 +1375,7 @@ def compacted_histogram_int(
     caps: list,
     method: str = "auto",
     levels: Optional[tuple] = None,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Integer twin of ``compacted_histogram``: gather the member rows
     into the smallest static capacity that fits, then run the integer
@@ -1200,12 +1393,14 @@ def compacted_histogram_int(
             g = jnp.take(gq, idxc)
             h = jnp.take(hq, idxc)
             return build_histogram_int(cols, g, h, valid, num_bins,
-                                       method=method, levels=levels)
+                                       method=method, levels=levels,
+                                       tile_rows=tile_rows)
         return run
 
     if len(caps) == 1:
         return build_histogram_int(binned_t, gq, hq, member, num_bins,
-                                   method=method, levels=levels)
+                                   method=method, levels=levels,
+                                   tile_rows=tile_rows)
     caps_arr = jnp.asarray(caps, jnp.int32)
     bucket = jnp.sum(caps_arr >= count) - 1
     return lax.switch(bucket, [branch(c) for c in caps])
@@ -1218,19 +1413,61 @@ def segment_histogram_int(
     num_slots: int,
     num_bins: int,
     levels: Optional[tuple] = None,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Per-slot integer histogram [S, 2, F, B] i32 (scatter formulation,
     CPU semantics path) — the quantized twin of ``segment_histogram``,
-    with the same packed-word shrink as ``histogram_scatter_int``."""
+    with the same packed-word shrink as ``histogram_scatter_int`` and the
+    same [tile, F] update-buffer streaming under ``tile_rows`` (exact:
+    integer adds are associative)."""
     F, n = binned_t.shape
     B = num_bins
     S = num_slots
-    binned = binned_t.T
     slot_m = jnp.where(member, slot.astype(jnp.int32), S)
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    M = _pack_modulus(n, levels)
+    T = resolve_tile_rows(tile_rows, n)
+    if T is not None:
+        nt = _pad_rows(n, T) // T
+        n_pad = nt * T
+        bt = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)))
+        st = jnp.pad(slot_m, (0, n_pad - n), constant_values=S)
+        if M:
+            word_all = jnp.pad(
+                (gq.astype(jnp.int32) * M + hq.astype(jnp.int32))
+                * member.astype(jnp.int32), (0, n_pad - n))
+
+            def body(t, hist):
+                b = lax.dynamic_slice(bt, (0, t * T), (F, T)).T  # [T, F]
+                s = lax.dynamic_slice(st, (t * T,), (T,))
+                wd = lax.dynamic_slice(word_all, (t * T,), (T,))
+                flat = (s[:, None] * (F * B) + b.astype(jnp.int32)
+                        + offsets)
+                return hist.at[flat.reshape(-1)].add(
+                    jnp.broadcast_to(wd[:, None], (T, F)).reshape(-1))
+
+            hist = lax.fori_loop(0, nt, body,
+                                 jnp.zeros(((S + 1) * F * B,), jnp.int32))
+            h = jnp.mod(hist, M)
+            g = (hist - h) // M
+            return jnp.stack([g, h]).reshape(2, S + 1, F, B).transpose(
+                1, 0, 2, 3)[:S]
+        vt = jnp.pad(_vals_t_int(gq, hq, member), ((0, 0), (0, n_pad - n)))
+
+        def body(t, hist):
+            b = lax.dynamic_slice(bt, (0, t * T), (F, T)).T      # [T, F]
+            s = lax.dynamic_slice(st, (t * T,), (T,))
+            v = lax.dynamic_slice(vt, (0, t * T), (2, T)).T.astype(jnp.int32)
+            flat = s[:, None] * (F * B) + b.astype(jnp.int32) + offsets
+            upd = jnp.broadcast_to(v[:, None, :], (T, F, 2))
+            return hist.at[flat.reshape(-1)].add(upd.reshape(-1, 2))
+
+        hist = lax.fori_loop(0, nt, body,
+                             jnp.zeros(((S + 1) * F * B, 2), jnp.int32))
+        return hist.reshape(S + 1, F, B, 2)[:S].transpose(0, 3, 1, 2)
+    binned = binned_t.T
     flat = (slot_m[:, None] * (F * B)
             + binned.astype(jnp.int32) + offsets)          # [n, F]
-    M = _pack_modulus(n, levels)
     if M:
         word = (gq.astype(jnp.int32) * M + hq.astype(jnp.int32)) \
             * member.astype(jnp.int32)
@@ -1279,12 +1516,15 @@ def segment_histogram_sorted_int(
     block_rows: int = 1024,
     caps: Optional[list] = None,
     packed: Optional[tuple] = None,    # pack_cols_u32_quant output
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Integer sorted-arena segment histogram: same sort + block-aligned
     arena as ``segment_histogram_sorted`` but the per-block one-hot
-    matmul runs int8 -> int32 and the block->slot fold is an exact
-    integer ``segment_sum`` (the f32 path's slot-fold matmul would lose
-    integer exactness past 2^24).  Returns [S, 2, F, B] i32."""
+    matmul runs int8 -> int32 and the block->slot fold accumulates exact
+    int32 inside the block scan (a slot-fold matmul would lose integer
+    exactness past 2^24).  ``tile_rows`` switches the record gathers
+    from one hoisted whole-arena gather to per-block in-loop gathers —
+    O(block) transients, identical values.  Returns [S, 2, F, B] i32."""
     F, n = binned_t.shape
     B = num_bins
     S = num_slots
@@ -1338,48 +1578,77 @@ def segment_histogram_sorted_int(
                 return lax.dot(vals, onehot2d,
                                preferred_element_type=jnp.int32)
 
-            if packed is not None and packed[0] is not None:
-                words_t, Wb = packed
-                rec = jnp.take(words_t, src, axis=1)    # [Wb+1, NBC] u32
-                recb = rec.reshape(Wb + 1, NB, C).transpose(1, 0, 2)
-                vmask = valid.reshape(NB, 1, C)
+            use_packed = packed is not None and packed[0] is not None
 
-                def body(_, xs):
-                    blk_rec, vm = xs
-                    bw = blk_rec[:Wb]                   # [Wb, C] u32
-                    rows = jnp.concatenate(
-                        [((bw >> (8 * j)) & 0xFF) for j in range(4)],
-                        axis=0).reshape(4, Wb, C).transpose(
-                            1, 0, 2).reshape(Wb * 4, C)[:F]   # [F, C]
-                    vw = blk_rec[Wb]                    # [C] u32
-                    g = (vw & 0xFF).astype(jnp.int32) - 128
-                    h = ((vw >> 8) & 0xFF).astype(jnp.int32)
-                    m = ((vw >> 16) & 1).astype(jnp.int32)
-                    sel = vm[0] & (m == 1)
-                    vals = jnp.where(sel, jnp.stack([g, h]), 0
-                                     ).astype(jnp.int8)
-                    return _, block_partial(rows.astype(jnp.int32), vals)
+            def part_from_packed(blk_rec, vm):
+                Wb = packed[1]
+                bw = blk_rec[:Wb]                       # [Wb, C] u32
+                rows = jnp.concatenate(
+                    [((bw >> (8 * j)) & 0xFF) for j in range(4)],
+                    axis=0).reshape(4, Wb, C).transpose(
+                        1, 0, 2).reshape(Wb * 4, C)[:F]   # [F, C]
+                vw = blk_rec[Wb]                        # [C] u32
+                g = (vw & 0xFF).astype(jnp.int32) - 128
+                h = ((vw >> 8) & 0xFF).astype(jnp.int32)
+                m = ((vw >> 16) & 1).astype(jnp.int32)
+                sel = vm[0] & (m == 1)
+                vals = jnp.where(sel, jnp.stack([g, h]), 0).astype(jnp.int8)
+                return block_partial(rows.astype(jnp.int32), vals)
 
-                _, parts = lax.scan(body, None, (recb, vmask))
+            def part_from_raw(cols, g, h, vm):
+                vt = jnp.stack([jnp.where(vm, g, 0),
+                                jnp.where(vm, h, 0)]).astype(jnp.int8)
+                return block_partial(cols, vt)
+
+            # blocks -> slots: exact int32 accumulation inside the scan
+            # (shared by the hoisted and in-loop gather modes)
+            acc0 = jnp.zeros((S + 1, 2 * F * B), jnp.int32)
+            j_arange = jnp.arange(NB, dtype=jnp.int32)
+
+            if resolve_tile_rows(tile_rows, n) is None:
+                if use_packed:
+                    words_t, Wb = packed
+                    rec = jnp.take(words_t, src, axis=1)  # [Wb+1, NBC] u32
+                    recb = rec.reshape(Wb + 1, NB, C).transpose(1, 0, 2)
+                    vmask = valid.reshape(NB, 1, C)
+
+                    def body(acc, xs):
+                        j, blk_rec, vm = xs
+                        return acc.at[blk_slot[j]].add(
+                            part_from_packed(blk_rec, vm).reshape(-1)), None
+
+                    acc, _ = lax.scan(body, acc0, (j_arange, recb, vmask))
+                else:
+                    cols = jnp.take(binned_t, src, axis=1)  # [F, NBC]
+                    g = jnp.take(gq, src)
+                    h = jnp.take(hq, src)
+                    colsb = cols.reshape(F, NB, C).transpose(1, 0, 2)
+                    gb = g.reshape(NB, C)
+                    hb = h.reshape(NB, C)
+                    vmask = valid.reshape(NB, C)
+
+                    def body(acc, xs):
+                        j, b, gg, hh, vm = xs
+                        return acc.at[blk_slot[j]].add(
+                            part_from_raw(b, gg, hh, vm).reshape(-1)), None
+
+                    acc, _ = lax.scan(body, acc0,
+                                      (j_arange, colsb, gb, hb, vmask))
             else:
-                cols = jnp.take(binned_t, src, axis=1)  # [F, NBC]
-                g = jnp.where(valid, jnp.take(gq, src), 0)
-                h = jnp.where(valid, jnp.take(hq, src), 0)
-                vt = jnp.stack([g, h]).astype(jnp.int8)
-                colsb = cols.reshape(F, NB, C).transpose(1, 0, 2)
-                vtb = vt.reshape(2, NB, C).transpose(1, 0, 2)
+                def body(acc, j):
+                    sb = lax.dynamic_slice(src, (j * C,), (C,))
+                    vm = lax.dynamic_slice(valid, (j * C,), (C,))
+                    if use_packed:
+                        rec = jnp.take(packed[0], sb, axis=1)  # [Wb+1, C]
+                        part = part_from_packed(rec, vm[None, :])
+                    else:
+                        cols = jnp.take(binned_t, sb, axis=1)  # [F, C]
+                        part = part_from_raw(cols, jnp.take(gq, sb),
+                                             jnp.take(hq, sb), vm)
+                    return acc.at[blk_slot[j]].add(part.reshape(-1)), None
 
-                def body(_, xs):
-                    b, v = xs
-                    return _, block_partial(b, v)
-
-                _, parts = lax.scan(body, None, (colsb, vtb))
-
-            # blocks -> slots: exact integer fold (parts are i32; a
-            # tiny [NB]-segment scatter, NB is a few hundred at most)
-            hist = jax.ops.segment_sum(parts.reshape(NB, 2 * F * B),
-                                       blk_slot, num_segments=S + 1)[:S]
-            return hist.reshape(S, 2, F, B)
+                acc, _ = lax.scan(body, acc0, j_arange)
+            return acc[:S].reshape(S, 2, F, B)
         return run
 
     if len(caps) == 1:
@@ -1405,6 +1674,7 @@ def segment_histogram_expanded_int(
     num_bins: int,
     live_cap: int = _EXPAND_SLOTS_QUANT,
     block_rows: int = _DEFAULT_BLOCK_ROWS,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Integer slot-expanded full-matrix pass: LHS [2*live_cap, C] int8
     (row j*cap+s carries vals[j] where slot == s), one s8 MXU tile per
@@ -1412,6 +1682,7 @@ def segment_histogram_expanded_int(
     F, n = binned_t.shape
     B = num_bins
     SE = live_cap
+    block_rows = _tile_block(block_rows, resolve_tile_rows(tile_rows, n))
     nb = max(1, _pad_rows(n, block_rows) // block_rows)
     n_pad = nb * block_rows
     vals_t = _vals_t_int(gq, hq, member)
@@ -1452,6 +1723,7 @@ def compacted_segment_histogram_int(
     num_live: Optional[jax.Array] = None,
     packed: Optional[tuple] = None,     # pack_cols_u32_quant output
     levels: Optional[tuple] = None,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Integer twin of ``compacted_segment_histogram`` with the same
     backend dispatch: sorted int arena / expanded int pass on
@@ -1465,7 +1737,7 @@ def compacted_segment_histogram_int(
         def arena_path(_):
             return segment_histogram_sorted_int(
                 binned_t, gq, hq, slot_w, num_slots, num_bins,
-                caps=caps, packed=packed)
+                caps=caps, packed=packed, tile_rows=tile_rows)
 
         small_enabled = os.environ.get("LGBM_TPU_SMALL_ROUNDS") != "0"
         if num_live is None or num_slots <= _SMALL_ROUND_SLOTS \
@@ -1475,7 +1747,8 @@ def compacted_segment_histogram_int(
 
         def expanded_path(_):
             hist = segment_histogram_expanded_int(
-                binned_t, gq, hq, member, slot_w, num_bins, live_cap=se)
+                binned_t, gq, hq, member, slot_w, num_bins, live_cap=se,
+                tile_rows=tile_rows)
             if num_slots > se:
                 hist = jnp.concatenate(
                     [hist, jnp.zeros((num_slots - se, 2, F, num_bins),
@@ -1497,12 +1770,14 @@ def compacted_segment_histogram_int(
             h = jnp.take(hq, idxc)
             s = jnp.where(valid, jnp.take(slot, idxc), num_slots)
             return segment_histogram_int(cols, g, h, valid, s, num_slots,
-                                         num_bins, levels=levels)
+                                         num_bins, levels=levels,
+                                         tile_rows=tile_rows)
         return run
 
     if len(caps) == 1:
         return segment_histogram_int(binned_t, gq, hq, in_play, slot,
-                                     num_slots, num_bins, levels=levels)
+                                     num_slots, num_bins, levels=levels,
+                                     tile_rows=tile_rows)
     caps_arr = jnp.asarray(caps, jnp.int32)
     bucket = jnp.sum(caps_arr >= count) - 1
     return lax.switch(bucket, [branch(c) for c in caps])

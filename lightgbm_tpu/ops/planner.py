@@ -1,0 +1,336 @@
+"""HBM budget planner: pick histogram execution parameters at trace time.
+
+The r5 bench died in compile with an HBM OOM — a lane-padded
+``f32[308000000, 3]`` whole-dataset record arena (157.7 GB requested vs
+17.2 GB HBM) — because every kernel materialized O(n*F) intermediates
+and nothing MODELED whether they fit.  This module is the model: it
+predicts per-variant peak HBM bytes for the histogram pipeline
+(device binned matrix, carried scores/gradients, per-tree hist cache
+including TPU lane padding, per-pass transients, pack/sort arenas,
+cross-device psum payloads) against the device's reported HBM limit and
+picks, at trace time:
+
+- ``tile_rows`` — the row-tile size every kernel in ops/histogram.py
+  streams through (power of two; 0 = untiled).  Peak transient HBM
+  becomes O(tile), not O(n*F);
+- whether the whole-dataset ``pack_cols_u32`` record arena may be
+  hoisted (``use_pack``) or records must be assembled per tile inside
+  the kernel loops;
+- the psum payload width for quantized histograms (``narrow_int16`` —
+  the record of ``ops.histogram.quant_psum_narrow``'s static bound).
+
+The same plan governs serial and sharded training: the GBDT layer plans
+with PER-SHARD rows and threads the result through ``GrowerConfig``
+(tile_rows / hist_pack), so the serial grower, the batched-frontier
+grower, the fused macro-chunk program and the data-/voting-parallel
+learners all execute under one verdict.  bench.py gates its >=10M-row
+stage on ``feasible`` and journals the chosen tile instead of crashing.
+
+Env overrides:
+- ``LGBM_TPU_TILE_ROWS``: force a tile size (``0``/``off`` forces
+  untiled; a positive integer forces that many rows per tile).
+- ``LGBM_TPU_HBM_BYTES``: override the device HBM limit (useful off-TPU
+  and in tests, which plan against a fake memory model).
+
+Related work: bounding device memory by streaming row chunks through a
+fixed-footprint histogram kernel is the GPU GBDT move (Wen et al.,
+arXiv:1706.08359; Ou, arXiv:1806.11248 — gradient-based sketching to
+bound device memory); here the bound is a *planner verdict* instead of
+an operator-tuned chunk count.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+# default assumed HBM when the backend reports nothing (one v5e-class
+# chip; r5 measured 17.2 GB reported — stay conservative)
+DEFAULT_HBM_BYTES = 16 * (1 << 30)
+# fraction of the limit a plan may claim: XLA needs slack for fusion
+# temps, the program image, and collectives' staging buffers
+HEADROOM = 0.85
+# smallest tile the planner will degrade to (a histogram pass over fewer
+# rows is dominated by fixed per-pass overhead)
+MIN_TILE_ROWS = 1 << 16
+_DEFAULT_BLOCK_ROWS = 4096
+
+
+def _pad(x: int, m: int) -> int:
+    return -(-int(x) // m) * m
+
+
+def _arr(minor: int, second: int, itemsize: int, accel: bool,
+         leading: int = 1) -> int:
+    """Bytes of an array whose two minor dims are (second, minor).
+
+    On accelerators the two minor-most dims tile to (sublanes, 128) with
+    sublanes scaling inversely with itemsize — (8, 128) for 4-byte,
+    (16, 128) for 2-byte, (32, 128) for 1-byte (ops/histogram.py LAYOUT
+    DOCTRINE).  Off-accelerator: dense.
+    """
+    if not accel:
+        return leading * second * minor * itemsize
+    sub = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    return leading * _pad(second, sub) * _pad(minor, 128) * itemsize
+
+
+class HistPlan(NamedTuple):
+    """Trace-time histogram execution plan (see module docstring)."""
+
+    tile_rows: int              # 0 = untiled
+    use_pack: bool              # whole-dataset u32 record arena allowed
+    variant: str                # resolved histogram kernel family
+    quant: bool
+    narrow_int16: bool          # quantized psum payload narrowed
+    predicted_peak_bytes: int   # at the chosen tile
+    untiled_peak_bytes: int     # what the unplanned pipeline would take
+    budget_bytes: int           # limit * HEADROOM
+    limit_bytes: int
+    limit_source: str           # "memory_stats" | "env" | "default"
+    feasible: bool              # predicted peak fits the budget
+    degraded: bool              # tiling was forced by the budget
+
+    def summary(self) -> dict:
+        """JSON-friendly form for bench journals / telemetry."""
+        return {
+            "tile_rows": self.tile_rows,
+            "use_pack": self.use_pack,
+            "variant": self.variant,
+            "quant": self.quant,
+            "narrow_int16": self.narrow_int16,
+            "predicted_peak_bytes": self.predicted_peak_bytes,
+            "untiled_peak_bytes": self.untiled_peak_bytes,
+            "budget_bytes": self.budget_bytes,
+            "hbm_limit_bytes": self.limit_bytes,
+            "limit_source": self.limit_source,
+            "feasible": self.feasible,
+            "degraded": self.degraded,
+        }
+
+
+def hbm_limit_bytes() -> tuple:
+    """(limit_bytes, source) for the active device.
+
+    Priority: ``LGBM_TPU_HBM_BYTES`` env (tests / fake memory models) >
+    the device allocator's reported ``bytes_limit`` > the conservative
+    default.  Never raises — planning must work before/without a
+    backend.
+    """
+    env = os.environ.get("LGBM_TPU_HBM_BYTES", "").strip()
+    if env:
+        try:
+            return max(int(float(env)), 1), "env"
+        except ValueError:
+            pass
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit, "memory_stats"
+    except Exception:
+        pass
+    return DEFAULT_HBM_BYTES, "default"
+
+
+def predict_peak_bytes(
+    rows: int,                  # per-shard row count the kernels see
+    features: int,              # device column count (groups under EFB)
+    num_bins: int,              # padded bin axis B
+    num_leaves: int = 31,
+    num_class: int = 1,
+    quant: bool = False,
+    variant: str = "scatter",   # resolved kernel family name
+    tile_rows: int = 0,         # 0 = untiled
+    use_pack: bool = True,
+    round_width: int = 128,
+    machines: int = 1,
+    accel: Optional[bool] = None,
+    block_rows: int = _DEFAULT_BLOCK_ROWS,
+) -> tuple:
+    """(peak_bytes, breakdown dict) for one training step's histogram
+    pipeline on one device.
+
+    A deliberately simple sum of the dominant allocations — resident
+    state plus the largest per-pass transient — NOT an XLA simulator.
+    Accuracy target: the right ORDER for the feasibility verdict (the
+    r5 failure was off by 9x, not 10%).
+    """
+    if accel is None:
+        from .histogram import on_accelerator
+        accel = on_accelerator()
+    n = max(int(rows), 1)
+    F = max(int(features), 1)
+    B = max(int(num_bins), 2)
+    L = max(int(num_leaves), 2)
+    K = max(int(num_class), 1)
+    S = max(int(round_width), 1)
+    T = n if tile_rows <= 0 else min(int(tile_rows), n)
+    C = min(block_rows, _pad(T, 128))
+    ch = 2 if quant else 3          # histogram channels
+    hitem = 4                       # i32 / f32 cells
+
+    b = {}
+    bin_item = 1 if B <= 256 else 2
+    # resident: the device binned matrix (feature-major [F, n]) and one
+    # transformation copy (pad / compaction gather of the same shape)
+    b["binned"] = _arr(n, F, bin_item, accel) * 2
+    # carried scores (donated in+out) + per-class grad/hess f32 rows
+    b["scores"] = 2 * K * _arr(n, 1, 4, accel)
+    b["grads"] = 2 * K * _arr(n, 1, 4, accel)
+    if quant:
+        b["grads"] += 2 * K * _arr(n, 1, 1, accel)      # int8 gq/hq
+    # per-tree histogram cache [L, ch, F, B] + the round's segment
+    # output [S, ch, F, B]
+    b["hist_cache"] = L * ch * _arr(B, F, hitem, accel)
+    b["seg_hist"] = (S + 1) * ch * _arr(B, F, hitem, accel)
+    # sorted-arena fixed state: u32 sort keys (key + sorted + order)
+    if variant in ("sorted", "matmul", "matmul_int8"):
+        b["sort_keys"] = 3 * _arr(n, 1, 4, accel)
+    # whole-dataset fused record arena (pack_cols_u32): Wb+3 u32 words
+    # per row (Wb+1 quantized)
+    if use_pack:
+        wb = (F + 3) // 4
+        b["pack_arena"] = _arr(n, wb + (1 if quant else 3), 4, accel)
+
+    # dominant per-pass transient, by kernel family
+    if variant.startswith("scatter"):
+        # the r5 OOM shape: [T*F, ch] update buffer (lane-padded on
+        # accel) + [T, F] i32 flat indices
+        b["scatter_updates"] = _arr(ch, T * F, hitem, accel)
+        b["scatter_index"] = _arr(F, T, 4, accel)
+    elif variant.startswith("matmul"):
+        onehot_item = 1 if (quant or variant == "matmul") else 4
+        if variant == "matmul" and not quant:
+            onehot_item = 2                      # bf16 one-hot
+        b["onehot"] = _arr(B * F, C, onehot_item, accel)
+        b["vals_pad"] = _arr(n, ch, 4, accel)    # padded vals copy
+    else:                                        # sorted / expanded
+        b["onehot"] = _arr(B * F, C, 1 if quant else 2, accel)
+        if tile_rows <= 0:
+            # hoisted whole-arena record gather
+            wb = (F + 3) // 4
+            width = (wb + (1 if quant else 3)) if use_pack else (F + 3)
+            b["arena_gather"] = _arr(n, width, 4, accel)
+        else:
+            wb = (F + 3) // 4
+            width = (wb + (1 if quant else 3)) if use_pack else (F + 3)
+            b["arena_gather"] = _arr(C, width, 4, accel)
+    # cross-device histogram reduction staging
+    if machines > 1:
+        from .histogram import hist_payload_bytes
+        b["psum"] = 2 * hist_payload_bytes(
+            F, B, rows_global=n * machines,
+            quant_bins=None if not quant else 64) * S
+
+    return sum(b.values()), b
+
+
+def _resolved_variant(method: str, quant: bool) -> str:
+    from .histogram import resolve_hist_method, use_sorted_seghist
+    m = resolve_hist_method(method, quantized=quant)
+    # the segment passes dominate peak; their dispatch follows
+    # use_sorted_seghist, not the point-histogram method
+    if use_sorted_seghist():
+        return "sorted"
+    return m
+
+
+def _tile_override():
+    """LGBM_TPU_TILE_ROWS: None = unset, 0 = force untiled, >0 = force."""
+    v = os.environ.get("LGBM_TPU_TILE_ROWS", "").strip().lower()
+    if not v:
+        return None
+    if v in ("0", "off", "none", "false"):
+        return 0
+    try:
+        return max(int(v), 1)
+    except ValueError:
+        return None
+
+
+def plan_histograms(
+    rows: int,
+    features: int,
+    num_bins: int,
+    num_leaves: int = 31,
+    num_class: int = 1,
+    quant: bool = False,
+    quant_bins: int = 4,
+    method: str = "auto",
+    round_width: int = 128,
+    machines: int = 1,
+    budget_bytes: Optional[int] = None,   # tests: fake memory model
+    accel: Optional[bool] = None,
+) -> HistPlan:
+    """Choose {tile_rows, use_pack, psum narrowing} for a training shape.
+
+    Search: untiled first (fastest dispatch); if its predicted peak
+    exceeds the budget, walk tile_rows down through powers of two until
+    the prediction fits (records un-hoisted — ``use_pack=False`` — the
+    moment tiling engages, so no whole-dataset record arena is ever
+    materialized in tiled mode).  ``feasible=False`` means even
+    MIN_TILE_ROWS does not fit: the caller should refuse to launch the
+    shape rather than hand XLA a guaranteed OOM.
+    """
+    from .histogram import quant_psum_narrow
+
+    if budget_bytes is not None:
+        limit, source = int(budget_bytes), "caller"
+    else:
+        limit, source = hbm_limit_bytes()
+    # HEADROOM applies to EVERY limit source (caller-supplied fake
+    # memory models included) so tests exercise the shipped decision rule
+    budget = int(limit * HEADROOM)
+    variant = _resolved_variant(method, quant)
+    narrow = bool(quant and quant_psum_narrow(rows * machines, quant_bins))
+
+    def peak(tile, pack):
+        return predict_peak_bytes(
+            rows, features, num_bins, num_leaves, num_class, quant,
+            variant, tile, pack, round_width, machines, accel)[0]
+
+    untiled_peak = peak(0, True)
+    forced = _tile_override()
+
+    def mk(tile, pack, degraded):
+        p = peak(tile, pack)
+        return HistPlan(
+            tile_rows=tile, use_pack=pack, variant=variant, quant=quant,
+            narrow_int16=narrow, predicted_peak_bytes=p,
+            untiled_peak_bytes=untiled_peak, budget_bytes=budget,
+            limit_bytes=limit, limit_source=source,
+            feasible=p <= budget, degraded=degraded)
+
+    if forced is not None:
+        if forced == 0 or forced >= rows:
+            return mk(0, True, False)
+        return mk(int(forced), False, False)
+
+    if untiled_peak <= budget:
+        return mk(0, True, False)
+
+    # degrade: largest power-of-two tile whose prediction fits
+    tile = 1 << max(int(rows - 1).bit_length() - 1, 0)
+    tile = max(tile, MIN_TILE_ROWS)
+    while tile > MIN_TILE_ROWS and peak(tile, False) > budget:
+        tile //= 2
+    return mk(tile, False, True)
+
+
+def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None):
+    """Thread a plan into a ``GrowerConfig``; returns (cfg, plan).
+
+    Shared by the GBDT layer (per-shard rows) and the standalone
+    parallel learners so every path trains under the same verdict.
+    """
+    plan = plan_histograms(
+        rows=rows, features=features, num_bins=cfg.num_bins,
+        num_leaves=cfg.num_leaves, quant=cfg.quant,
+        quant_bins=cfg.quant_bins, method=cfg.hist_method,
+        round_width=cfg.round_width, machines=max(cfg.num_machines, 1),
+        accel=accel)
+    cfg = cfg._replace(tile_rows=plan.tile_rows,
+                       hist_pack=cfg.hist_pack and plan.use_pack)
+    return cfg, plan

@@ -74,6 +74,7 @@ def make_sharded_grower(
     cfg: GrowerConfig,
     data_axis: Optional[str] = DATA_AXIS,
     feature_axis: Optional[str] = None,
+    auto_plan: bool = True,
 ):
     """Build a jitted sharded grow-tree callable.
 
@@ -81,6 +82,11 @@ def make_sharded_grower(
       binned_t [F_pad, n_pad] (feature-major), grad/hess/row_mask [n_pad]
     (pad rows with row_mask = 0; pad features with trivial bins).
     Returns fn(binned_t, grad, hess, row_mask) -> (TreeArrays, leaf_id).
+
+    ``auto_plan``: when ``cfg.tile_rows`` is unset (0), run the HBM
+    budget planner (ops/planner.py) at trace time over the PER-SHARD
+    shapes, so the standalone learners obey the same memory verdict as
+    engine-driven training (row tiling, record-arena hoisting).
     """
     if feature_axis and meta.resolved().has_bundles \
             and cfg.num_feature_shards <= 1:
@@ -100,8 +106,23 @@ def make_sharded_grower(
         check_vma=False,
     )
     def sharded(binned_t, grad, hess, row_mask):
+        run_cfg = cfg
+        if auto_plan and cfg.tile_rows == 0:
+            # trace-time planning over the local (per-shard) shapes —
+            # binned_t here is already the device slice
+            from ..ops.planner import apply_plan
+            run_cfg, plan = apply_plan(cfg, int(binned_t.shape[1]),
+                                       int(binned_t.shape[0]))
+            if not plan.feasible:
+                from ..utils.log import log_warning
+                log_warning(
+                    "HBM planner: predicted peak "
+                    f"{plan.predicted_peak_bytes / 1e9:.2f} GB exceeds "
+                    f"the {plan.budget_bytes / 1e9:.2f} GB budget even "
+                    f"at tile_rows={plan.tile_rows}; training may OOM "
+                    "(LGBM_TPU_HBM_BYTES / LGBM_TPU_TILE_ROWS override)")
         out = grow_tree(
-            binned_t, grad, hess, row_mask, meta, cfg,
+            binned_t, grad, hess, row_mask, meta, run_cfg,
             axis_name=data_axis, feature_axis_name=feature_axis)
         # CEGB-enabled configs return (tree, leaf_id, cegb_state); this
         # standalone grower drops the cross-tree state (single-tree API)

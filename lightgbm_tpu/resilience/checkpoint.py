@@ -97,10 +97,16 @@ def build_bundle_bytes(booster, iteration: int,
     # body is chunk-size-invariant, boosting/macro.py), so a bundle from
     # a chunked run restores into a per-iteration run and vice versa
     from ..boosting.macro import chunk_cap
+    # hist_plan likewise: row tiling is bit-invariant (pinned tile-major
+    # accumulation, ops/planner.py), so a bundle from a tiled run
+    # restores into an untiled one and vice versa — recorded so an OOM
+    # post-mortem can see what the planner chose
+    plan = getattr(booster.boosting, "hist_plan", None)
     manifest = {
         "format": FORMAT,
         "iteration": int(iteration),
         "chunk_cap": chunk_cap(),
+        "hist_plan": plan.summary() if plan is not None else None,
         "members": {
             "model.txt": {"sha256": _sha256(model_txt),
                           "size": len(model_txt)},

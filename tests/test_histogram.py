@@ -224,3 +224,152 @@ def test_take_from_table_exact(monkeypatch):
     t3 = rng.randint(0, 1 << 20, 255).astype(np.int32)
     out3 = np.asarray(H.take_from_table(jnp.asarray(t3), jnp.asarray(idx)))
     assert np.array_equal(out3, t3[idx])
+
+
+# ---------------------------------------------------------------- tiling
+# Row-tiled execution (ops/planner.py HBM budget planner): every kernel
+# streams row tiles through a scan/fori accumulator in PINNED tile-major
+# order, so tiled results equal untiled ones BIT-FOR-BIT — the integer
+# family by int32 associativity, the f32 scatter/sorted kernels by
+# identical per-bin/per-block add order.  Tile sizes cover a ragged last
+# tile, a tiny tile, and tile_rows > n (degenerates to untiled).
+
+_TILE_SIZES = [128, 192, 7, 4096]   # ragged / odd / tiny / > n
+
+
+def _tile_data(seed=3, n=1000, F=7, S=16, B=32):
+    rng = np.random.RandomState(seed)
+    binned = jnp.asarray(rng.randint(0, B - 1, (F, n)).astype(np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.abs(g) + 0.1
+    w = jnp.asarray((rng.rand(n) > 0.3).astype(np.float32) * 1.5)
+    slot = jnp.asarray(rng.randint(0, S + 1, n).astype(np.int32))
+    return binned, g, h, w, slot, n, F, S, B
+
+
+@pytest.mark.parametrize("tile", _TILE_SIZES)
+def test_tiled_scatter_bit_parity(tile):
+    from lightgbm_tpu.ops.histogram import _vals_t, segment_histogram
+    binned, g, h, w, slot, n, F, S, B = _tile_data()
+    vals = _vals_t(g, h, w)
+    a = np.asarray(histogram_scatter(binned, vals, B))
+    b = np.asarray(histogram_scatter(binned, vals, B, tile_rows=tile))
+    assert np.array_equal(a, b)
+    a = np.asarray(segment_histogram(binned, g, h, w, slot, S, B))
+    b = np.asarray(segment_histogram(binned, g, h, w, slot, S, B,
+                                     tile_rows=tile))
+    assert np.array_equal(a, b)
+
+
+@pytest.mark.parametrize("tile", _TILE_SIZES)
+def test_tiled_sorted_bit_parity(tile):
+    """Sorted-arena f32 kernel: hoisted whole-arena gathers (untiled) vs
+    per-block in-loop record assembly (tiled) — same blocks, same pinned
+    fold order, bit-identical; with and without the fused u32 records."""
+    from lightgbm_tpu.ops.histogram import (pack_cols_u32,
+                                            segment_histogram,
+                                            segment_histogram_sorted)
+    binned, g, h, w, slot, n, F, S, B = _tile_data()
+    for pk in (None, pack_cols_u32(binned, g, h, w)):
+        a = np.asarray(segment_histogram_sorted(
+            binned, g, h, w, slot, S, B, f32_vals=True, packed=pk))
+        b = np.asarray(segment_histogram_sorted(
+            binned, g, h, w, slot, S, B, f32_vals=True, packed=pk,
+            tile_rows=tile))
+        assert np.array_equal(a, b), f"pk={pk is not None}"
+    # and the sorted result still matches the scatter reference
+    ref = np.asarray(segment_histogram(binned, g, h, w, slot, S, B))
+    np.testing.assert_allclose(b, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("tile", _TILE_SIZES)
+def test_tiled_matmul_parity(tile):
+    """Matmul family: any tile >= the streaming block leaves the block
+    partition unchanged (bit-identical); a smaller tile refines it —
+    deterministic, within f32 reassociation of the same sums."""
+    from lightgbm_tpu.ops.histogram import _vals_t, histogram_matmul
+    binned, g, h, w, slot, n, F, S, B = _tile_data()
+    vals = _vals_t(g, h, w)
+    block = 64
+    a = np.asarray(histogram_matmul(binned, vals, B, block_rows=block))
+    b = np.asarray(histogram_matmul(binned, vals, B, block_rows=block,
+                                    tile_rows=tile))
+    if tile >= block:
+        assert np.array_equal(a, b)
+    else:
+        np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("tile", _TILE_SIZES)
+def test_tiled_int_family_exact(tile):
+    """Quantized integer kernels are exactly associative: EVERY tile
+    size equals untiled bit-for-bit, across the whole family."""
+    import jax
+
+    from lightgbm_tpu.ops import histogram as H
+    binned, g, h, w, slot, n, F, S, B = _tile_data()
+    gq, hq, _, _ = H.quantize_gradients(g, h, w, 8, jax.random.PRNGKey(0))
+    member = w > 0
+    lv = H.quant_levels(8)
+    vals = H._vals_t_int(gq, hq, member)
+    pairs = [
+        (H.histogram_scatter_int(binned, vals, B, levels=lv),
+         H.histogram_scatter_int(binned, vals, B, levels=lv,
+                                 tile_rows=tile)),
+        (H.histogram_matmul_int(binned, vals, B, block_rows=64),
+         H.histogram_matmul_int(binned, vals, B, block_rows=64,
+                                tile_rows=tile)),
+        (H.segment_histogram_int(binned, gq, hq, member, slot, S, B,
+                                 levels=lv),
+         H.segment_histogram_int(binned, gq, hq, member, slot, S, B,
+                                 levels=lv, tile_rows=tile)),
+    ]
+    slot_w = jnp.where(member, slot, S)
+    for pk in (None, H.pack_cols_u32_quant(binned, gq, hq, member)):
+        pairs.append(
+            (H.segment_histogram_sorted_int(binned, gq, hq, slot_w, S, B,
+                                            packed=pk),
+             H.segment_histogram_sorted_int(binned, gq, hq, slot_w, S, B,
+                                            packed=pk, tile_rows=tile)))
+    pairs.append(
+        (H.segment_histogram_expanded_int(binned, gq, hq, member, slot_w,
+                                          B, live_cap=16),
+         H.segment_histogram_expanded_int(binned, gq, hq, member, slot_w,
+                                          B, live_cap=16,
+                                          tile_rows=tile)))
+    for i, (a, b) in enumerate(pairs):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f"pair {i}"
+
+
+def test_tiled_compacted_dispatch():
+    """tile_rows threads through the compacted wrappers (the growers'
+    entry points) on both the f32 and integer paths."""
+    import jax
+
+    from lightgbm_tpu.ops import histogram as H
+    from lightgbm_tpu.ops.histogram import capacity_schedule
+    binned, g, h, w, slot, n, F, S, B = _tile_data()
+    member = jnp.asarray((np.arange(n) % 3 == 0))
+    caps = capacity_schedule(n, min_cap=256)
+    a = np.asarray(H.compacted_histogram(binned, g, h, w, member, B, caps))
+    b = np.asarray(H.compacted_histogram(binned, g, h, w, member, B, caps,
+                                         tile_rows=128))
+    assert np.array_equal(a, b)
+    a = np.asarray(H.compacted_segment_histogram(
+        binned, g, h, w, slot, S, B, caps))
+    b = np.asarray(H.compacted_segment_histogram(
+        binned, g, h, w, slot, S, B, caps, tile_rows=128))
+    assert np.array_equal(a, b)
+    gq, hq, _, _ = H.quantize_gradients(g, h, w, 8, jax.random.PRNGKey(0))
+    lv = H.quant_levels(8)
+    a = np.asarray(H.compacted_histogram_int(binned, gq, hq, w, member, B,
+                                             caps, levels=lv))
+    b = np.asarray(H.compacted_histogram_int(binned, gq, hq, w, member, B,
+                                             caps, levels=lv,
+                                             tile_rows=128))
+    assert np.array_equal(a, b)
+    a = np.asarray(H.compacted_segment_histogram_int(
+        binned, gq, hq, w, slot, S, B, caps, levels=lv))
+    b = np.asarray(H.compacted_segment_histogram_int(
+        binned, gq, hq, w, slot, S, B, caps, levels=lv, tile_rows=128))
+    assert np.array_equal(a, b)

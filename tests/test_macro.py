@@ -78,6 +78,21 @@ def test_chunked_equals_per_iteration(case):
     assert mixed == per_iter, f"{case}: mixed chunks != per-iteration"
 
 
+@pytest.mark.parametrize("case", ["gbdt", "quant"])
+def test_chunked_equals_per_iteration_tiled(case, monkeypatch):
+    """Planner row tiling active (LGBM_TPU_TILE_ROWS forces tiles far
+    smaller than n): chunked == per-iteration must hold unchanged, and
+    the tiled models must equal the untiled ones byte-for-byte (the
+    kernels' pinned tile-major accumulation order)."""
+    params, y = PARITY_CASES[case]
+    untiled = _train(params, y, [1] * 12)
+    monkeypatch.setenv("LGBM_TPU_TILE_ROWS", "256")
+    per_iter = _train(params, y, [1] * 12)
+    chunked = _train(params, y, [8, 4])
+    assert chunked == per_iter, f"{case}: tiled chunk(8,4) != per-iter"
+    assert per_iter == untiled, f"{case}: tiled != untiled"
+
+
 def test_chunked_equals_per_iteration_deferred_host(monkeypatch):
     """The deferred-host banking path (accelerator default) slices the
     chunk bundle into per-iteration pending entries; the drain must see

@@ -18,14 +18,22 @@ Measures, on the live backend:
   quantization scales must track the f32 histogram within the
   discretization step.
 
+Tile-sweep mode (``--tile-sweep``, or the default small sweep inside
+``run_probe``): for each row-tile size, report the HBM planner's
+PREDICTED peak bytes (ops/planner.py memory model) next to the MEASURED
+per-pass time (and measured peak where the device allocator reports
+``memory_stats``) — the predicted-vs-measured table that validates the
+planner's model at bench time.
+
 The LAST stdout line is a single JSON object so bench.py's worker can
 bank it as a stage (``stage: hist_probe``, wired next to
-``dispatch_probe``).
+``dispatch_probe``; ``BENCH_SKIP_HIST_PROBE=1`` skips the stage).
 
 Usage:
     JAX_PLATFORMS=cpu python tools/hist_probe.py \
         [--rows 1000000] [--features 28] [--max-bin 63] \
-        [--quant-bins 4] [--leaves 255] [--reps 5]
+        [--quant-bins 4] [--leaves 255] [--reps 5] \
+        [--tile-sweep 0,262144,65536]
 """
 
 import argparse
@@ -39,8 +47,62 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _measured_peak():
+    """Allocator peak bytes, 0 when the backend reports none."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return int(stats.get("peak_bytes_in_use", 0))
+    except Exception:
+        return 0
+
+
+def tile_sweep(binned_t, grad, hess, ones, B, tiles, reps, sync,
+               leaves=255) -> list:
+    """Predicted-vs-measured table per row-tile size (see module doc).
+
+    The allocator's ``peak_bytes_in_use`` is a process-lifetime
+    HIGH-WATER mark that cannot be reset, so the sweep runs in ASCENDING
+    predicted-peak order (smallest tile first, untiled last): each
+    config's high-water then reflects its own pass rather than an
+    earlier larger one's.  The field is named
+    ``measured_peak_bytes_highwater`` to say exactly that.
+    """
+    import jax
+
+    from lightgbm_tpu.ops import histogram as H
+    from lightgbm_tpu.ops import planner as P
+
+    F, n = binned_t.shape
+    variant = H.resolve_hist_method("auto")
+
+    def predicted(t):
+        return P.predict_peak_bytes(n, F, B, num_leaves=leaves,
+                                    variant=variant, tile_rows=t,
+                                    use_pack=(t == 0))[0]
+
+    out = []
+    for t in sorted(set(tiles), key=predicted):
+        fn = jax.jit(lambda b, g, h, m, _t=t: H.build_histogram(
+            b, g, h, m, B, tile_rows=(_t or None)))
+        sync(fn(binned_t, grad, hess, ones))            # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            sync(fn(binned_t, grad, hess, ones))
+        ms = (time.perf_counter() - t0) / reps * 1e3
+        row = {"tile_rows": t,
+               "ms_per_pass": round(ms, 2),
+               "iters_per_sec": round(1e3 / max(ms, 1e-9), 2),
+               "predicted_peak_bytes": predicted(t)}
+        measured = _measured_peak()
+        if measured:
+            row["measured_peak_bytes_highwater"] = measured
+        out.append(row)
+    return out
+
+
 def run_probe(rows=1_000_000, features=28, max_bin=63, quant_bins=4,
-              leaves=255, reps=5) -> dict:
+              leaves=255, reps=5, tiles=None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -106,8 +168,17 @@ def run_probe(rows=1_000_000, features=28, max_bin=63, quant_bins=4,
     f32_payload = H.hist_payload_bytes(features, B)
     quant_payload = H.hist_payload_bytes(features, B, rows, quant_bins)
     levels_per_tree = max(1.0, float(np.log2(leaves)))
+    # ---- tile sweep: planner predicted-vs-measured per tile size ------
+    if tiles is None:
+        # default small sweep: untiled plus two power-of-two tiles
+        p2 = 1 << max((rows // 4).bit_length() - 1, 10)
+        tiles = [0, p2, max(p2 // 4, 1024)]
+    sweep = tile_sweep(binned_t, grad, hess, ones, B, tiles, reps, sync,
+                       leaves=leaves)
+
     out.update({
         "reps": reps,
+        "tile_sweep": sweep,
         "f32": {"ms_per_pass": round(f32_ms, 2),
                 "psum_payload_bytes": f32_payload,
                 "psum_payload_bytes_per_tree":
@@ -137,9 +208,15 @@ def main():
     ap.add_argument("--quant-bins", type=int, default=4)
     ap.add_argument("--leaves", type=int, default=255)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--tile-sweep", type=str, default=None,
+                    help="comma-separated row-tile sizes (0 = untiled); "
+                         "default: a small automatic sweep")
     args = ap.parse_args()
+    tiles = None
+    if args.tile_sweep:
+        tiles = [max(int(v), 0) for v in args.tile_sweep.split(",") if v]
     out = run_probe(args.rows, args.features, args.max_bin, args.quant_bins,
-                    args.leaves, args.reps)
+                    args.leaves, args.reps, tiles=tiles)
     print(json.dumps(out))
     return 0
 
