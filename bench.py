@@ -52,7 +52,17 @@ Env overrides: BENCH_ROWS, BENCH_TREES, BENCH_LEAVES, BENCH_BIN,
 BENCH_FORCE_CPU=1 (skip TPU entirely), BENCH_PROFILE=1 (jax.profiler trace
 to ./bench_trace), BENCH_TOTAL_BUDGET (s, default 6600),
 BENCH_CPU_ROWS / BENCH_CPU_TREES, BENCH_SMOKE_ROWS / BENCH_SMOKE_TREES,
-BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1, BENCH_SKIP_HIST_PROBE=1.
+BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1, BENCH_SKIP_HIST_PROBE=1,
+BENCH_SKIP_OBS=1 (skip the obs_dump stage AND the measured per-variant
+MFU table — lightgbm_tpu/obs/devprof.py cost_analysis numbers that
+otherwise ride in the full/fallback run_bench results as "mfu_measured",
+banked under their own journal key so retries replay them).
+Observability: LIGHTGBM_TPU_TRACE=1 records structured spans through
+every stage (bench phases, engine loop, dispatch/fetch, serving) and
+each run_bench stage dumps a Chrome-trace JSON (bench_trace_<stage>.json)
+plus a unified metrics-registry snapshot (bench_obs_metrics.json) next
+to the journal; "obs" in the stage JSON carries the file + a span-tree
+wall-clock coverage figure (docs/OBSERVABILITY.md).
 Memory/caching: LGBM_TPU_TILE_ROWS / LGBM_TPU_HBM_BYTES steer the HBM
 budget planner (ops/planner.py; the >=10M-row stage is gated on its
 feasibility verdict and degrades to smaller row tiles instead of
@@ -101,15 +111,11 @@ RANK_TREES = int(os.environ.get("BENCH_RANK_TREES", 100))
 
 TOTAL_BUDGET = float(os.environ.get("BENCH_TOTAL_BUDGET", 6600))
 
-# peak dense compute per chip for the MFU estimate (bf16, conservative)
-PEAK_FLOPS = {
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v4": 275e12,
-    "v5p": 459e12,
-    "v6": 918e12,
-}
-DEFAULT_PEAK = 197e12
+# peak dense compute per chip for the MFU estimate (bf16, conservative) —
+# ONE table, shared with the measured-MFU path (obs/devprof.py) so the
+# lower bound and the cost_analysis numbers use the same denominator
+from lightgbm_tpu.obs.devprof import (DEFAULT_PEAK_FLOPS as DEFAULT_PEAK,
+                                      PEAK_FLOPS, peak_flops_for)
 
 START = time.time()
 
@@ -260,14 +266,6 @@ def holdout_auc(booster, f, seed=1):
         npos * (len(yh) - npos))
 
 
-def peak_flops_for(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    return DEFAULT_PEAK
-
-
 def device_memory_stats():
     """peak/limit HBM from the device allocator; planner fallback.
 
@@ -342,7 +340,11 @@ def mfu_estimate(n, f, max_bin, leaves, sec_per_tree, peak):
     Per tree, the bucketed compaction processes ~n rows per frontier level
     and there are ~log2(leaves) levels, so R_total ~ n * log2(leaves).
     Counts ONLY histogram matmul FLOPs (the MXU work) — a lower bound.
+    The MEASURED per-variant numbers (compiler cost_analysis, not this
+    formula) ride alongside as ``mfu_measured`` (obs/devprof.py).
     """
+    if peak <= 0:          # a device the flops table doesn't know
+        return 0.0
     levels = max(1.0, np.log2(leaves))
     flops_per_tree = 2.0 * 3.0 * n * levels * f * (max_bin + 1)
     return flops_per_tree / max(sec_per_tree, 1e-9) / peak
@@ -394,87 +396,112 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
                  or os.environ.get("JAX_COMPILATION_CACHE_DIR") or None)
     cache_before = compile_cache_entries(cache_dir)
 
-    X, y = make_higgs_like(n, F)
-    params = {
-        "objective": "binary",
-        "num_leaves": leaves,
-        "learning_rate": 0.1,
-        "max_bin": max_bin,
-        "metric": "None",
-        "verbosity": -1,
-        # relaxed batched-frontier growth: ~8 rounds per 255-leaf tree vs
-        # 17 for the exact-prefix mode (measured, docs/PERFORMANCE.md);
-        # tree-shape deviation class = the reference's own CPU-vs-GPU
-        # difference, and the holdout AUC printed in the metric line is
-        # the quality check.  BENCH_EXTRA_PARAMS can override.
-        "tpu_tree_growth": "fast",
-    }
-    # measurement experiments: BENCH_EXTRA_PARAMS='{"tpu_tree_growth":
-    # "fast", ...}' merges into the training params
-    extra = os.environ.get("BENCH_EXTRA_PARAMS")
-    if extra:
-        params.update(json.loads(extra))
-    train_set = lgb.Dataset(X, label=y, params=params)
-    t_bin0 = time.perf_counter()
-    train_set.construct()          # binning happens here, outside the clock
-    bin_seconds = time.perf_counter() - t_bin0
-    del X
+    # structured tracing (lightgbm_tpu/obs/): with LIGHTGBM_TPU_TRACE set
+    # the whole stage records phase spans (+ the engine/grower/serving
+    # spans underneath) and dumps a Chrome-trace JSON next to the journal
+    from lightgbm_tpu.obs.trace import global_tracer, instant as obs_instant
+    from lightgbm_tpu.obs.trace import span as obs_span, span_coverage
+    # stages share one process tracer: mark here so this stage's dump and
+    # coverage cover ONLY its own slice of events
+    trace_mark = global_tracer.mark()
+    root_span = obs_span("bench.run", rows=n, trees=trees, tag=tag)
+    root_span.__enter__()
+    try:
 
-    booster = lgb.Booster(params=params, train_set=train_set)
-    t_c0 = time.perf_counter()
-    booster.update()               # iteration 1: triggers XLA compile
-    dsync(booster.boosting.train_score)
-    compile_seconds = time.perf_counter() - t_c0
-    if compile_done is not None:
-        compile_done.set()
-    if cancel is not None and cancel.is_set():
-        return {"cancelled_after_compile": True,
-                "compile_seconds": round(compile_seconds, 2)}
-
-    profile = os.environ.get("BENCH_PROFILE") == "1"
-    if profile:
-        jax.profiler.start_trace(os.path.join(REPO, "bench_trace"))
-
-    t0 = time.perf_counter()
-    for _ in range(trees - 1):
-        booster.update()
-    dsync(booster.boosting.train_score)
-    elapsed = (time.perf_counter() - t0) * trees / max(trees - 1, 1)
-
-    if profile:
-        jax.profiler.stop_trace()
-
-    sec_per_tree = elapsed / trees
-    auc = holdout_auc(booster, F)      # metric BEFORE the chunked segment
-    # extends the model, so the reported AUC stays comparable to baselines
-
-    # fused macro-steps (lightgbm_tpu/boosting/macro.py): continue the
-    # SAME booster with update_chunk so training compute matches and only
-    # the dispatch count changes; LGBM_TPU_CHUNK=0 (the compile-variant
-    # ladder's chunk-off rung) skips this segment
-    from lightgbm_tpu.boosting.macro import chunk_cap, pow2_chunk
-    chunk_result = None
-    cap = chunk_cap()
-    if cap > 1 and booster.boosting.chunk_supported():
-        # whole chunks only: each distinct chunk size is a separate
-        # compiled shape, so a ragged tail step would put an XLA compile
-        # inside the clock and corrupt iters_per_sec_chunked
-        c = pow2_chunk(trees, cap)
-        n_chunks = max(trees // c, 1)
-        chunk_iters = n_chunks * c
-        booster.update_chunk(c)            # chunk program compile
-        dsync(booster.boosting.train_score)
-        t0 = time.perf_counter()
-        for _ in range(n_chunks):
-            booster.update_chunk(c)
-        dsync(booster.boosting.train_score)
-        chunk_s = time.perf_counter() - t0
-        chunk_result = {
-            "chunk_size": c,
-            "chunk_iters": chunk_iters,
-            "iters_per_sec_chunked": round(chunk_iters / chunk_s, 3),
-            "sec_per_tree_chunked": round(chunk_s / chunk_iters, 4),
+        with obs_span("bench.make_data", rows=n):
+            X, y = make_higgs_like(n, F)
+        params = {
+            "objective": "binary",
+            "num_leaves": leaves,
+            "learning_rate": 0.1,
+            "max_bin": max_bin,
+            "metric": "None",
+            "verbosity": -1,
+            # relaxed batched-frontier growth: ~8 rounds per 255-leaf tree vs
+            # 17 for the exact-prefix mode (measured, docs/PERFORMANCE.md);
+            # tree-shape deviation class = the reference's own CPU-vs-GPU
+            # difference, and the holdout AUC printed in the metric line is
+            # the quality check.  BENCH_EXTRA_PARAMS can override.
+            "tpu_tree_growth": "fast",
         }
+        # measurement experiments: BENCH_EXTRA_PARAMS='{"tpu_tree_growth":
+        # "fast", ...}' merges into the training params
+        extra = os.environ.get("BENCH_EXTRA_PARAMS")
+        if extra:
+            params.update(json.loads(extra))
+        train_set = lgb.Dataset(X, label=y, params=params)
+        t_bin0 = time.perf_counter()
+        with obs_span("bench.construct"):
+            train_set.construct()      # binning happens here, outside the clock
+        bin_seconds = time.perf_counter() - t_bin0
+        del X
+
+        with obs_span("bench.build_booster"):
+            booster = lgb.Booster(params=params, train_set=train_set)
+        t_c0 = time.perf_counter()
+        with obs_span("bench.compile"):
+            booster.update()           # iteration 1: triggers XLA compile
+            dsync(booster.boosting.train_score)
+        compile_seconds = time.perf_counter() - t_c0
+        if compile_done is not None:
+            compile_done.set()
+        if cancel is not None and cancel.is_set():
+            root_span.set(cancelled=True)
+            return {"cancelled_after_compile": True,
+                    "compile_seconds": round(compile_seconds, 2)}
+
+        profile = os.environ.get("BENCH_PROFILE") == "1"
+        if profile:
+            jax.profiler.start_trace(os.path.join(REPO, "bench_trace"))
+
+        t0 = time.perf_counter()
+        with obs_span("bench.train_loop", trees=trees - 1):
+            for _ in range(trees - 1):
+                booster.update()
+            dsync(booster.boosting.train_score)
+        elapsed = (time.perf_counter() - t0) * trees / max(trees - 1, 1)
+
+        if profile:
+            jax.profiler.stop_trace()
+
+        sec_per_tree = elapsed / trees
+        with obs_span("bench.holdout_auc"):
+            auc = holdout_auc(booster, F)  # metric BEFORE the chunked segment
+        # extends the model, so the reported AUC stays comparable to baselines
+
+        # fused macro-steps (lightgbm_tpu/boosting/macro.py): continue the
+        # SAME booster with update_chunk so training compute matches and only
+        # the dispatch count changes; LGBM_TPU_CHUNK=0 (the compile-variant
+        # ladder's chunk-off rung) skips this segment
+        from lightgbm_tpu.boosting.macro import chunk_cap, pow2_chunk
+        chunk_result = None
+        cap = chunk_cap()
+        with obs_span("bench.chunked"):
+            if cap > 1 and booster.boosting.chunk_supported():
+                # whole chunks only: each distinct chunk size is a separate
+                # compiled shape, so a ragged tail step would put an XLA compile
+                # inside the clock and corrupt iters_per_sec_chunked
+                c = pow2_chunk(trees, cap)
+                n_chunks = max(trees // c, 1)
+                chunk_iters = n_chunks * c
+                booster.update_chunk(c)            # chunk program compile
+                dsync(booster.boosting.train_score)
+                t0 = time.perf_counter()
+                for _ in range(n_chunks):
+                    booster.update_chunk(c)
+                dsync(booster.boosting.train_score)
+                chunk_s = time.perf_counter() - t0
+                chunk_result = {
+                    "chunk_size": c,
+                    "chunk_iters": chunk_iters,
+                    "iters_per_sec_chunked": round(chunk_iters / chunk_s, 3),
+                    "sec_per_tree_chunked": round(chunk_s / chunk_iters, 4),
+                }
+    except BaseException as e:
+        root_span.set(error=type(e).__name__)
+        raise
+    finally:
+        root_span.__exit__(None, None, None)
 
     result = {
         "metric": f"synthetic-HIGGS {n}x{F} train wall-clock, "
@@ -514,7 +541,93 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
     result["mfu_histogram_lower_bound"] = round(
         mfu_estimate(n, F, max_bin, leaves, sec_per_tree, peak), 4)
     result["peak_flops_assumed"] = peak
-    result.update(device_memory_stats())
+    mem = device_memory_stats()
+    result.update(mem)
+
+    # planner predicted-vs-measured peak bytes as a first-class event +
+    # result field (docs/OBSERVABILITY.md): the number that says whether
+    # the HBM model (ops/planner.py) is still honest on this backend
+    eff_plan = getattr(booster.boosting, "hist_plan", None) or plan
+    measured_peak = int(mem.get("peak_hbm_bytes", 0))
+    pvm = {
+        "predicted_peak_bytes": int(eff_plan.predicted_peak_bytes),
+        "measured_peak_bytes": measured_peak,
+        "ratio": (round(measured_peak / eff_plan.predicted_peak_bytes, 3)
+                  if measured_peak and eff_plan.predicted_peak_bytes
+                  else None),
+    }
+    result["hbm_predicted_vs_measured"] = pvm
+    obs_instant("hbm.peak", **pvm)
+    from lightgbm_tpu.obs.metrics import global_registry as obs_registry
+    obs_registry.gauge("hbm_measured_peak_bytes").set(measured_peak)
+
+    # MEASURED per-variant MFU / HBM-bandwidth utilization from the
+    # compiler's own cost model (obs/devprof.py) — the number the
+    # lower-bound estimate above only brackets.  Not in the smoke stage
+    # (18 variant compiles would dwarf the canary it rides on) and banked
+    # under its own journal key so a full-stage retry replays it instead
+    # of paying the compiles again.  BENCH_SKIP_OBS=1 skips.
+    if os.environ.get("BENCH_SKIP_OBS") != "1" and tag != "-smoke":
+        mfu_rows = min(n, 1_000_000)
+        mfu_key = f"mfu_measured@{mfu_rows}"
+        # the journal belongs to the TPU worker: the CPU-fallback process
+        # has a different workload fingerprint, and a journal_put from it
+        # would atomically REWRITE the file and wipe every banked TPU stage
+        in_worker = os.environ.get("BENCH_STAGE") == "tpu-worker"
+
+        def _table_ok(t):
+            return any(isinstance(v, dict) and "seconds_per_call" in v
+                       for v in t.values())
+
+        if not in_worker:
+            # the CPU-fallback/pipeline path cannot bank (different
+            # journal fingerprint): keep its un-replayable table cheap
+            mfu_rows = min(mfu_rows, 200_000)
+        banked = journal_stages().get(mfu_key) if in_worker else None
+        if banked is not None and _table_ok(banked):
+            result["mfu_measured"] = banked
+        else:
+            try:
+                from lightgbm_tpu.obs.devprof import \
+                    histogram_utilization_table
+                with obs_span("bench.mfu_measured"):
+                    result["mfu_measured"] = histogram_utilization_table(
+                        rows=mfu_rows, features=F,
+                        num_bins=max_bin + 1,
+                        reps=2 if in_worker else 1)
+                # bank only a table with at least one real measurement —
+                # an all-error table must retry next run (the journal's
+                # errors-never-banked rule)
+                if in_worker and _table_ok(result["mfu_measured"]):
+                    journal_put(mfu_key, result["mfu_measured"])
+            except Exception as e:  # never fail the stage for telemetry
+                result["mfu_measured"] = {"error": str(e)[-200:]}
+
+    # trace file + unified-registry snapshot alongside the journal entry
+    from lightgbm_tpu.utils.timer import global_timer
+    if global_timer.enabled:
+        global_timer.publish(obs_registry)
+    if global_tracer.enabled:
+        safe_tag = (tag or "-full").strip("-").replace("/", "_") or "full"
+        evs = global_tracer.since(trace_mark)   # THIS stage's slice only
+        try:
+            result["obs"] = {
+                "trace_file": global_tracer.dump(
+                    os.path.join(REPO, f"bench_trace_{safe_tag}.json"),
+                    events=evs),
+                "trace_events": len(evs),
+                "trace_coverage": round(
+                    span_coverage(evs, "bench.run") or 0.0, 4),
+            }
+        except OSError as e:
+            result["obs"] = {"error": str(e)[-200:]}
+    try:
+        from lightgbm_tpu.utils.file_io import write_atomic
+        snap_path = os.path.join(REPO, "bench_obs_metrics.json")
+        write_atomic(snap_path, obs_registry.dump_json())
+        result["obs_metrics_file"] = snap_path
+    except OSError:
+        pass
     return result
 
 
@@ -848,6 +961,18 @@ def tpu_worker():
             return hist_run(rows=min(N, 1_000_000), features=F,
                             max_bin=MAX_BIN, leaves=LEAVES)
         run_stage("hist_probe", _hist)
+
+    # whole-plane observability smoke (tools/obs_dump.py): a tiny
+    # instrumented train+serve cycle dumping trace/metrics/prometheus
+    # artifacts — cheap, banked before the long stages; errors are never
+    # journaled (run_stage), so a failed dump retries on the next run
+    if os.environ.get("BENCH_SKIP_OBS") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _obs():
+            from obs_dump import run_dump
+            return run_dump(out_dir=REPO, rows=20_000, trees=8)
+        run_stage("obs_dump", _obs)
 
     if os.environ.get("BENCH_SKIP_SMOKE") != "1":
         smoke = run_stage(

@@ -29,6 +29,8 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset, FeatureMeta
+from ..obs.metrics import global_registry as _obs_registry
+from ..obs.trace import span as _span
 from ..ops.histogram import (on_accelerator, quantize_gradients,
                              take_from_table)
 from ..grower import GrowerConfig, TreeArrays, grow_tree, predict_tree_binned
@@ -618,6 +620,24 @@ class GBDT:
             shard_feats //= max(int(self._mesh.shape[self._feature_axis]), 1)
         self.grower_cfg, self.hist_plan = apply_plan(
             self.grower_cfg, shard_rows, shard_feats)
+        # unified-registry training gauges (the planner.plan trace event
+        # itself is emitted inside apply_plan; the bench logs the measured
+        # peak next to it — docs/OBSERVABILITY.md predicted-vs-measured)
+        _obs_registry.gauge("train_hist_method").set(
+            self.hist_plan.variant)   # resolved variant, never "auto"
+        _obs_registry.gauge("train_tile_rows").set(self.hist_plan.tile_rows)
+        _obs_registry.gauge("train_hist_predicted_peak_bytes").set(
+            int(self.hist_plan.predicted_peak_bytes))
+        _obs_registry.gauge("train_hbm_budget_bytes").set(
+            int(self.hist_plan.budget_bytes))
+        if nmach > 1:
+            from ..ops.histogram import hist_payload_bytes
+            _obs_registry.gauge("train_psum_payload_bytes").set(
+                hist_payload_bytes(
+                    shard_feats, self.num_bins,
+                    rows_global=self._n_pad,
+                    quant_bins=(cc.num_grad_quant_bins if quant_on
+                                else None)))
         if not self.hist_plan.feasible:
             log_warning(
                 "HBM planner: predicted peak "
@@ -1157,7 +1177,8 @@ class GBDT:
         with global_timer.section("GBDT::Bagging"):
             mask = self._bagging_mask(self.iter)
 
-        with global_timer.section("TreeLearner::Train(dispatch)"):
+        with global_timer.section("TreeLearner::Train(dispatch)"), \
+                _span("gbdt.dispatch", iteration=self.iter):
             (self.train_score, stacked, leaf_ids, cu, cr,
              self._quant_scales) = self._iter_fn(
                 self.binned, self.train_score, mask, grad, hess,
@@ -1216,7 +1237,8 @@ class GBDT:
         from ONE stacked ``[c, ...]`` device tree bundle.  Same timer tag
         as _finish_iter — it is the same role, amortized over c."""
         from ..utils.timer import global_timer
-        with global_timer.section("GBDT::FinishIter(host trees)"):
+        with global_timer.section("GBDT::FinishIter(host trees)"), \
+                _span("macro.host_fetch", c=c, it0=it0):
             return self._finish_chunk_inner(stacked_seq, c, shrinks, it0)
 
     def _chunk_slice(self, stacked_seq, j: int):
@@ -1346,6 +1368,10 @@ class GBDT:
         """
         if not self._pending:
             return
+        with _span("gbdt.drain_pending", pending=len(self._pending)):
+            self._drain_pending_inner()
+
+    def _drain_pending_inner(self) -> None:
         K = self.num_tree_per_iteration
         pend = self._pending
         self._pending = []
@@ -1396,7 +1422,8 @@ class GBDT:
         the (tiny) tree arrays, first-iteration bias folding, valid-score
         updates.  Returns True when training should stop."""
         from ..utils.timer import global_timer
-        with global_timer.section("GBDT::FinishIter(host trees)"):
+        with global_timer.section("GBDT::FinishIter(host trees)"), \
+                _span("gbdt.finish_iter", iteration=self.iter):
             return self._finish_iter_inner(stacked)
 
     def _finish_iter_inner(self, stacked) -> bool:
@@ -1682,7 +1709,8 @@ class GBDT:
 
     def _eval(self, dataname, score, metrics, objective):
         from ..utils.timer import global_timer
-        with global_timer.section("GBDT::EvalMetrics"):
+        with global_timer.section("GBDT::EvalMetrics"), \
+                _span("gbdt.eval", dataset=dataname):
             return self._eval_inner(dataname, score, metrics, objective)
 
     def _eval_inner(self, dataname, score, metrics, objective):

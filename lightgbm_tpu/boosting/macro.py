@@ -254,8 +254,17 @@ def run_chunk(b, c: int, lrs: Optional[Sequence[float]] = None) -> bool:
     if b._macro_chunk_jit is None:
         b._macro_chunk_jit = build_chunk_program(b)
     cu, cr = b._cegb_state
+    from ..obs.metrics import global_registry as _obs_registry
+    from ..obs.trace import span as _span
     from ..utils.timer import global_timer
-    with global_timer.section("TreeLearner::Train(dispatch)"):
+    # chunk-size telemetry on the unified registry (obs_dump / bench
+    # journal it instead of scraping logs)
+    _obs_registry.counter("train_chunk_dispatches").inc()
+    _obs_registry.histogram(
+        "train_chunk_size",
+        buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)).observe(c)
+    with global_timer.section("TreeLearner::Train(dispatch)"), \
+            _span("macro.dispatch", c=c, it0=it0):
         (b.train_score, cu, cr, stacked_seq, qss) = b._macro_chunk_jit(
             b.binned, b.train_score, cu, cr, np.int32(c), xs,
             b._macro_ctx["label"], b._macro_ctx["weight"], grad_c, hess_c)

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import time
 from typing import Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -16,6 +17,8 @@ from . import callback as callback_mod
 from .basic import Booster
 from .config import Config
 from .dataset import Dataset
+from .obs.metrics import global_registry as _obs_registry
+from .obs.trace import span as _span
 
 
 def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
@@ -208,56 +211,78 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
 
     evaluation_result_list = []
     i = start_iter
-    while i < num_boost_round:
-        c = 1
-        if can_chunk:
-            d = num_boost_round - i
-            if eval_possible:
-                d = min(d, mf - (i % mf))
-            if ckpt_mgr is not None:
-                d = min(d, snapshot_freq - (i % snapshot_freq))
-            c = pow2_chunk(d, cap)
-        if c > 1:
-            lrs = ([_lr_at(j) for j in range(i, i + c)] if lr_cbs else None)
-            finished = booster.update_chunk(c, lrs)
-            if lrs is not None:
-                # replicate the last reset_parameter side effects so the
-                # post-chunk state matches per-iteration training
-                booster.reset_parameter({"learning_rate": lrs[-1]})
-                params["learning_rate"] = lrs[-1]
-            i += c
-        else:
-            for cb in cbs_before:
-                cb(callback_mod.CallbackEnv(booster, params, i, 0,
-                                            num_boost_round, None))
-            finished = booster.update(fobj=fobj)
-            i += 1
-        j = i - 1        # last iteration trained this turn
-        evaluation_result_list = []
-        if eval_possible and (j + 1) % mf == 0:
-            if cfg.is_provide_training_metric or train_in_valid:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        early_stopped = False
-        try:
-            for cb in cbs_after:
-                cb(callback_mod.CallbackEnv(booster, params, j, 0,
-                                            num_boost_round, evaluation_result_list))
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for item in e.best_score:
-                booster.best_score.setdefault(item[0], collections.OrderedDict())
-                booster.best_score[item[0]][item[1]] = item[2]
-            early_stopped = True
-        # snapshot even on the iteration that triggered early stop
-        # (reference: GBDT::Train reaches the snapshot write, gbdt.cpp:259-263)
-        if ckpt_mgr is not None and (j + 1) % snapshot_freq == 0:
-            ckpt_mgr.save(
-                booster, iteration=j + 1,
-                engine_state={"callbacks": _collect_callback_states(
-                    cbs_before + cbs_after)})
-        if early_stopped or finished:
-            break
+    t_loop0 = time.perf_counter()
+    train_root = _span("engine.train", start_iter=start_iter,
+                       num_boost_round=num_boost_round)
+    train_root.__enter__()
+    try:
+        while i < num_boost_round:
+            c = 1
+            if can_chunk:
+                d = num_boost_round - i
+                if eval_possible:
+                    d = min(d, mf - (i % mf))
+                if ckpt_mgr is not None:
+                    d = min(d, snapshot_freq - (i % snapshot_freq))
+                c = pow2_chunk(d, cap)
+            if c > 1:
+                lrs = ([_lr_at(j) for j in range(i, i + c)] if lr_cbs else None)
+                with _span("engine.step", i=i, c=c):
+                    finished = booster.update_chunk(c, lrs)
+                if lrs is not None:
+                    # replicate the last reset_parameter side effects so the
+                    # post-chunk state matches per-iteration training
+                    booster.reset_parameter({"learning_rate": lrs[-1]})
+                    params["learning_rate"] = lrs[-1]
+                i += c
+            else:
+                for cb in cbs_before:
+                    cb(callback_mod.CallbackEnv(booster, params, i, 0,
+                                                num_boost_round, None))
+                with _span("engine.step", i=i, c=1):
+                    finished = booster.update(fobj=fobj)
+                i += 1
+            j = i - 1        # last iteration trained this turn
+            evaluation_result_list = []
+            if eval_possible and (j + 1) % mf == 0:
+                with _span("engine.eval", iteration=j):
+                    if cfg.is_provide_training_metric or train_in_valid:
+                        evaluation_result_list.extend(booster.eval_train(feval))
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+            early_stopped = False
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(booster, params, j, 0,
+                                                num_boost_round, evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for item in e.best_score:
+                    booster.best_score.setdefault(item[0], collections.OrderedDict())
+                    booster.best_score[item[0]][item[1]] = item[2]
+                early_stopped = True
+            # snapshot even on the iteration that triggered early stop
+            # (reference: GBDT::Train reaches the snapshot write, gbdt.cpp:259-263)
+            if ckpt_mgr is not None and (j + 1) % snapshot_freq == 0:
+                ckpt_mgr.save(
+                    booster, iteration=j + 1,
+                    engine_state={"callbacks": _collect_callback_states(
+                        cbs_before + cbs_after)})
+            if early_stopped or finished:
+                break
+    except BaseException as e:
+        train_root.set(error=type(e).__name__)
+        raise
+    finally:
+        train_root.__exit__(None, None, None)
+    # training-loop instruments on the unified process registry
+    # (docs/OBSERVABILITY.md): cheap host-side gauges, no device work
+    wall = time.perf_counter() - t_loop0
+    trained = i - start_iter
+    if trained > 0:
+        _obs_registry.counter("train_iterations_total").inc(trained)
+        if wall > 0:
+            _obs_registry.gauge("train_trees_per_sec").set(round(
+                trained * booster.boosting.num_tree_per_iteration / wall, 3))
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
         for item in evaluation_result_list:

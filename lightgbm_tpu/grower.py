@@ -278,7 +278,20 @@ def row_goes_left(col: jax.Array, node_thr: jax.Array, node_dl: jax.Array,
     return jnp.where(node_cat, cat_left, num_left)
 
 
-def grow_tree(
+def grow_tree(binned_t, *args, **kwargs):
+    """Grow one tree (full signature/contract: ``_grow_tree_traced``).
+
+    The wrapper records a ``trace.grow_tree`` span around program-trace
+    construction: the body runs on the HOST once per XLA compile (cached
+    executions never re-enter it), so the span attributes compile-side
+    cost to the grower — the seam the timer table cannot see
+    (docs/OBSERVABILITY.md)."""
+    from .obs.trace import span as _span
+    with _span("trace.grow_tree", rows=int(binned_t.shape[1])):
+        return _grow_tree_traced(binned_t, *args, **kwargs)
+
+
+def _grow_tree_traced(
     binned_t: jax.Array,        # [F, n] uint8/16 feature-major (F, n
                                 #   possibly per-shard; see ops/histogram.py
                                 #   LAYOUT DOCTRINE)

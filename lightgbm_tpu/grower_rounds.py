@@ -70,7 +70,17 @@ def _pad_scatter(arr: jax.Array, idx: jax.Array, val: jax.Array,
     return ext.at[safe].set(val.astype(arr.dtype))[:M]
 
 
-def grow_tree_rounds(
+def grow_tree_rounds(binned_t, *args, **kwargs):
+    """Grow one tree, batched-frontier (full signature:
+    ``_grow_tree_rounds_traced``).  Span-wrapped like ``grow_tree``:
+    records trace-construction time per compile (docs/OBSERVABILITY.md).
+    """
+    from .obs.trace import span as _span
+    with _span("trace.grow_tree_rounds", rows=int(binned_t.shape[1])):
+        return _grow_tree_rounds_traced(binned_t, *args, **kwargs)
+
+
+def _grow_tree_rounds_traced(
     binned_t: jax.Array,        # [G, n] uint8/16 feature-major (rows
                                 #   possibly per-shard)
     grad: jax.Array,            # [n] f32

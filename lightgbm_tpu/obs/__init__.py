@@ -1,0 +1,32 @@
+"""Unified observability plane: structured tracing, measured device
+profiling, and the single process metrics registry (docs/OBSERVABILITY.md).
+
+Three pillars, shared by training, serving, resilience and the bench:
+
+- ``obs.trace`` — thread-safe span recorder (``span("grow_tree")``
+  context managers through the hot seams) emitting Chrome trace-event /
+  Perfetto-compatible JSON; gated by ``LIGHTGBM_TPU_TRACE`` to one
+  attribute check when disabled;
+- ``obs.devprof`` — measured per-program MFU / HBM-bandwidth utilization
+  from ``Compiled.cost_analysis()`` (the compiler's own FLOP/byte
+  counts), plus optional ``jax.profiler`` capture;
+- ``obs.metrics`` — the ``MetricsRegistry`` promoted from serving as the
+  process-wide instrument registry (``global_registry``), with JSON
+  snapshots and Prometheus text exposition.
+
+``trace``/``metrics`` are stdlib-only; ``devprof`` imports jax lazily.
+"""
+
+from .metrics import (LATENCY_BUCKETS_MS, RATIO_BUCKETS, Counter, Gauge,
+                      Histogram, MetricsRegistry, get_registry,
+                      global_registry)
+from .trace import (Tracer, global_tracer, instant, span, span_coverage,
+                    trace_enabled, trace_path)
+
+__all__ = [
+    "span", "instant", "trace_enabled", "trace_path", "span_coverage",
+    "Tracer", "global_tracer",
+    "MetricsRegistry", "global_registry", "get_registry",
+    "Counter", "Gauge", "Histogram",
+    "LATENCY_BUCKETS_MS", "RATIO_BUCKETS",
+]

@@ -1,0 +1,298 @@
+"""Measured device profiling: per-program FLOPs/bytes from XLA's
+``Compiled.cost_analysis()`` -> measured MFU and HBM-bandwidth
+utilization.
+
+``bench.py``'s ``mfu_histogram_lower_bound`` hand-counts only the
+histogram-matmul FLOPs and divides by a wall-clock that smears compile
+and host time in — a lower bound good for trendlines, useless for
+finding where the other 99.9% of the chip went.  This module asks the
+compiler instead: ``jit(f).lower(*args).compile().cost_analysis()``
+reports the FLOPs and bytes the COMPILED program actually executes
+(post-fusion, post-DCE), so
+
+    mfu      = flops / seconds / peak_flops
+    hbm_util = bytes_accessed / seconds / peak_hbm_bandwidth
+
+are measured per program variant, not estimated per formula.  Caveats
+(docs/OBSERVABILITY.md): under async dispatch ``seconds`` must come from
+a host-blocking sync (callers pass the same ``dsync`` trick bench.py
+uses — ``block_until_ready`` is a no-op on the tunneled backend), and
+``cost_analysis`` availability varies by backend/jax version — every
+helper degrades to ``{}``/partial results instead of raising.
+
+``jax.profiler`` trace capture (the XLA-level timeline, complementary to
+obs/trace.py's host spans) is wrapped behind ``profiler_trace`` with the
+same degrade-gracefully contract.  jax imports are lazy: importing this
+module never initializes a backend.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+# peak dense compute per chip (bf16/int8 systolic, conservative) — shared
+# with bench.py's lower-bound estimate
+PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+}
+DEFAULT_PEAK_FLOPS = 197e12
+
+# peak HBM bandwidth per chip, bytes/s (public spec sheets)
+PEAK_HBM_BW = {
+    "v5 lite": 819e9,
+    "v5e": 819e9,
+    "v4": 1228e9,
+    "v5p": 2765e9,
+    "v6": 1640e9,
+}
+DEFAULT_PEAK_HBM_BW = 819e9
+
+
+def _device_kind(device=None) -> str:
+    if device is None:
+        try:
+            import jax
+            device = jax.devices()[0]
+        except Exception:
+            return ""
+    return str(getattr(device, "device_kind", "")).lower()
+
+
+def peak_flops_for(device=None) -> float:
+    kind = _device_kind(device)
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK_FLOPS
+
+
+def peak_hbm_bw_for(device=None) -> float:
+    kind = _device_kind(device)
+    for key, val in PEAK_HBM_BW.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK_HBM_BW
+
+
+def normalize_cost(ca) -> dict:
+    """Flatten a ``cost_analysis()`` result (dict, or list-of-dict on
+    older jax) into {str: float}; {} when unavailable."""
+    if ca is None:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    out = {}
+    try:
+        items = dict(ca).items()
+    except Exception:
+        return {}
+    for k, v in items:
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def program_cost(fn: Callable, *args) -> dict:
+    """{"flops", "bytes_accessed"} of the compiled program for ``fn`` at
+    ``args``'s shapes ({} when the backend reports no cost model).
+
+    ``fn`` may be a plain callable or an already-``jax.jit``-wrapped one;
+    the AOT path (``lower().compile()``) hits the persistent compile
+    cache, so asking for the cost of an already-trained program is cheap.
+    """
+    try:
+        import jax
+        jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = jfn.lower(*args).compile()
+        ca = normalize_cost(compiled.cost_analysis())
+    except Exception:
+        return {}
+    if not ca:
+        return {}
+    out = {}
+    if "flops" in ca:
+        out["flops"] = ca["flops"]
+    ba = ca.get("bytes accessed", ca.get("bytes_accessed"))
+    if ba is not None:
+        out["bytes_accessed"] = ba
+    return out
+
+
+def _default_sync(out) -> None:
+    """Block until device work behind ``out`` is done.  On the tunneled
+    axon backend ``block_until_ready`` is a no-op (measured, bench.py
+    ``dsync``), so pull a tiny reduction of every array leaf instead."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "astype"):
+            np.asarray(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def measure_program(fn: Callable, args: tuple, reps: int = 3,
+                    sync: Optional[Callable] = None,
+                    device=None) -> dict:
+    """Compile ``fn(*args)``, read its cost analysis, time ``reps``
+    executions, and report measured utilization::
+
+        {"flops", "bytes_accessed",            # from cost_analysis
+         "seconds_per_call", "mfu", "hbm_gbps", "hbm_util",
+         "peak_flops", "peak_hbm_bw"}
+
+    Cost keys are absent when the backend has no cost model; timing keys
+    are always present.  ``sync`` defaults to a host-pulling reduction
+    (see ``_default_sync``).
+    """
+    import jax
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    sync = sync or _default_sync
+    # ONE compile: the AOT executable serves both the cost analysis and
+    # the timed runs (jit'ing again would pay a second, discarded compile
+    # for every variant — compile time dominates bench stages)
+    out = {}
+    runner = jfn
+    try:
+        compiled = jfn.lower(*args).compile()
+        ca = normalize_cost(compiled.cost_analysis())
+        if "flops" in ca:
+            out["flops"] = ca["flops"]
+        ba = ca.get("bytes accessed", ca.get("bytes_accessed"))
+        if ba is not None:
+            out["bytes_accessed"] = ba
+        compiled(*args)                  # callable-executable probe
+        runner = compiled
+    except Exception:
+        runner = jfn                     # backend without AOT/cost model
+    sync(runner(*args))                  # warm outside the clock
+    t0 = time.perf_counter()
+    for _ in range(max(reps, 1)):
+        sync(runner(*args))
+    sec = (time.perf_counter() - t0) / max(reps, 1)
+    out["seconds_per_call"] = sec
+    pf = peak_flops_for(device)
+    pb = peak_hbm_bw_for(device)
+    out["peak_flops"] = pf
+    out["peak_hbm_bw"] = pb
+    if "flops" in out and sec > 0:
+        out["mfu"] = out["flops"] / sec / pf
+    if "bytes_accessed" in out and sec > 0:
+        out["hbm_gbps"] = out["bytes_accessed"] / sec / 1e9
+        out["hbm_util"] = out["bytes_accessed"] / sec / pb
+    return out
+
+
+@contextmanager
+def profiler_trace(logdir: str):
+    """Optional ``jax.profiler`` capture around a block; yields True when
+    the profiler started (False = unavailable on this backend — the block
+    still runs)."""
+    started = False
+    try:
+        import jax
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield started
+    finally:
+        if started:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def histogram_utilization_table(rows: int = 200_000, features: int = 28,
+                                num_bins: int = 64, slots: int = 8,
+                                reps: int = 2, tile_rows: Optional[int] = None,
+                                seed: int = 0, quant: bool = True) -> dict:
+    """Measured per-kernel-variant utilization table for the histogram
+    family: {matmul, matmul_f32, scatter, sorted, expanded} x {f32, quant}
+    x {untiled, tiled} -> ``measure_program`` dicts.
+
+    This replaces the bench's hand-derived MFU lower bound with the
+    compiler's own FLOP/byte counts per compiled variant — the numbers
+    the Pallas-megakernel work (ROADMAP item 2) is steered by.  A variant
+    unsupported on the backend reports ``{"error": ...}`` instead of
+    failing the table.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import histogram as H
+
+    rng = np.random.RandomState(seed)
+    n, F, B = int(rows), int(features), int(num_bins)
+    binned = jnp.asarray(
+        rng.randint(0, B, (F, n), dtype=np.int64), jnp.uint8)
+    grad = jnp.asarray(rng.randn(n), jnp.float32)
+    hess = jnp.abs(grad) + 0.1
+    mask = jnp.ones((n,), jnp.float32)
+    slot = jnp.asarray(rng.randint(0, slots, n, dtype=np.int64), jnp.int32)
+    gq = jnp.asarray(rng.randint(-8, 8, n, dtype=np.int64), jnp.int8)
+    hq = jnp.asarray(rng.randint(0, 8, n, dtype=np.int64), jnp.int8)
+    member = jnp.ones((n,), bool)
+
+    if tile_rows is None:
+        tile_rows = 1 << max((n // 4).bit_length() - 1, 10)
+    tile_rows = max(min(int(tile_rows), n), 1)
+
+    def fam(tile):
+        ms = {
+            "f32/matmul": lambda b, g, h, m: H.build_histogram(
+                b, g, h, m, B, method="matmul", tile_rows=tile),
+            "f32/matmul_f32": lambda b, g, h, m: H.build_histogram(
+                b, g, h, m, B, method="matmul_f32", tile_rows=tile),
+            "f32/scatter": lambda b, g, h, m: H.build_histogram(
+                b, g, h, m, B, method="scatter", tile_rows=tile),
+            "f32/sorted": lambda b, g, h, m: H.segment_histogram_sorted(
+                b, g, h, m, slot, slots, B, tile_rows=tile),
+            "f32/expanded": lambda b, g, h, m: H.segment_histogram_expanded(
+                b, g, h, m, slot, B, tile_rows=tile),
+        }
+        if quant:
+            ms.update({
+                "quant/matmul_int8": lambda b, g, h, m: H.build_histogram_int(
+                    b, gq, hq, member, B, method="matmul_int8",
+                    tile_rows=tile),
+                "quant/scatter_int": lambda b, g, h, m: H.build_histogram_int(
+                    b, gq, hq, member, B, method="scatter_int",
+                    tile_rows=tile),
+                "quant/sorted": lambda b, g, h, m:
+                    H.segment_histogram_sorted_int(
+                        b, gq, hq, slot, slots, B, tile_rows=tile),
+                "quant/expanded": lambda b, g, h, m:
+                    H.segment_histogram_expanded_int(
+                        b, gq, hq, member, slot, B, tile_rows=tile),
+            })
+        return ms
+
+    device = None
+    try:
+        device = jax.devices()[0]
+    except Exception:
+        pass
+    out = {"rows": n, "features": F, "num_bins": B, "slots": slots,
+           "tile_rows": tile_rows}
+    for tile_label, tile in (("untiled", None), ("tiled", tile_rows)):
+        for name, fn in fam(tile).items():
+            key = f"{name}/{tile_label}"
+            try:
+                out[key] = measure_program(
+                    jax.jit(fn), (binned, grad, hess, mask),
+                    reps=reps, device=device)
+            except Exception as e:  # unsupported variant on this backend
+                out[key] = {"error": str(e)[:160]}
+    return out

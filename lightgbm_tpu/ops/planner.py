@@ -331,6 +331,11 @@ def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None):
         quant_bins=cfg.quant_bins, method=cfg.hist_method,
         round_width=cfg.round_width, machines=max(cfg.num_machines, 1),
         accel=accel)
+    # first-class predicted-peak event (docs/OBSERVABILITY.md): the bench
+    # logs the allocator's MEASURED peak next to it, so memory-model
+    # drift is visible per run on the same timeline
+    from ..obs.trace import instant
+    instant("planner.plan", rows=rows, features=features, **plan.summary())
     cfg = cfg._replace(tile_rows=plan.tile_rows,
                        hist_pack=cfg.hist_pack and plan.use_pack)
     return cfg, plan

@@ -37,10 +37,13 @@ import hashlib
 import io
 import json
 import pickle
+import time
 import zipfile
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from ..obs.metrics import global_registry as _obs_registry
+from ..obs.trace import span as _span
 from ..utils.file_io import exists, open_file, remove, write_atomic
 from ..utils.log import log_info, log_warning
 
@@ -173,7 +176,12 @@ def save_checkpoint(booster, path: str, iteration: Optional[int] = None,
     """Write one atomic bundle to ``path``; returns the path."""
     if iteration is None:
         iteration = booster.current_iteration()
-    write_atomic(path, build_bundle_bytes(booster, iteration, engine_state))
+    t0 = time.perf_counter()
+    with _span("checkpoint.save", iteration=int(iteration)):
+        write_atomic(path,
+                     build_bundle_bytes(booster, iteration, engine_state))
+    _obs_registry.histogram("checkpoint_save_ms").observe(
+        (time.perf_counter() - t0) * 1e3)
     return str(path)
 
 
@@ -181,15 +189,20 @@ def load_checkpoint(path: str) -> Checkpoint:
     """Read + verify one bundle."""
     if not exists(path):
         raise CheckpointNotFoundError(f"no checkpoint at {path!r}")
-    try:
-        with open_file(path, "rb") as fh:
-            blob = fh.read()
-    except CheckpointError:
-        raise
-    except Exception as e:
-        raise CheckpointCorruptError(
-            f"checkpoint {path}: unreadable ({e})") from e
-    return decode_bundle_bytes(blob, path=str(path))
+    t0 = time.perf_counter()
+    with _span("checkpoint.load", path=str(path)):
+        try:
+            with open_file(path, "rb") as fh:
+                blob = fh.read()
+        except CheckpointError:
+            raise
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: unreadable ({e})") from e
+        ck = decode_bundle_bytes(blob, path=str(path))
+    _obs_registry.histogram("checkpoint_load_ms").observe(
+        (time.perf_counter() - t0) * 1e3)
+    return ck
 
 
 def restore_booster(booster, ckpt: Checkpoint) -> None:
@@ -266,8 +279,7 @@ class CheckpointManager:
     def save(self, booster, iteration: int,
              engine_state: Optional[dict] = None) -> str:
         path = self.path_for(iteration)
-        write_atomic(path, build_bundle_bytes(booster, iteration,
-                                              engine_state))
+        save_checkpoint(booster, path, iteration, engine_state)
         names = [n for n in self.bundles()
                  if n != path.rsplit("/", 1)[-1]]
         names.append(path.rsplit("/", 1)[-1])

@@ -146,8 +146,18 @@ def resilient_allgather(payload: bytes,
 
     Raises ``CollectiveError`` (on every rank, within the deadline) when
     the transport cannot produce a round that ALL ranks verify.
+
+    ``metrics`` defaults to the unified process registry
+    (``obs.metrics.global_registry``) so collective health counters are
+    always visible process-wide; pass a registry to scope them.  Every
+    attempt records an ``allgather.attempt`` trace span when tracing is
+    enabled (docs/OBSERVABILITY.md).
     """
     cfg = config or ResilienceConfig()
+    if metrics is None:
+        from ..obs.metrics import global_registry
+        metrics = global_registry
+    from ..obs.trace import span as _span
     deadline = time.monotonic() + cfg.deadline_s
     rng = np.random.RandomState(
         (int(cfg.jitter_seed) * 1000003 + rank * 7919) % (2 ** 31))
@@ -166,42 +176,49 @@ def resilient_allgather(payload: bytes,
                 f"{label}: rank {rank} aborting after {attempt} attempt(s) "
                 f"({'deadline exceeded' if remaining <= 0 else 'retries exhausted'}); "
                 f"last failure: {last_reason}")
-        # --- payload round -------------------------------------------------
-        ok, parts, reason = True, None, ""
-        try:
-            raw = _call_bounded(allgather_bytes,
-                                frame_payload(payload, attempt), remaining)
-            if len(raw) != world:
-                ok, reason = False, f"{len(raw)} parts != world {world}"
-            else:
-                parts = []
-                for r, blob in enumerate(raw):
-                    p, why = unframe_payload(blob, attempt)
-                    if p is None:
-                        ok, reason = False, f"rank {r} frame: {why}"
-                        break
-                    parts.append(p)
-        except Exception as e:  # noqa: BLE001 — any transport fault retries
-            ok, reason = False, repr(e)
-        # --- verdict round: all ranks agree to commit or retry -------------
-        committed = False
-        remaining = deadline - time.monotonic()
-        if remaining > 0:
+        att_span = _span("allgather.attempt", label=label, rank=rank,
+                         attempt=attempt)
+        with att_span:
+            # --- payload round ---------------------------------------------
+            ok, parts, reason = True, None, ""
             try:
-                vote = VMAGIC + struct.pack("<IB", attempt, 1 if ok else 0)
-                votes = _call_bounded(allgather_bytes, vote, remaining)
-                if len(votes) == world:
-                    committed = ok and all(
-                        len(v) == len(vote) and v[:4] == VMAGIC
-                        and struct.unpack("<IB", v[4:])[0] == attempt
-                        and struct.unpack("<IB", v[4:])[1] == 1
-                        for v in votes)
-                    if ok and not committed:
-                        reason = "a peer rank voted to retry"
+                raw = _call_bounded(allgather_bytes,
+                                    frame_payload(payload, attempt),
+                                    remaining)
+                if len(raw) != world:
+                    ok, reason = False, f"{len(raw)} parts != world {world}"
                 else:
-                    reason = reason or "verdict round incomplete"
-            except Exception as e:  # noqa: BLE001
-                reason = reason or f"verdict round failed: {e!r}"
+                    parts = []
+                    for r, blob in enumerate(raw):
+                        p, why = unframe_payload(blob, attempt)
+                        if p is None:
+                            ok, reason = False, f"rank {r} frame: {why}"
+                            break
+                        parts.append(p)
+            except Exception as e:  # noqa: BLE001 — any transport fault retries
+                ok, reason = False, repr(e)
+            # --- verdict round: all ranks agree to commit or retry ---------
+            committed = False
+            remaining = deadline - time.monotonic()
+            if remaining > 0:
+                try:
+                    vote = VMAGIC + struct.pack("<IB", attempt,
+                                                1 if ok else 0)
+                    votes = _call_bounded(allgather_bytes, vote, remaining)
+                    if len(votes) == world:
+                        committed = ok and all(
+                            len(v) == len(vote) and v[:4] == VMAGIC
+                            and struct.unpack("<IB", v[4:])[0] == attempt
+                            and struct.unpack("<IB", v[4:])[1] == 1
+                            for v in votes)
+                        if ok and not committed:
+                            reason = "a peer rank voted to retry"
+                    else:
+                        reason = reason or "verdict round incomplete"
+                except Exception as e:  # noqa: BLE001
+                    reason = reason or f"verdict round failed: {e!r}"
+            att_span.set(ok=ok, committed=committed,
+                         reason=(reason or "")[:120])
         if committed:
             if attempt > 0:
                 log_warning(f"{label}: rank {rank} recovered after "

@@ -34,6 +34,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..obs.trace import span as _span
 from .errors import DeadlineExceeded, ServerClosed
 
 
@@ -251,7 +252,9 @@ class MicroBatcher:
             batch = Batch(items, self.ladder.bucket_for(rows))
             self._record_batch(batch)
             try:
-                self.run_batch(batch)
+                with _span("serving.dispatch", rows=batch.rows,
+                           bucket=batch.bucket, items=len(batch.items)):
+                    self.run_batch(batch)
             except Exception as e:  # noqa: BLE001 — fail items, keep serving
                 for it in batch.items:
                     it.request.fail_item(e)

@@ -32,6 +32,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs.metrics import global_registry as _obs_registry
+from ..obs.trace import instant as _instant
+from ..obs.trace import span as _span
 from .batcher import Batch, BucketLadder, MicroBatcher, WorkItem
 from .errors import QueueFull, ServerClosed, ServingError
 from .metrics import MetricsRegistry
@@ -143,6 +146,12 @@ class Server:
             batch_window_ms=config.batch_window_ms,
             max_queue_rows=config.max_queue_rows)
         self._closed = False
+        # join the unified process registry (docs/OBSERVABILITY.md): the
+        # per-server registry stays authoritative (tests/serve_smoke read
+        # it), but a process-wide snapshot / Prometheus scrape sees every
+        # live server as a named component; detached at close()
+        self._obs_component = _obs_registry.attach_child(
+            "serving", self.metrics)
 
     # --------------------------------------------------------------- submit
 
@@ -202,6 +211,9 @@ class Server:
                 self.metrics.counter("requests_rejected_closed").inc()
             req.fail_item(e)
             raise
+        # after submit_items: a QueueFull-rejected request must not show
+        # up in the trace as admitted
+        _instant("serving.admit", rows=n, items=n_items)
         return req.future
 
     def predict(self, X, deadline_ms: Optional[float] = None,
@@ -241,7 +253,8 @@ class Server:
                        sum(it.n for it in items))))
             prog = self.programs.get(model, sub.bucket)
             t0 = time.perf_counter()
-            raw = prog(sub.padded_input())           # [K, bucket] f64
+            with _span("serving.batch", rows=sub.rows, bucket=sub.bucket):
+                raw = prog(sub.padded_input())       # [K, bucket] f64
             self.metrics.histogram("batch_latency_ms").observe(
                 (time.perf_counter() - t0) * 1e3)
             pos = 0
@@ -266,8 +279,9 @@ class Server:
             self.metrics.counter("requests_cancelled").inc()
             return                      # saw a timeout, not a completion
         self.metrics.counter("requests_completed").inc()
-        self.metrics.histogram("request_latency_ms").observe(
-            (time.monotonic() - req.t_submit) * 1e3)
+        lat_ms = (time.monotonic() - req.t_submit) * 1e3
+        self.metrics.histogram("request_latency_ms").observe(lat_ms)
+        _instant("serving.complete", rows=req.n, latency_ms=round(lat_ms, 3))
 
     def warm(self, buckets=None) -> int:
         """Pre-compile the active model's predict programs — for
@@ -323,6 +337,7 @@ class Server:
             return
         self._closed = True
         self._batcher.close(drain=drain, timeout=timeout)
+        _obs_registry.detach_child(self._obs_component)
 
     def __enter__(self) -> "Server":
         return self
@@ -337,3 +352,9 @@ class Server:
 
     def metrics_json(self, path: Optional[str] = None) -> str:
         return self.metrics.dump_json(path)
+
+    def prometheus_text(self, prefix: str = "lgbt_serving") -> str:
+        """This server's instruments in Prometheus text exposition format
+        (the process-wide scrape is
+        ``obs.metrics.global_registry.to_prometheus()``)."""
+        return self.metrics.to_prometheus(prefix=prefix)

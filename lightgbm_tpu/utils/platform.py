@@ -98,6 +98,16 @@ def enable_compile_cache(path=None):
         return None
     try:
         os.makedirs(d, exist_ok=True)
+        # cache warmth on the unified registry: entries found at wiring
+        # time discriminate cold vs warm starts (docs/OBSERVABILITY.md)
+        try:
+            from ..obs.metrics import global_registry
+            entries = compile_cache_entries(d)
+            global_registry.gauge("compile_cache_entries_at_init").set(
+                entries)
+            global_registry.gauge("compile_cache_warm").set(entries > 0)
+        except Exception:
+            pass
         import jax
         jax.config.update("jax_compilation_cache_dir", d)
         # sane thresholds: bank everything that took real compile time,

@@ -11,6 +11,13 @@ Here the gate is runtime: set ``LIGHTGBM_TPU_TIMETAG=1`` in the environment
 sorted by total time, like Timer::Print.  Disabled, a tagged section costs
 one attribute check.
 
+Machine-readable exit dump: ``LIGHTGBM_TPU_TIMETAG=json`` emits a JSON
+object to stderr instead of the table; ``LIGHTGBM_TPU_TIMETAG=json:<path>``
+writes it to ``<path>`` — so bench stages and CI journal timer totals
+instead of scraping the human table.  ``publish()`` mirrors the totals
+into the unified process metrics registry (``obs.metrics``,
+docs/OBSERVABILITY.md) as ``timer.<name>.{calls,total_s}`` gauges.
+
 Because device work is asynchronous under jit, host-side sections measure
 dispatch + the points where the host blocks (fetching tree arrays, metric
 values) — the same wall-clock decomposition the reference reports, with
@@ -32,7 +39,10 @@ class Timer:
 
     def __init__(self, enabled: bool | None = None):
         if enabled is None:
-            enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") == "1"
+            # any non-empty value but "0" enables ("1" = table at exit,
+            # "json"/"json:<path>" = machine-readable exit dump)
+            enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") \
+                not in ("", "0")
         self.enabled = enabled
         self._acc: dict = {}          # name -> [count, total_seconds]
         self._lock = threading.Lock()
@@ -69,6 +79,38 @@ class Timer:
     def items(self):
         with self._lock:
             return {k: tuple(v) for k, v in self._acc.items()}
+
+    def to_dict(self) -> dict:
+        """JSON-ready totals: name -> {calls, total_s, mean_ms}."""
+        return {
+            name: {"calls": cnt, "total_s": round(total, 6),
+                   "mean_ms": round(total / cnt * 1e3, 6) if cnt else 0.0}
+            for name, (cnt, total) in self.items().items()
+        }
+
+    def dump_json(self, path=None) -> str:
+        """The machine-readable form of ``print``; writes to ``path``
+        when given, returns the JSON string either way."""
+        import json
+        s = json.dumps({"timers": self.to_dict()}, indent=1, sort_keys=True)
+        if path:
+            with open(path, "w") as f:
+                f.write(s)
+        return s
+
+    def publish(self, registry=None) -> dict:
+        """Mirror the totals into the unified process metrics registry
+        (default: ``obs.metrics.global_registry``) as
+        ``timer.<name>.calls`` / ``timer.<name>.total_s`` gauges, so
+        bench stages journal them with the rest of the snapshot instead
+        of scraping stderr.  Returns the mirrored totals."""
+        if registry is None:
+            from ..obs.metrics import global_registry as registry
+        items = self.items()
+        for name, (cnt, total) in items.items():
+            registry.gauge(f"timer.{name}.calls").set(cnt)
+            registry.gauge(f"timer.{name}.total_s").set(round(total, 6))
+        return items
 
     def print(self, file=None) -> None:
         """reference: Timer::Print (common.h:1054-1070)."""
@@ -111,5 +153,17 @@ def function_timer(name: str, timer: Timer = global_timer):
 
 @atexit.register
 def _print_at_exit() -> None:
-    if global_timer.enabled:
+    if not global_timer.enabled:
+        return
+    mode = os.environ.get("LIGHTGBM_TPU_TIMETAG", "")
+    if mode == "json" or mode.startswith("json:"):
+        # an empty path ("json:") falls back to stderr, never silence
+        path = (mode[5:] or None) if mode.startswith("json:") else None
+        try:
+            s = global_timer.dump_json(path)
+            if path is None:
+                print(s, file=sys.stderr)
+        except OSError:
+            global_timer.print()
+    else:
         global_timer.print()
