@@ -56,7 +56,11 @@ BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1, BENCH_SKIP_HIST_PROBE=1,
 BENCH_SKIP_OBS=1 (skip the obs_dump stage AND the measured per-variant
 MFU table — lightgbm_tpu/obs/devprof.py cost_analysis numbers that
 otherwise ride in the full/fallback run_bench results as "mfu_measured",
-banked under their own journal key so retries replay them).
+banked under their own journal key so retries replay them; the table
+now includes the */fused rows — the Pallas histogram→split megakernel,
+ops/fused.py — whose MFU against the staged rows at the same shape is
+the fusion acceptance figure, and the hist_probe stage journals the
+fused-vs-staged sec/level + HBM bytes_accessed drop per level).
 Observability: LIGHTGBM_TPU_TRACE=1 records structured spans through
 every stage (bench phases, engine loop, dispatch/fetch, serving) and
 each run_bench stage dumps a Chrome-trace JSON (bench_trace_<stage>.json)
@@ -67,6 +71,8 @@ Memory/caching: LGBM_TPU_TILE_ROWS / LGBM_TPU_HBM_BYTES steer the HBM
 budget planner (ops/planner.py; the >=10M-row stage is gated on its
 feasibility verdict and degrades to smaller row tiles instead of
 crashing — the decision is journaled as the "hbm_plan" stage);
+LGBM_TPU_VMEM_BYTES steers the fused-megakernel VMEM arena election and
+LGBM_TPU_FUSED=0 drops the fused arm entirely (staged family only);
 LGBM_TPU_COMPILE_CACHE=<dir> wires the persistent XLA compile cache
 (cold-vs-warm compile_seconds recorded per stage under "compile_cache").
 

@@ -568,12 +568,58 @@ class GBDT:
             forced_plan = (forced_plan[0],
                            inv[np.asarray(forced_plan[1], np.int64)],
                            forced_plan[2])
+        # fused Pallas histogram→split megakernel (ops/fused.py) context:
+        # the numeric unsharded common case.  hist_method=auto elects it
+        # on accelerators when the planner proves the VMEM arena fits
+        # (plan_fused, below) AND a one-time compile probe verified the
+        # kernel on this backend; an explicit hist_method=fused also runs
+        # on CPU (interpret mode — how the tier-1 parity suite executes
+        # it).  Computed BEFORE the measured-auto resolution: electing
+        # fused must leave the method string "auto" for the planner, and
+        # the per-kernel timing probe would be wasted work.
+        meta_fused = (self._meta_dist if self._meta_dist is not None
+                      else self.meta).resolved()
+        fused_ctx = (
+            not cegb_enabled and vote_k == 0
+            and self._feature_axis is None and forced_plan is None
+            and (self._mesh is None or self._data_axis is None)
+            and not self.config.monotone_constraints
+            and not cc.extra_trees and bynode_cnt == 0
+            and not meta_fused.has_bundles
+            and not bool(meta_fused.is_categorical.any()))
+        want_fused = fused_ctx and (
+            self.config.tpu_hist_method == "fused"
+            or (self.config.tpu_hist_method == "auto" and on_accelerator()
+                # the serial grower's fused arm streams ALL rows per
+                # split (no leaf compaction); auto only elects fused
+                # where the per-LEVEL rounds grower can run it
+                and self.config.tpu_tree_growth != "serial"))
+        if want_fused and on_accelerator() \
+                and self.config.tpu_hist_method != "fused":
+            # the one-time compile/parity probe protects the AUTO
+            # election only; an EXPLICIT hist_method=fused is honored
+            # (it fails loudly at compile if the backend truly cannot
+            # lower the kernel) — the override the probe's warning
+            # advertises
+            from ..ops.fused import fused_kernel_verified
+            want_fused = fused_kernel_verified()
+        if self.config.tpu_hist_method == "fused" and not fused_ctx \
+                and not getattr(self, "_fused_warned", False):
+            self._fused_warned = True
+            log_warning(
+                "tpu_hist_method=fused applies to the numeric unsharded "
+                "case (no categorical features, EFB bundles, monotone "
+                "constraints, extra_trees, per-node column sampling, "
+                "CEGB, forced splits, or feature/voting sharding); "
+                "falling back to the staged kernel family")
         # resolve hist_method="auto" by MEASURING the kernel variants on
         # the live accelerator at the training shape (reference: the
         # GetShareStates col-vs-row timed probe, dataset.cpp:589-684);
-        # CPU resolves to scatter without probing
+        # CPU resolves to scatter without probing.  Deferred while a
+        # fused election is pending — the planner needs the literal
+        # "auto" to elect, and re-resolves below if it declines.
         hist_method = self.config.tpu_hist_method
-        if hist_method == "auto" and on_accelerator():
+        if hist_method == "auto" and on_accelerator() and not want_fused:
             from ..ops.histogram import measured_best_method
             hist_method = measured_best_method(
                 self.num_data, self._binned_shape[1], self.num_bins)
@@ -618,8 +664,33 @@ class GBDT:
             # the sharded array keeps its GLOBAL shape; each device's
             # kernels see only its feature slice
             shard_feats //= max(int(self._mesh.shape[self._feature_axis]), 1)
+        if want_fused and self.grower_cfg.hist_method == "auto":
+            # dry-run the fused VMEM election (plan_histograms emits no
+            # trace event and mutates nothing) so a decline can fall
+            # back to the measured kernel BEFORE the one real apply_plan
+            # — one planner.plan event, modeled on the variant that
+            # actually executes, and no hist_pack ratcheting through a
+            # provisional plan
+            from ..ops.planner import plan_histograms
+            probe_plan = plan_histograms(
+                rows=shard_rows, features=shard_feats,
+                num_bins=self.grower_cfg.num_bins,
+                num_leaves=self.grower_cfg.num_leaves,
+                quant=self.grower_cfg.quant,
+                quant_bins=self.grower_cfg.quant_bins, method="auto",
+                round_width=self.grower_cfg.round_width,
+                machines=max(nmach, 1), fused_ok=True)
+            want_fused = probe_plan.fused
+        if not want_fused and self.grower_cfg.hist_method == "auto" \
+                and on_accelerator():
+            # the deferred timed-probe resolution (fused declined or was
+            # never in play after all)
+            from ..ops.histogram import measured_best_method
+            self.grower_cfg = self.grower_cfg._replace(
+                hist_method=measured_best_method(
+                    self.num_data, self._binned_shape[1], self.num_bins))
         self.grower_cfg, self.hist_plan = apply_plan(
-            self.grower_cfg, shard_rows, shard_feats)
+            self.grower_cfg, shard_rows, shard_feats, fused_ok=want_fused)
         # unified-registry training gauges (the planner.plan trace event
         # itself is emitted inside apply_plan; the bench logs the measured
         # peak next to it — docs/OBSERVABILITY.md predicted-vs-measured)
@@ -885,7 +956,7 @@ class GBDT:
                     os.environ.get(k, "") for k in
                     ("LGBM_TPU_SEGHIST", "LGBM_TPU_SMALL_ROUNDS",
                      "LGBM_TPU_PACK", "LGBM_TPU_TABLE_MATMUL",
-                     "LGBM_TPU_ROUTER"))
+                     "LGBM_TPU_ROUTER", "LGBM_TPU_FUSED"))
                 cache_key = (
                     "one_iter", K, n_pad, self.binned.shape,
                     str(self.binned.dtype), cfg, use_rounds, use_renew,

@@ -244,6 +244,13 @@ class GrowerConfig(NamedTuple):
                                    # planner clears it when tiling is
                                    # active (records are then assembled
                                    # per tile inside the kernel loops)
+    fused_feat_tile: int = 0       # hist_method="fused": features per
+                                   # VMEM arena block of the Pallas
+                                   # histogram→split megakernel
+                                   # (ops/fused.py); 0 = let plan_fused
+                                   # pick.  Set by ops/planner.apply_plan
+    fused_block_rows: int = 0      # hist_method="fused": rows per
+                                   # double-buffered tile DMA; 0 = auto
 
 
 def _psum(x, axis_name):
@@ -606,6 +613,26 @@ def _grow_tree_traced(
     use_rng = hp.extra_trees or cfg.bynode_feature_cnt > 0
     if use_rng and rng_key is None:
         rng_key = jax.random.PRNGKey(0)
+
+    # fused Pallas histogram→split megakernel arm (ops/fused.py): per
+    # split, ONE kernel streams the binned matrix once, accumulates the
+    # smaller child's bins in VMEM, derives the sibling from the parent
+    # arena in-kernel and scans both children's gains before writing
+    # back only the smaller-child histogram (the subtraction cache's
+    # input) + [2, F] per-feature-best tuples.  Applies to the numeric
+    # common case; every other mode keeps the staged family (same
+    # trees: the scan is ops.split.numeric_feature_scan either way).
+    use_fused = (cfg.hist_method == "fused" and axis_name is None
+                 and feature_axis_name is None and not voting
+                 and not cegb_enabled and cfg.n_forced == 0
+                 and not meta.has_bundles and not has_cat
+                 and monotone_constraints is None and not use_rng)
+    if use_fused:
+        from .ops.fused import fused_frontier_splits, pick_fused_best
+        from .ops.histogram import _vals_t, _vals_t_int
+        fused_vals = (_vals_t_int(q_grad, q_hess, row_mask > 0) if quant
+                      else _vals_t(grad, hess, row_mask))
+        fused_scales = (g_scale, h_scale) if quant else None
 
     def node_rand(key):
         """(by-node feature mask or None, extra-trees uniforms or None)."""
@@ -1084,7 +1111,24 @@ def _grow_tree_traced(
         small_leaf = jnp.where(left_smaller, leaf, new_leaf)
         parent_hist = c.hist[leaf]
         small_member = leaf_id == small_leaf
-        if cfg.compact and len(caps) > 1:
+        fused_best = None
+        if use_fused:
+            # one streamed pass: smaller-child bins accumulate in VMEM,
+            # the sibling derives from the parent arena in-kernel, both
+            # children's per-feature-best tuples come back with the
+            # smaller-child histogram (ops/fused.py)
+            csums = jnp.stack([jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                               jnp.stack([lc, rc])])            # [3, 2]
+            seg1, fused_best = fused_frontier_splits(
+                binned_t, fused_vals, jnp.where(small_member, 0, 1), 1,
+                Bg, csums, left_smaller[None], parent_hist[None],
+                num_bin, missing_type, default_bin, hp,
+                quant_scales=fused_scales,
+                feat_tile=(cfg.fused_feat_tile or None),
+                block_rows=(cfg.fused_block_rows or None),
+                tile_rows=tile)
+            small_hist = seg1[0]
+        elif cfg.compact and len(caps) > 1:
             if quant:
                 small_hist = hist_sync(compacted_histogram_int(
                     binned_t, q_grad, q_hess, row_mask, small_member, Bg,
@@ -1146,6 +1190,20 @@ def _grow_tree_traced(
             in_r = (leaf_id == new_leaf) & (row_mask > 0)
             best = best.store(leaf, pfl, cegb_lazy_row(in_l, cegb_rows)) \
                        .store(new_leaf, pfr, cegb_lazy_row(in_r, cegb_rows))
+        elif use_fused:
+            # the kernel already scanned both children: pick the best
+            # feature (ties -> smaller index, like pick_best_feature),
+            # then apply the depth gate exactly where leaf_best does
+            res2 = pick_fused_best(fused_best, jnp.stack([lg, rg]),
+                                   jnp.stack([lh, rh]),
+                                   jnp.stack([lc, rc]),
+                                   feature_mask=feature_mask)
+            if cfg.max_depth > 0:
+                res2 = res2._replace(gain=jnp.where(
+                    new_depth >= cfg.max_depth, -jnp.inf, res2.gain))
+            rl = jax.tree_util.tree_map(lambda x: x[0], res2)
+            rr = jax.tree_util.tree_map(lambda x: x[1], res2)
+            best = best.store(leaf, rl).store(new_leaf, rr)
         else:
             rl = leaf_best(hist_l, lg, lh, lc, new_depth,
                            bounds=bounds_l, key=kl)

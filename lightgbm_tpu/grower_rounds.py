@@ -147,6 +147,22 @@ def _grow_tree_rounds_traced(
         def split_conv(ghist, cnt):
             return ghist
     caps = capacity_schedule(n) if cfg.compact else [n]
+    use_mc = monotone_constraints is not None
+    use_rng = hp.extra_trees or cfg.bynode_feature_cnt > 0
+    # fused Pallas histogram→split megakernel arm (ops/fused.py): per
+    # ROUND, one kernel streams every binned row tile HBM→VMEM once,
+    # accumulates all K candidates' smaller-child bins in a VMEM arena,
+    # derives each sibling from the parent histograms in-kernel and
+    # scans both children's per-feature gains before writing back only
+    # the smaller-child histograms (the cache's subtraction input) and
+    # the [2K, F] best tuples — the staged pipeline's [K,ch,F,B] segment
+    # output + [2K,ch,F,B] scan re-read round-trip never touches HBM.
+    # Numeric common case only; anything else falls back to the staged
+    # family (same trees: the scan body is shared —
+    # ops.split.numeric_feature_scan).
+    use_fused = (cfg.hist_method == "fused" and axis_name is None
+                 and not meta.has_bundles and not has_cat
+                 and not use_mc and not use_rng)
     # fused u32 column records for the arena's single gather (sorted-path
     # only: gather cost scales with element count — pack_cols_u32; the
     # quantized record fuses (gq, hq, member) into ONE word, Wb+1 vs
@@ -154,7 +170,10 @@ def _grow_tree_rounds_traced(
     # (compile-cost bisect hook).  Under planner tiling the whole-dataset
     # record arena is NOT hoisted (cfg.hist_pack cleared / tile set):
     # the kernels assemble records per tile inside their loops instead.
+    # The fused arm gathers nothing — the record arena would be dead
+    # weight.
     use_pack = (use_sorted_seghist() and cfg.hist_pack and tile is None
+                and not use_fused
                 and os.environ.get("LGBM_TPU_PACK") != "0")
     if not use_pack:
         packed = None
@@ -196,11 +215,15 @@ def _grow_tree_rounds_traced(
     # width and the segment-histogram slot axis.
     KCAP = min(Lm1, max(1, cfg.round_width))
 
-    use_mc = monotone_constraints is not None
     mc_j = jnp.asarray(monotone_constraints) if use_mc else None
-    use_rng = hp.extra_trees or cfg.bynode_feature_cnt > 0
     if use_rng and rng_key is None:
         rng_key = jax.random.PRNGKey(0)
+    if use_fused:
+        from .ops.fused import fused_frontier_splits, pick_fused_best
+        from .ops.histogram import _vals_t, _vals_t_int
+        fused_vals = (_vals_t_int(q_grad, q_hess, row_mask > 0) if quant
+                      else _vals_t(grad, hess, row_mask))
+        fused_scales = (g_scale, h_scale) if quant else None
 
     # ---- per-leaf best-split search, vmapped over all L slots ----------
     def leaf_key(parent, side):
@@ -519,11 +542,13 @@ def _grow_tree_rounds_traced(
                  jnp.zeros(n, jnp.bool_)),
                 jnp.arange(KCAP, dtype=jnp.int32))
 
-        # smaller-child segment histograms: one sorted-arena pass for the
-        # whole candidate batch (slot r = the round's r-th candidate)
+        # smaller-child segment histograms: one pass for the whole
+        # candidate batch (slot r = the round's r-th candidate)
         small_left = b.left_count <= b.right_count
         slot = jnp.where(row_small, crank, KCAP)
-        if quant:
+        if use_fused:
+            seg = None      # the fused megakernel produces it below
+        elif quant:
             seg = psum_quant_hist(compacted_segment_histogram_int(
                 binned_t, q_grad, q_hess, row_mask, slot, KCAP, Bg, caps,
                 num_live=k, packed=packed, levels=q_levels,
@@ -540,29 +565,54 @@ def _grow_tree_rounds_traced(
         # valid under any commit that includes candidate i.  Left children
         # keep the parent's leaf slot; stats come from the cache.
         ph = c.hist[idl]                                # [K, 3, G, Bg]
-        sl = small_left[idl][:, None, None, None]
-        h_left = jnp.where(sl, seg, ph - seg)
-        h_right = ph - h_left
         lg_, lh_, lc_ = (b.left_sum_grad[idl], b.left_sum_hess[idl],
                          b.left_count[idl])
         rg_, rh_, rc_ = (b.right_sum_grad[idl], b.right_sum_hess[idl],
                          b.right_count[idl])
         depth_c = c.tree.leaf_depth[idl] + 1
-        if use_mc:
-            bl_min, bl_max, br_min, br_max = child_bounds(c)
-            bmin = jnp.concatenate([bl_min[idl], br_min[idl]])
-            bmax = jnp.concatenate([bl_max[idl], br_max[idl]])
+        if use_fused:
+            # fused megakernel (ops/fused.py): one streamed pass builds
+            # the K smaller-child histograms in VMEM, derives each
+            # sibling from the parent arena in-kernel and scans both
+            # children; only `seg` + the [2K, F] per-feature-best
+            # tuples return — the staged arm's seg/scan HBM round-trip
+            # is deleted.  The pick + depth gate mirror search_all's
+            # best_split_for_leaf + gain gating exactly.
+            csums = jnp.stack([jnp.concatenate([lg_, rg_]),
+                               jnp.concatenate([lh_, rh_]),
+                               jnp.concatenate([lc_, rc_])])   # [3, 2K]
+            seg, nfb = fused_frontier_splits(
+                binned_t, fused_vals, slot, KCAP, Bg, csums,
+                small_left[idl], ph, num_bin, missing_type, default_bin,
+                hp, quant_scales=fused_scales,
+                feat_tile=(cfg.fused_feat_tile or None),
+                block_rows=(cfg.fused_block_rows or None),
+                tile_rows=tile)
+            res = pick_fused_best(nfb, csums[0], csums[1], csums[2],
+                                  feature_mask=feature_mask)
+            if cfg.max_depth > 0:
+                dd = jnp.concatenate([depth_c, depth_c])
+                res = res._replace(gain=jnp.where(
+                    dd >= cfg.max_depth, -jnp.inf, res.gain))
         else:
-            bmin = bmax = jnp.zeros(2 * KCAP, jnp.float32)
-        node_of_k = c.split_idx + iota_K                # candidate node ids
-        res = search_all(
-            jnp.concatenate([h_left, h_right]),
-            jnp.concatenate([lg_, rg_]), jnp.concatenate([lh_, rh_]),
-            jnp.concatenate([lc_, rc_]),
-            jnp.concatenate([depth_c, depth_c]), bmin, bmax,
-            jnp.concatenate([node_of_k, node_of_k]),
-            jnp.concatenate([jnp.zeros(KCAP, jnp.int32),
-                             jnp.ones(KCAP, jnp.int32)]))
+            sl = small_left[idl][:, None, None, None]
+            h_left = jnp.where(sl, seg, ph - seg)
+            h_right = ph - h_left
+            if use_mc:
+                bl_min, bl_max, br_min, br_max = child_bounds(c)
+                bmin = jnp.concatenate([bl_min[idl], br_min[idl]])
+                bmax = jnp.concatenate([bl_max[idl], br_max[idl]])
+            else:
+                bmin = bmax = jnp.zeros(2 * KCAP, jnp.float32)
+            node_of_k = c.split_idx + iota_K            # candidate node ids
+            res = search_all(
+                jnp.concatenate([h_left, h_right]),
+                jnp.concatenate([lg_, rg_]), jnp.concatenate([lh_, rh_]),
+                jnp.concatenate([lc_, rc_]),
+                jnp.concatenate([depth_c, depth_c]), bmin, bmax,
+                jnp.concatenate([node_of_k, node_of_k]),
+                jnp.concatenate([jnp.zeros(KCAP, jnp.int32),
+                                 jnp.ones(KCAP, jnp.int32)]))
 
         # -- maximal exact prefix: candidate i (in gain order) is the
         # best-first pop at step i iff its gain >= every child spawned by

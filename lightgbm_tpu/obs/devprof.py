@@ -218,12 +218,16 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
                                 reps: int = 2, tile_rows: Optional[int] = None,
                                 seed: int = 0, quant: bool = True) -> dict:
     """Measured per-kernel-variant utilization table for the histogram
-    family: {matmul, matmul_f32, scatter, sorted, expanded} x {f32, quant}
-    x {untiled, tiled} -> ``measure_program`` dicts.
+    family: {matmul, matmul_f32, scatter, pallas, sorted, expanded,
+    fused} x {f32, quant} x {untiled, tiled} -> ``measure_program``
+    dicts.
 
     This replaces the bench's hand-derived MFU lower bound with the
     compiler's own FLOP/byte counts per compiled variant — the numbers
-    the Pallas-megakernel work (ROADMAP item 2) is steered by.  A variant
+    the Pallas-megakernel work (ROADMAP item 2) is steered by; the
+    ``*/fused`` rows are that megakernel itself (ops/fused.py: histogram
+    build + in-VMEM split scan in one program — the acceptance figure is
+    its MFU against the staged rows at the same shape).  A variant
     unsupported on the backend reports ``{"error": ...}`` instead of
     failing the table.
     """
@@ -231,7 +235,9 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
     import jax.numpy as jnp
     import numpy as np
 
+    from ..ops import fused as FU
     from ..ops import histogram as H
+    from ..ops.split import SplitHyperparams
 
     rng = np.random.RandomState(seed)
     n, F, B = int(rows), int(features), int(num_bins)
@@ -249,6 +255,16 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
         tile_rows = 1 << max((n // 4).bit_length() - 1, 10)
     tile_rows = max(min(int(tile_rows), n), 1)
 
+    # fused-megakernel fixtures: per-slot totals + trivially-valid meta
+    hp = SplitHyperparams(min_data_in_leaf=1)
+    nb_v = jnp.full((F,), B, jnp.int32)
+    z_v = jnp.zeros((F,), jnp.int32)
+    oh_slot = (slot[None, :] == jnp.arange(slots)[:, None])
+    slot_sums = jnp.stack([
+        jnp.sum(jnp.where(oh_slot, grad[None, :], 0.0), axis=1),
+        jnp.sum(jnp.where(oh_slot, hess[None, :], 0.0), axis=1),
+        jnp.sum(oh_slot.astype(jnp.float32), axis=1)])
+
     def fam(tile):
         ms = {
             "f32/matmul": lambda b, g, h, m: H.build_histogram(
@@ -257,10 +273,15 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
                 b, g, h, m, B, method="matmul_f32", tile_rows=tile),
             "f32/scatter": lambda b, g, h, m: H.build_histogram(
                 b, g, h, m, B, method="scatter", tile_rows=tile),
+            "f32/pallas": lambda b, g, h, m: H.build_histogram(
+                b, g, h, m, B, method="pallas", tile_rows=tile),
             "f32/sorted": lambda b, g, h, m: H.segment_histogram_sorted(
                 b, g, h, m, slot, slots, B, tile_rows=tile),
             "f32/expanded": lambda b, g, h, m: H.segment_histogram_expanded(
                 b, g, h, m, slot, B, tile_rows=tile),
+            "f32/fused": lambda b, g, h, m: FU.fused_segment_splits(
+                b, H._vals_t(g, h, m), slot, slots, B, slot_sums,
+                nb_v, z_v, z_v, hp, tile_rows=tile),
         }
         if quant:
             ms.update({
@@ -276,6 +297,12 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
                 "quant/expanded": lambda b, g, h, m:
                     H.segment_histogram_expanded_int(
                         b, gq, hq, member, slot, B, tile_rows=tile),
+                "quant/fused": lambda b, g, h, m:
+                    FU.fused_segment_splits(
+                        b, H._vals_t_int(gq, hq, member), slot, slots, B,
+                        slot_sums, nb_v, z_v, z_v, hp,
+                        quant_scales=(jnp.float32(0.25), jnp.float32(0.5)),
+                        tile_rows=tile),
             })
         return ms
 

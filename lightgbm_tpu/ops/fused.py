@@ -1,0 +1,466 @@
+"""Fused Pallas histogram→split megakernel: one HBM pass per level.
+
+Why this module exists (ROADMAP item 2, bench telemetry): the staged
+pipeline runs histogram build and split-gain scan as SEPARATE device
+programs with the materialized ``[L, ch, F, B]`` histogram round-tripping
+through HBM between them — ``mfu_histogram_lower_bound`` pinned at
+~0.0005 even in the best sorted-arena run (0.852 s/tree at 1M×28).  The
+GPU prior art (shared-memory histograms: Wen et al., arXiv 1706.08359;
+XGBoost GPU, arXiv 1806.11248) accumulates bins in fast on-chip memory
+and scans gains before ever writing back; this is the TPU/Pallas shape
+of that move:
+
+- Grid = (feature blocks, row tiles), row axis fastest.  Each binned
+  row tile streams HBM→VMEM ONCE per level (Pallas' block pipeline
+  double-buffers the tile DMA against compute automatically — the
+  planner's ``fused_vmem_bytes`` model charges 2× tile bytes for it).
+- Per-leaf grad/hess bins accumulate into a VMEM scratch arena in the
+  slot-expanded MXU formulation (``segment_histogram_expanded``'s
+  one-hot ⊗ slot-mask matmul — the quantized 2×64-slot layout fills one
+  s8 MXU tile exactly), so the arena never leaves the chip between the
+  build and the scan.
+- After the last tile, STILL IN-KERNEL: sibling-subtraction children
+  derive their histograms from the parent arena carried alongside the
+  scratch (``sibling = parent − smaller``), the quantized arena is
+  rescaled (``quant_rescale_hist``'s formulas, kept in lockstep), and
+  the per-feature cumulative-sum gain scan runs — BOTH missing-direction
+  sweeps, the L1/L2 thresholds — via ``ops.split.numeric_feature_scan``,
+  the SAME function the staged pipeline calls, so fused == staged
+  per-feature-best tuples are bit-identical by construction given
+  bit-identical histograms (exactly the case for the integer family:
+  int32 accumulation is associative).
+- Writeback per level is the tiny ``[children, F]`` per-feature-best
+  tuple set (gain, bin, direction, left sums) plus the one smaller-child
+  histogram the growers' subtraction cache needs — the staged pipeline's
+  extra hist-cache read for the scan (and the sibling's write+read) never
+  happens.  ``hist_scan_traffic_bytes`` is the accounting twin.
+
+Scope: the numeric-feature scan (the common case — the growers gate the
+fused arm off for categorical features, EFB bundles, monotone
+constraints, per-node randomness, CEGB/forced splits and sharded axes,
+falling back to the staged family; ``hist_method=auto`` elects fused
+only when ``ops.planner.plan_fused`` proves the VMEM arena fits).
+"One HBM pass per LEVEL" is the rounds grower's contract (one kernel
+per frontier round); the serial grower's fused arm streams the full
+matrix once per SPLIT with no leaf compaction — it exists for mode
+completeness and the parity suite, so ``auto`` only elects fused where
+the rounds grower runs (explicit ``hist_method=fused`` still honors a
+forced ``tpu_tree_growth=serial``).
+
+Off-accelerator the whole family runs under
+``pl.pallas_call(..., interpret=True)`` so tier-1's ``JAX_PLATFORMS=cpu``
+pytest run executes the kernels instead of skipping them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .histogram import _pad_rows, on_accelerator, resolve_tile_rows
+from .split import (K_MIN_SCORE, MAX_CAT_WORDS, NumericFeatureBest,
+                    SplitHyperparams, SplitResult, numeric_feature_scan,
+                    quant_rescale_hist)
+
+# row-tile (VMEM block) and feature-block defaults; the planner's
+# plan_fused() picks per-shape values against the VMEM budget
+_DEF_BLOCK_ROWS = 512
+_DEF_FEAT_TILE = 8
+
+
+def _interp(interpret: Optional[bool]) -> bool:
+    return (not on_accelerator()) if interpret is None else bool(interpret)
+
+
+def hist_scan_traffic_bytes(num_candidates: int, num_features: int,
+                            num_bins: int, quant: bool = False) -> int:
+    """Per-level HBM bytes the fused kernel does NOT move vs staged.
+
+    Staged, per round of K candidates: the split scan re-reads both
+    children's histograms (2K·ch·F·B cells) and the sibling histograms
+    are written+read through the cache (K·ch·F·B each way).  Fused scans
+    in VMEM and derives siblings in-kernel, so exactly this term drops;
+    ``tools/hist_probe.py --fused`` journals it next to the measured
+    ``bytes_accessed`` delta."""
+    ch = 2 if quant else 3
+    cell = ch * num_features * num_bins * 4
+    return num_candidates * cell * 4          # 2K scan reads + K write + K read
+
+
+def _fused_call(
+    binned_t: jax.Array,          # [F, n] uint8/uint16 feature-major
+    vals_t: jax.Array,            # f32 [3, n] (g,h,1)*w  |  int8 [2, n]
+    slot: jax.Array,              # [n] i32 in [0, K]; K = dropped
+    num_slots: int,
+    num_bins: int,
+    child_sums: jax.Array,        # [3, NC] f32 (sum_grad, sum_hess, count)
+    meta_vecs: tuple,             # (num_bin, missing_type, default_bin) [F]
+    hp: SplitHyperparams,
+    small_left: Optional[jax.Array] = None,   # [K] bool (with parent)
+    parent_hist: Optional[jax.Array] = None,  # [K, ch, F, B]
+    quant_scales: Optional[tuple] = None,     # (g_scale, h_scale) traced
+    feat_tile: Optional[int] = None,
+    block_rows: Optional[int] = None,
+    tile_rows: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """One megakernel invocation; returns ``(slot_hist [K, ch, F, B],
+    NumericFeatureBest [NC, F])`` with NC = 2K (parent mode: children are
+    [left 0..K-1, right K..2K-1]) or K (leaf mode: the slot histograms
+    themselves are scanned)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    quant = vals_t.dtype == jnp.int8
+    ch = int(vals_t.shape[0])
+    acc_dtype = jnp.int32 if quant else jnp.float32
+    F, n = binned_t.shape
+    K = int(num_slots)
+    B = int(num_bins)
+    with_parent = parent_hist is not None
+    NC = 2 * K if with_parent else K
+    if quant and quant_scales is None:
+        raise ValueError("quantized fused kernel needs quant_scales")
+
+    if feat_tile is None or block_rows is None:
+        from .planner import plan_fused
+        fp = plan_fused(K, B, quant, with_parent=with_parent)
+        if feat_tile is None:
+            feat_tile = fp["feat_tile"] if fp else 1
+        if block_rows is None:
+            block_rows = fp["block_rows"] if fp else 128
+    Ft = max(1, min(int(feat_tile), F))
+    # tile_rows (the planner's row-tile budget) CAPS the VMEM block like
+    # the staged family's _tile_block: peak per-step bytes track the tile
+    T = resolve_tile_rows(tile_rows, n)
+    C = int(block_rows)
+    if T is not None:
+        C = min(C, max(128, _pad_rows(T, 128)))
+    C = max(128, C)
+
+    n_pad = _pad_rows(n, C)
+    F_pad = _pad_rows(F, Ft)
+    bt = binned_t
+    if n_pad != n or F_pad != F:
+        bt = jnp.pad(bt, ((0, F_pad - F), (0, n_pad - n)))
+    vt = jnp.pad(vals_t, ((0, 0), (0, n_pad - n))) if n_pad != n else vals_t
+    st = jnp.pad(slot.astype(jnp.int32), (0, n_pad - n),
+                 constant_values=K)[None, :]               # [1, n_pad]
+    num_bin_v, missing_v, default_v = meta_vecs
+    meta = jnp.stack([jnp.asarray(num_bin_v, jnp.int32),
+                      jnp.asarray(missing_v, jnp.int32),
+                      jnp.asarray(default_v, jnp.int32)])  # [3, F]
+    if F_pad != F:
+        # padded features: num_bin 0 -> every bin invalid -> gain -inf
+        meta = jnp.pad(meta, ((0, 0), (0, F_pad - F)))
+    sums = jnp.asarray(child_sums, jnp.float32)            # [3, NC]
+    nf_blocks = F_pad // Ft
+    nt = n_pad // C
+
+    in_arrays = [bt, vt, st]
+    in_specs = [
+        pl.BlockSpec((Ft, C), lambda j, i: (j, i)),
+        pl.BlockSpec((ch, C), lambda j, i: (0, i)),
+        pl.BlockSpec((1, C), lambda j, i: (0, i)),
+    ]
+    if with_parent:
+        in_arrays.append(parent_hist.astype(acc_dtype))
+        in_specs.append(pl.BlockSpec((K, ch, Ft, B),
+                                     lambda j, i: (0, 0, j, 0)))
+        in_arrays.append(small_left.astype(jnp.int32)[None, :])  # [1, K]
+        in_specs.append(pl.BlockSpec((1, K), lambda j, i: (0, 0)))
+    in_arrays.append(sums)
+    in_specs.append(pl.BlockSpec((3, NC), lambda j, i: (0, 0)))
+    in_arrays.append(meta)
+    in_specs.append(pl.BlockSpec((3, Ft), lambda j, i: (0, j)))
+    if quant:
+        in_arrays.append(jnp.stack([jnp.asarray(quant_scales[0], jnp.float32),
+                                    jnp.asarray(quant_scales[1],
+                                                jnp.float32)])[None, :])
+        in_specs.append(pl.BlockSpec((1, 2), lambda j, i: (0, 0)))
+
+    def kernel(*refs):
+        it = iter(refs)
+        b_ref = next(it)
+        v_ref = next(it)
+        s_ref = next(it)
+        p_ref = next(it) if with_parent else None
+        sl_ref = next(it) if with_parent else None
+        sum_ref = next(it)
+        m_ref = next(it)
+        sc_ref = next(it) if quant else None
+        hist_ref = next(it)
+        gn_ref = next(it)
+        th_ref = next(it)
+        dl_ref = next(it)
+        lg_ref = next(it)
+        lh_ref = next(it)
+        lc_ref = next(it)
+        acc = next(it)
+
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _init():
+            acc[...] = jnp.zeros_like(acc)
+
+        # ---- accumulate: slot-expanded one-hot matmul on this tile ----
+        blk = b_ref[...].astype(jnp.int32)                 # [Ft, C]
+        sl = s_ref[0, :]                                   # [C]
+        iota_s = lax.broadcasted_iota(jnp.int32, (K, C), 0)
+        oh_s = sl[None, :] == iota_s                       # [K, C]
+        v = v_ref[...]                                     # [ch, C]
+        iota_b = lax.broadcasted_iota(jnp.int32, (C, Ft, B), 2)
+        ohb = blk.T[:, :, None] == iota_b                  # [C, Ft, B]
+        if quant:
+            lhs = (v[:, None, :] * oh_s[None].astype(jnp.int8)
+                   ).reshape(ch * K, C)
+            part = lax.dot(lhs, ohb.astype(jnp.int8).reshape(C, Ft * B),
+                           preferred_element_type=jnp.int32)
+        else:
+            lhs = (v[:, None, :] * oh_s[None].astype(jnp.float32)
+                   ).reshape(ch * K, C)
+            part = lax.dot(lhs, ohb.astype(jnp.float32).reshape(C, Ft * B),
+                           precision=lax.Precision.HIGHEST,
+                           preferred_element_type=jnp.float32)
+        acc[...] += part
+
+        # ---- epilogue after the last tile: derive + scan in VMEM ----
+        @pl.when(i == nt - 1)
+        def _epilogue():
+            small = acc[...].reshape(ch, K, Ft, B).transpose(1, 0, 2, 3)
+            hist_ref[...] = small
+            if with_parent:
+                parent = p_ref[...]
+                s_is_left = (sl_ref[0, :] != 0)[:, None, None, None]
+                h_left = jnp.where(s_is_left, small, parent - small)
+                h_right = parent - h_left
+                ch_hist = jnp.concatenate([h_left, h_right], axis=0)
+            else:
+                ch_hist = small
+            sums_k = sum_ref[...]
+            sg, sh, cnt = sums_k[0], sums_k[1], sums_k[2]
+            if quant:
+                # the SHARED rescale body (batched over children; its
+                # default count factor reads the block's FIRST feature —
+                # any feature's bins partition the child's rows, so the
+                # integer total equals the staged feature-0 total
+                # bit-for-bit)
+                hist3 = quant_rescale_hist(ch_hist, sc_ref[0, 0],
+                                           sc_ref[0, 1], cnt)
+            else:
+                hist3 = ch_hist
+            res = numeric_feature_scan(
+                hist3, sg, sh, cnt, m_ref[0, :], m_ref[1, :], m_ref[2, :],
+                hp)
+            gn_ref[...] = res.gain
+            th_ref[...] = res.threshold
+            dl_ref[...] = res.default_left.astype(jnp.int32)
+            lg_ref[...] = res.left_sum_grad
+            lh_ref[...] = res.left_sum_hess
+            lc_ref[...] = res.left_count
+
+    tuple_spec = pl.BlockSpec((NC, Ft), lambda j, i: (0, j))
+    out = pl.pallas_call(
+        kernel,
+        grid=(nf_blocks, nt),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((K, ch, Ft, B), lambda j, i: (0, 0, j, 0)),
+            tuple_spec, tuple_spec, tuple_spec, tuple_spec, tuple_spec,
+            tuple_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, ch, F_pad, B), acc_dtype),
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # gain
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.int32),     # threshold
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.int32),     # default_left
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # left_sum_grad
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # left_sum_hess
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # left_count
+        ],
+        scratch_shapes=[pltpu.VMEM((ch * K, Ft * B), acc_dtype)],
+        interpret=_interp(interpret),
+    )(*in_arrays)
+    hist, gain, thr, dl, lgs, lhs_, lcs = out
+    best = NumericFeatureBest(
+        gain=gain[:, :F], threshold=thr[:, :F],
+        default_left=dl[:, :F].astype(bool),
+        left_sum_grad=lgs[:, :F], left_sum_hess=lhs_[:, :F],
+        left_count=lcs[:, :F])
+    return hist[:, :, :F, :], best
+
+
+def fused_segment_splits(
+    binned_t: jax.Array,
+    vals_t: jax.Array,
+    slot: jax.Array,
+    num_slots: int,
+    num_bins: int,
+    slot_sums: jax.Array,          # [3, K] per-slot (sum_g, sum_h, count)
+    num_bin: jax.Array,
+    missing_type: jax.Array,
+    default_bin: jax.Array,
+    hp: SplitHyperparams,
+    quant_scales: Optional[tuple] = None,
+    feat_tile: Optional[int] = None,
+    block_rows: Optional[int] = None,
+    tile_rows: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Leaf mode: build K slot histograms AND their per-feature-best
+    numeric splits in one pass.  Returns ``(hist [K, ch, F, B],
+    NumericFeatureBest [K, F])`` — the staged equivalent is
+    ``segment_histogram*`` + (rescale +) ``feature_best_splits`` with the
+    full histogram round-tripping through HBM in between."""
+    return _fused_call(
+        binned_t, vals_t, slot, num_slots, num_bins, slot_sums,
+        (num_bin, missing_type, default_bin), hp,
+        quant_scales=quant_scales, feat_tile=feat_tile,
+        block_rows=block_rows, tile_rows=tile_rows, interpret=interpret)
+
+
+def fused_frontier_splits(
+    binned_t: jax.Array,
+    vals_t: jax.Array,
+    slot: jax.Array,               # [n] i32: candidate rank of the row's
+                                   # SMALLER child, K = dropped
+    num_slots: int,                # K (the frontier width)
+    num_bins: int,
+    child_sums: jax.Array,         # [3, 2K] (left children, right children)
+    small_left: jax.Array,         # [K] bool: smaller child is the LEFT one
+    parent_hist: jax.Array,        # [K, ch, F, B] candidates' parent hists
+    num_bin: jax.Array,
+    missing_type: jax.Array,
+    default_bin: jax.Array,
+    hp: SplitHyperparams,
+    quant_scales: Optional[tuple] = None,
+    feat_tile: Optional[int] = None,
+    block_rows: Optional[int] = None,
+    tile_rows: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Frontier mode (the growers' per-level call): accumulate the K
+    smaller-child histograms in VMEM, derive each sibling from the parent
+    arena in-kernel, scan BOTH children, and write back the smaller-child
+    histograms (the subtraction cache's input) plus ``[2K, F]``
+    per-feature-best tuples — one streamed pass over the binned matrix
+    per level."""
+    return _fused_call(
+        binned_t, vals_t, slot, num_slots, num_bins, child_sums,
+        (num_bin, missing_type, default_bin), hp,
+        small_left=small_left, parent_hist=parent_hist,
+        quant_scales=quant_scales, feat_tile=feat_tile,
+        block_rows=block_rows, tile_rows=tile_rows, interpret=interpret)
+
+
+def pick_fused_best(best: NumericFeatureBest, sum_grad, sum_hess, num_data,
+                    feature_mask: Optional[jax.Array] = None) -> SplitResult:
+    """argmax over features of fused per-feature-best tuples — the
+    numeric twin of ``ops.split.pick_best_feature`` (ties -> smaller
+    feature index), vectorized over the leading children axis.  The
+    feature mask applies here (outside the kernel): masking gains after
+    the scan is exactly what ``feature_best_splits`` does inside."""
+    gain = best.gain
+    if feature_mask is not None:
+        gain = jnp.where(feature_mask.astype(bool), gain, K_MIN_SCORE)
+    f = jnp.argmax(gain, axis=-1).astype(jnp.int32)
+
+    def sel(a):
+        return jnp.take_along_axis(a, f[..., None], -1)[..., 0]
+
+    blg = sel(best.left_sum_grad)
+    blh = sel(best.left_sum_hess)
+    blc = sel(best.left_count)
+    return SplitResult(
+        gain=sel(gain), feature=f,
+        threshold=sel(best.threshold),
+        default_left=sel(best.default_left),
+        left_sum_grad=blg, left_sum_hess=blh, left_count=blc,
+        right_sum_grad=jnp.asarray(sum_grad) - blg,
+        right_sum_hess=jnp.asarray(sum_hess) - blh,
+        right_count=jnp.asarray(num_data).astype(jnp.float32) - blc,
+        is_categorical=jnp.zeros(f.shape, bool),
+        cat_bitset=jnp.zeros(f.shape + (MAX_CAT_WORDS,), jnp.uint32))
+
+
+# one-time per-backend verdict: does the fused megakernel COMPILE AND
+# AGREE with the staged pipeline on this backend?  {backend_name: bool}
+_FUSED_PROBE: dict = {}
+
+
+def fused_kernel_verified() -> bool:
+    """Compile + run the fused kernel at a tiny shape on the live backend
+    and check its tuples against the staged scan.
+
+    The scan epilogue leans on ops (cumsum, argmax, take_along_axis)
+    whose Pallas/Mosaic lowering varies by backend and jax version; a
+    backend where any of them fails must NOT be elected by
+    ``hist_method=auto`` — it falls back to the staged family instead of
+    crashing the trace (same pattern as histogram.py's
+    ``_table_matmul_verified``).  Off-accelerator (interpret mode) the
+    kernel is plain jax — verified trivially."""
+    backend = jax.default_backend()
+    ok = _FUSED_PROBE.get(backend)
+    if ok is not None:
+        return ok
+    if not on_accelerator():
+        _FUSED_PROBE[backend] = True
+        return True
+    try:
+        rng = np.random.RandomState(0)
+        F, n, B, K = 4, 256, 8, 2
+        binned = jnp.asarray(rng.randint(0, B - 1, (F, n)), jnp.uint8)
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        h = jnp.abs(g) + 0.1
+        vals = jnp.stack([g, h, jnp.ones_like(g)])
+        slot = jnp.asarray(rng.randint(0, K + 1, n), jnp.int32)
+        sums = []
+        for k in range(K):
+            m = np.asarray(slot) == k
+            sums.append([float(np.asarray(g)[m].sum()),
+                         float(np.asarray(h)[m].sum()), float(m.sum())])
+        sums = jnp.asarray(np.asarray(sums).T, jnp.float32)
+        nb = jnp.full((F,), B, jnp.int32)
+        zero = jnp.zeros((F,), jnp.int32)
+        hp = SplitHyperparams(min_data_in_leaf=1)
+        hist, best = jax.jit(
+            lambda b, v, s, su: fused_segment_splits(
+                b, v, s, K, B, su, nb, zero, zero, hp,
+                feat_tile=2, block_rows=128))(binned, vals, slot, sums)
+        # BOTH halves of the kernel are checked: the accumulated
+        # histograms against the staged scatter segment pass (a Mosaic
+        # mis-lowering of the slot-expanded dot would be internally
+        # consistent with the in-kernel scan, so scan parity alone
+        # cannot catch it), and the scan against the shared body
+        from .histogram import segment_histogram
+        ref_hist = segment_histogram(binned, g, h, jnp.ones_like(g),
+                                     slot, K, B)
+        ok = bool(np.allclose(np.asarray(hist), np.asarray(ref_hist),
+                              rtol=1e-4, atol=1e-3))
+        ref = numeric_feature_scan(hist.astype(jnp.float32), sums[0],
+                                   sums[1], sums[2], nb, zero, zero, hp)
+        ok = ok and bool(np.allclose(np.asarray(best.gain),
+                                     np.asarray(ref.gain), equal_nan=True))
+    except Exception:
+        ok = False
+    _FUSED_PROBE[backend] = ok
+    if not ok:
+        import warnings
+        warnings.warn(
+            f"fused histogram→split megakernel is unavailable on backend "
+            f"{jax.default_backend()!r}; hist_method=auto falls back to "
+            "the staged kernel family (set tpu_hist_method explicitly to "
+            "override)")
+    return ok
+
+
+def fused_enabled_env() -> bool:
+    """LGBM_TPU_FUSED=0 drops the fused arm (compile-cost bisect hook,
+    mirroring LGBM_TPU_SEGHIST / LGBM_TPU_ROUTER)."""
+    return os.environ.get("LGBM_TPU_FUSED") != "0"

@@ -74,7 +74,15 @@ def resolve_hist_method(method: str, quantized: bool = False) -> str:
     accelerators, packed scatter on CPU.  A forced f32-family name maps
     to its integer analogue so ``tpu_hist_method`` keeps steering the
     matmul-vs-scatter axis in either mode.
+
+    ``method="fused"`` (the Pallas histogram→split megakernel,
+    ops/fused.py) resolves to itself in BOTH families — the growers gate
+    where it actually applies and this module's plain-histogram entry
+    points (``build_histogram*``) map it to the staged auto kernel,
+    since a bare histogram has no split scan to fuse.
     """
+    if method == "fused":
+        return "fused"
     if quantized:
         if method in ("matmul_int8", "scatter_int"):
             return method
@@ -177,6 +185,7 @@ def histogram_pallas(
     block_rows: int = 512,
     feat_tile: int = 8,
     interpret: Optional[bool] = None,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Histogram via a Pallas VPU kernel accumulating in VMEM.
 
@@ -193,12 +202,21 @@ def histogram_pallas(
     TPU-shaped third answer.  Grid = (feature tiles, row blocks); the row
     axis iterates fastest so each feature tile's accumulator initializes
     once (@pl.when i==0) and revisits its output block across row blocks.
+
+    ``tile_rows`` (the ops/planner.py row-tile budget) CAPS the VMEM row
+    block like the matmul family's ``_tile_block``: the kernel was always
+    streamed with an O(block) transient, so under a tile budget the block
+    simply shrinks to min(block, tile) — this brings the one previously
+    unbudgeted kernel in the family under the same planner accounting
+    (``predict_peak_bytes`` variant "pallas"), so ``auto`` can elect it
+    safely.  Off-accelerator the kernel runs ``interpret=True`` so the
+    tier-1 CPU pytest run executes it rather than skipping.
     """
     from jax.experimental import pallas as pl
 
     F, n = binned_t.shape
     B = num_bins
-    C = block_rows
+    C = _tile_block(block_rows, resolve_tile_rows(tile_rows, n))
     Ft = min(feat_tile, F)
     if interpret is None:
         interpret = not on_accelerator()
@@ -314,6 +332,17 @@ def build_histogram(
     see ops/planner.py).
     """
     vals_t = _vals_t(grad, hess, mask)
+    # "fused" is a grower-level arm (ops/fused.py pairs the histogram
+    # with its split scan); a bare histogram maps to a staged kernel.
+    # PRECISION PAIRING (same invariant as the growers' seg_f32): the
+    # fused kernel accumulates f32-exact (HIGHEST one-hot dot), and its
+    # in-kernel sibling subtraction consumes THIS kernel's output as the
+    # parent — so the root/parent pass must be f32-exact too, never the
+    # bf16 one-hot (a bf16 parent minus an exact child could go negative
+    # in derived sibling bins).  matmul_f32 on accelerators, auto
+    # (scatter, exact) on CPU.
+    if method == "fused":
+        method = "matmul_f32" if on_accelerator() else "auto"
     method = resolve_hist_method(method)
     if method == "matmul":
         return histogram_matmul(binned_t, vals_t, num_bins, block_rows,
@@ -325,7 +354,8 @@ def build_histogram(
         return histogram_scatter(binned_t, vals_t, num_bins,
                                  tile_rows=tile_rows)
     if method == "pallas":
-        return histogram_pallas(binned_t, vals_t, num_bins)
+        return histogram_pallas(binned_t, vals_t, num_bins,
+                                tile_rows=tile_rows)
     raise ValueError(f"unknown histogram method {method!r}")
 
 
@@ -1357,7 +1387,8 @@ def build_histogram_int(
     over ``member`` rows — the quantized twin of ``build_histogram``,
     dispatched through the same ``resolve_hist_method`` seam."""
     vals_t = _vals_t_int(gq, hq, member)
-    method = resolve_hist_method(method, quantized=True)
+    method = resolve_hist_method("auto" if method == "fused" else method,
+                                 quantized=True)
     if method == "matmul_int8":
         return histogram_matmul_int(binned_t, vals_t, num_bins, block_rows,
                                     tile_rows=tile_rows)

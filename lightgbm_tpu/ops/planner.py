@@ -55,6 +55,13 @@ HEADROOM = 0.85
 MIN_TILE_ROWS = 1 << 16
 _DEFAULT_BLOCK_ROWS = 4096
 
+# on-chip vector memory per core (v5e-class ~16 MiB; LGBM_TPU_VMEM_BYTES
+# overrides) and the fraction the fused megakernel's arena may claim —
+# Mosaic needs slack for the pipeline's double-buffered tile windows and
+# spills
+DEFAULT_VMEM_BYTES = 16 << 20
+VMEM_HEADROOM = 0.7
+
 
 def _pad(x: int, m: int) -> int:
     return -(-int(x) // m) * m
@@ -90,6 +97,11 @@ class HistPlan(NamedTuple):
     limit_source: str           # "memory_stats" | "env" | "default"
     feasible: bool              # predicted peak fits the budget
     degraded: bool              # tiling was forced by the budget
+    fused: bool = False         # fused Pallas megakernel elected
+    fused_feat_tile: int = 0    # features per VMEM arena block
+    fused_block_rows: int = 0   # rows per double-buffered tile DMA
+    fused_vmem_bytes: int = 0   # predicted VMEM arena bytes at that shape
+    vmem_limit_bytes: int = 0   # VMEM limit the fused election ran against
 
     def summary(self) -> dict:
         """JSON-friendly form for bench journals / telemetry."""
@@ -106,6 +118,11 @@ class HistPlan(NamedTuple):
             "limit_source": self.limit_source,
             "feasible": self.feasible,
             "degraded": self.degraded,
+            "fused": self.fused,
+            "fused_feat_tile": self.fused_feat_tile,
+            "fused_block_rows": self.fused_block_rows,
+            "fused_vmem_bytes": self.fused_vmem_bytes,
+            "vmem_limit_bytes": self.vmem_limit_bytes,
         }
 
 
@@ -132,6 +149,67 @@ def hbm_limit_bytes() -> tuple:
     except Exception:
         pass
     return DEFAULT_HBM_BYTES, "default"
+
+
+def vmem_limit_bytes() -> int:
+    """VMEM per core for the fused megakernel's arena election
+    (``LGBM_TPU_VMEM_BYTES`` overrides; tests plan against fakes)."""
+    env = os.environ.get("LGBM_TPU_VMEM_BYTES", "").strip()
+    if env:
+        try:
+            return max(int(float(env)), 1)
+        except ValueError:
+            pass
+    return DEFAULT_VMEM_BYTES
+
+
+def fused_vmem_bytes(num_slots: int, num_bins: int, feat_tile: int,
+                     block_rows: int, quant: bool = False,
+                     with_parent: bool = True) -> int:
+    """Predicted VMEM bytes of one fused-megakernel step (ops/fused.py).
+
+    Resident across the row loop: the [ch·K, Ft·B] accumulator arena,
+    the parent block and the double-buffered input tile windows; the
+    epilogue additionally materializes the 2K children (+ their rescale/
+    prefix transients) and the tiny tuple blocks.  Deliberately simple —
+    the right ORDER for the fits/doesn't verdict, like
+    ``predict_peak_bytes``."""
+    K = max(int(num_slots), 1)
+    B = max(int(num_bins), 2)
+    Ft = max(int(feat_tile), 1)
+    C = max(int(block_rows), 128)
+    ch = 2 if quant else 3
+    nc = 2 * K if with_parent else K
+    acc = ch * K * Ft * B * 4
+    parent = K * ch * Ft * B * 4 if with_parent else 0
+    small_out = K * ch * Ft * B * 4
+    # epilogue: children + one prefix/rescale transient of the same shape
+    children = 2 * nc * 3 * Ft * B * 4
+    # double-buffered tile DMA windows: binned (1B), vals (<=4B), slot,
+    # plus the one-hot operand the dot consumes
+    tiles = 2 * (Ft * C + ch * C * 4 + C * 4)
+    onehot = C * Ft * B * (1 if quant else 4)
+    tuples = 6 * nc * Ft * 4
+    return acc + parent + small_out + children + tiles + onehot + tuples
+
+
+def plan_fused(num_slots: int, num_bins: int, quant: bool = False,
+               with_parent: bool = True,
+               vmem_bytes: Optional[int] = None) -> Optional[dict]:
+    """Pick {feat_tile, block_rows} for the fused megakernel, or None
+    when no shape fits the VMEM budget (the staged family then keeps the
+    level).  Preference order: widest feature block first (fewer grid
+    columns, better MXU occupancy), then the larger row tile."""
+    limit = int(vmem_bytes if vmem_bytes is not None else vmem_limit_bytes())
+    budget = int(limit * VMEM_HEADROOM)
+    for ft in (8, 4, 2, 1):
+        for c in (512, 256, 128):
+            need = fused_vmem_bytes(num_slots, num_bins, ft, c, quant,
+                                    with_parent)
+            if need <= budget:
+                return {"feat_tile": ft, "block_rows": c,
+                        "vmem_bytes": need, "vmem_limit_bytes": limit}
+    return None
 
 
 def predict_peak_bytes(
@@ -200,6 +278,20 @@ def predict_peak_bytes(
         # accel) + [T, F] i32 flat indices
         b["scatter_updates"] = _arr(ch, T * F, hitem, accel)
         b["scatter_index"] = _arr(F, T, 4, accel)
+    elif variant == "pallas":
+        # VPU kernel: the accumulator and tile windows live in VMEM; HBM
+        # transients are just the padded vals copy and the (small)
+        # blocked output already counted in seg_hist/hist_cache
+        b["vals_pad"] = _arr(n, ch, 4, accel)
+    elif variant == "fused":
+        # fused megakernel (ops/fused.py): the arena and one-hot operands
+        # are VMEM-resident (modeled by fused_vmem_bytes, a SEPARATE
+        # budget); HBM sees the streamed tiles, the smaller-child hist
+        # writeback (seg_hist above) and the tiny tuple outputs — the
+        # [L,ch,F,B] scan round-trip term is exactly what this variant
+        # deletes
+        b["vals_pad"] = _arr(n, ch, 4, accel)
+        b["fused_tuples"] = 6 * _arr(F, 2 * S, 4, accel)
     elif variant.startswith("matmul"):
         onehot_item = 1 if (quant or variant == "matmul") else 4
         if variant == "matmul" and not quant:
@@ -229,9 +321,14 @@ def predict_peak_bytes(
 
 def _resolved_variant(method: str, quant: bool) -> str:
     from .histogram import resolve_hist_method, use_sorted_seghist
-    m = resolve_hist_method(method, quantized=quant)
+    # "fused" models at the staged family here; fused election is a
+    # separate verdict in plan_histograms (VMEM budget, plan_fused)
+    m = resolve_hist_method("auto" if method == "fused" else method,
+                            quantized=quant)
     # the segment passes dominate peak; their dispatch follows
-    # use_sorted_seghist, not the point-histogram method
+    # use_sorted_seghist, not the point-histogram method — a forced
+    # "pallas" POINT kernel still runs sorted-arena segment passes on
+    # accelerators, so the peak model must keep those terms
     if use_sorted_seghist():
         return "sorted"
     return m
@@ -263,6 +360,8 @@ def plan_histograms(
     machines: int = 1,
     budget_bytes: Optional[int] = None,   # tests: fake memory model
     accel: Optional[bool] = None,
+    fused_ok: bool = False,               # caller-verified fused context
+    vmem_bytes: Optional[int] = None,     # tests: fake VMEM model
 ) -> HistPlan:
     """Choose {tile_rows, use_pack, psum narrowing} for a training shape.
 
@@ -273,7 +372,16 @@ def plan_histograms(
     materialized in tiled mode).  ``feasible=False`` means even
     MIN_TILE_ROWS does not fit: the caller should refuse to launch the
     shape rather than hand XLA a guaranteed OOM.
+
+    ``fused_ok=True`` (the caller proved the semantic context applies:
+    numeric features, no bundles/monotone/per-node randomness, unsharded
+    axes — GBDT._build_jit_fns) lets ``method`` "auto"/"fused" elect the
+    fused Pallas histogram→split megakernel (ops/fused.py): elected ONLY
+    when ``plan_fused`` proves its VMEM arena fits, so the staged family
+    remains the fallback arm and an explicit ``hist_method=fused`` that
+    does not fit degrades to staged instead of OOMing VMEM.
     """
+    from .fused import fused_enabled_env
     from .histogram import quant_psum_narrow
 
     if budget_bytes is not None:
@@ -283,25 +391,42 @@ def plan_histograms(
     # HEADROOM applies to EVERY limit source (caller-supplied fake
     # memory models included) so tests exercise the shipped decision rule
     budget = int(limit * HEADROOM)
-    variant = _resolved_variant(method, quant)
+    fp = None
+    if fused_ok and method in ("auto", "fused") and fused_enabled_env():
+        # the frontier never exceeds num_leaves - 1 candidates, so the
+        # arena is sized by the EFFECTIVE round width (grower KCAP)
+        kcap = max(min(int(round_width), int(num_leaves) - 1), 1)
+        fp = plan_fused(kcap, num_bins, quant, with_parent=True,
+                        vmem_bytes=vmem_bytes)
+    variant = "fused" if fp is not None else _resolved_variant(method, quant)
     narrow = bool(quant and quant_psum_narrow(rows * machines, quant_bins))
+    # the fused grower never hoists the pack_cols_u32 record arena (it
+    # gathers nothing), so its plan must not charge — or report — it
+    pack_cap = variant != "fused"
 
     def peak(tile, pack):
         return predict_peak_bytes(
             rows, features, num_bins, num_leaves, num_class, quant,
-            variant, tile, pack, round_width, machines, accel)[0]
+            variant, tile, pack and pack_cap, round_width, machines,
+            accel)[0]
 
     untiled_peak = peak(0, True)
     forced = _tile_override()
 
     def mk(tile, pack, degraded):
+        pack = pack and pack_cap
         p = peak(tile, pack)
         return HistPlan(
             tile_rows=tile, use_pack=pack, variant=variant, quant=quant,
             narrow_int16=narrow, predicted_peak_bytes=p,
             untiled_peak_bytes=untiled_peak, budget_bytes=budget,
             limit_bytes=limit, limit_source=source,
-            feasible=p <= budget, degraded=degraded)
+            feasible=p <= budget, degraded=degraded,
+            fused=fp is not None,
+            fused_feat_tile=fp["feat_tile"] if fp else 0,
+            fused_block_rows=fp["block_rows"] if fp else 0,
+            fused_vmem_bytes=fp["vmem_bytes"] if fp else 0,
+            vmem_limit_bytes=fp["vmem_limit_bytes"] if fp else 0)
 
     if forced is not None:
         if forced == 0 or forced >= rows:
@@ -319,18 +444,24 @@ def plan_histograms(
     return mk(tile, False, True)
 
 
-def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None):
+def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None,
+               fused_ok: bool = False):
     """Thread a plan into a ``GrowerConfig``; returns (cfg, plan).
 
     Shared by the GBDT layer (per-shard rows) and the standalone
     parallel learners so every path trains under the same verdict.
+    ``fused_ok`` carries the caller's semantic-applicability verdict for
+    the fused megakernel; when the plan elects it, ``hist_method`` flips
+    to "fused" and the kernel's {feat_tile, block_rows} ride along — and
+    when an EXPLICIT hist_method="fused" fails the VMEM election, the
+    config degrades to the staged auto family instead of OOMing.
     """
     plan = plan_histograms(
         rows=rows, features=features, num_bins=cfg.num_bins,
         num_leaves=cfg.num_leaves, quant=cfg.quant,
         quant_bins=cfg.quant_bins, method=cfg.hist_method,
         round_width=cfg.round_width, machines=max(cfg.num_machines, 1),
-        accel=accel)
+        accel=accel, fused_ok=fused_ok)
     # first-class predicted-peak event (docs/OBSERVABILITY.md): the bench
     # logs the allocator's MEASURED peak next to it, so memory-model
     # drift is visible per run on the same timeline
@@ -338,4 +469,23 @@ def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None):
     instant("planner.plan", rows=rows, features=features, **plan.summary())
     cfg = cfg._replace(tile_rows=plan.tile_rows,
                        hist_pack=cfg.hist_pack and plan.use_pack)
+    if plan.fused:
+        cfg = cfg._replace(hist_method="fused",
+                           fused_feat_tile=plan.fused_feat_tile,
+                           fused_block_rows=plan.fused_block_rows)
+    elif cfg.hist_method == "fused":
+        from .fused import fused_enabled_env
+        if fused_ok and fused_enabled_env():
+            # the VMEM election actually ran and declined; the env-gate
+            # (LGBM_TPU_FUSED=0) and context rejections are explained by
+            # their own channels (the bisect operator / GBDT's gate
+            # warning / make_sharded_grower's note)
+            from ..utils.log import log_warning
+            log_warning(
+                "hist_method=fused: the fused megakernel's VMEM arena "
+                f"does not fit at round_width={cfg.round_width}, "
+                f"num_bins={cfg.num_bins} "
+                f"(limit {vmem_limit_bytes()} bytes; LGBM_TPU_VMEM_BYTES "
+                "overrides); falling back to the staged kernel family")
+        cfg = cfg._replace(hist_method="auto")
     return cfg, plan

@@ -31,6 +31,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..binning import MissingType
 
@@ -118,6 +119,186 @@ class PerFeatureBest(NamedTuple):
     cat_bitset: jax.Array     # [F, MAX_CAT_WORDS]
 
 
+class NumericFeatureBest(NamedTuple):
+    """Per-feature best NUMERIC split candidates ([..., F] arrays).
+
+    ``gain`` is already shifted by the leaf's ``parent_gain +
+    min_gain_to_split`` (same convention as ``PerFeatureBest.gain``)."""
+
+    gain: jax.Array
+    threshold: jax.Array     # i32 bin threshold
+    default_left: jax.Array  # bool
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array
+
+
+def numeric_feature_scan(
+    hist: jax.Array,            # [..., 3, F, B] (grad, hess, count leading)
+    sum_grad: jax.Array,        # [...] leaf totals (broadcast against hist)
+    sum_hess: jax.Array,
+    num_data: jax.Array,
+    num_bin: jax.Array,         # [F] i32 static-shaped per-feature bin counts
+    missing_type: jax.Array,    # [F] i32
+    default_bin: jax.Array,     # [F] i32
+    hp: SplitHyperparams,
+    monotone_constraints: Optional[jax.Array] = None,  # [F] i32 in {-1,0,1}
+    leaf_output_bounds: Optional[tuple] = None,        # (min, max) scalars
+    rand_t_u: Optional[jax.Array] = None,  # [F] uniforms: extra-trees random
+                                           # thresholds (one per feature)
+) -> NumericFeatureBest:
+    """The numeric-feature threshold scan of ``feature_best_splits``,
+    extracted as ONE shared body: prefix-sums along the bin axis, both
+    missing-direction sweeps, L1/L2-thresholded gains, masked argmax.
+
+    Shared verbatim by the staged pipeline (``feature_best_splits`` below)
+    and by the fused Pallas megakernel's in-kernel epilogue
+    (``ops/fused.py``), so the two pipelines' per-feature-best tuples are
+    bit-identical BY CONSTRUCTION given bit-identical histograms — the
+    seam the fused == staged parity suite pins.  Supports arbitrary
+    leading batch axes on ``hist`` / the scalar totals (the fused kernel
+    scans a whole frontier of children at once); every op is written
+    batch-agnostic (negative axes, ``broadcasted_iota``) and produces
+    values bit-identical to the historical unbatched code.
+    """
+    F, B = hist.shape[-2], hist.shape[-1]
+    bins = lax.broadcasted_iota(jnp.int32, (F, B), 1)           # [F, B]
+
+    num_data = jnp.asarray(num_data).astype(jnp.float32)
+    sum_grad = jnp.asarray(sum_grad)
+    sum_hess = jnp.asarray(sum_hess)
+    parent_gain = leaf_gain(sum_grad, sum_hess + 2 * K_EPSILON,
+                            hp.lambda_l1, hp.lambda_l2)         # [...]
+    min_gain_shift = parent_gain + hp.min_gain_to_split
+    mgs = min_gain_shift[..., None, None]
+
+    # missing bin per feature: NaN bin = num_bin-1, Zero bin = default_bin.
+    # Features WITHOUT a dedicated missing direction (missing_type None, or
+    # num_bin <= 2 — the reference's dispatch guard) run the plain scan
+    # with the missing bin treated as an ordinary bin
+    # (feature_histogram.hpp:96-258: the two-direction template is only
+    # instantiated for num_bin > 2 with missing handling).
+    has_missing_dir = (missing_type != MissingType.NONE) & (num_bin > 2)
+    miss_bin = jnp.where(
+        missing_type == MissingType.NAN, num_bin - 1,
+        jnp.where(missing_type == MissingType.ZERO, default_bin, -1),
+    )  # [F]; -1 = no missing handling
+    miss_bin = jnp.where(has_missing_dir, miss_bin, -1)
+    is_missing_bin = bins == miss_bin[:, None]                  # [F, B]
+    valid_bin = bins < num_bin[:, None]                         # [F, B]
+
+    drop = is_missing_bin | ~valid_bin                          # [F, B]
+    hist_nm = jnp.where(drop, 0.0, hist)                        # [..., 3, F, B]
+    prefix = jnp.cumsum(hist_nm, axis=-1)
+    miss = jnp.where(is_missing_bin, hist, 0.0).sum(axis=-1)    # [..., 3, F]
+
+    total_g = sum_grad[..., None, None]
+    total_h = (sum_hess + 2 * K_EPSILON)[..., None, None]
+    nd = num_data[..., None, None]
+
+    def eval_dir(missing_left: jax.Array):
+        # left sums at threshold t (non-missing bins <= t, missing by dir)
+        lg = prefix[..., 0, :, :] + jnp.where(missing_left,
+                                              miss[..., 0, :, None], 0.0)
+        lh = prefix[..., 1, :, :] + jnp.where(missing_left,
+                                              miss[..., 1, :, None], 0.0) \
+            + K_EPSILON
+        lc = prefix[..., 2, :, :] + jnp.where(missing_left,
+                                              miss[..., 2, :, None], 0.0)
+        rg = total_g - lg
+        rh = total_h - lh
+        rc = nd - lc
+        ok = (
+            (lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf)
+            & (lh >= hp.min_sum_hessian_in_leaf)
+            & (rh >= hp.min_sum_hessian_in_leaf)
+        )
+        if monotone_constraints is None:
+            gain = leaf_gain(lg, lh, hp.lambda_l1, hp.lambda_l2) + \
+                leaf_gain(rg, rh, hp.lambda_l1, hp.lambda_l2)
+        else:
+            # monotone mode (reference: GetSplitGains USE_MC,
+            # feature_histogram.hpp:714-747): child outputs are clamped
+            # to the leaf's propagated bounds, the gain is computed FROM
+            # the clamped outputs, and the split is rejected when the
+            # clamped outputs violate the feature's constraint direction.
+            lo = leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
+                             hp.max_delta_step)
+            ro = leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
+                             hp.max_delta_step)
+            if leaf_output_bounds is not None:
+                lob = jnp.asarray(leaf_output_bounds[0])[..., None, None]
+                upb = jnp.asarray(leaf_output_bounds[1])[..., None, None]
+                lo = jnp.clip(lo, lob, upb)
+                ro = jnp.clip(ro, lob, upb)
+            mc = monotone_constraints[:, None]
+            bad = ((mc > 0) & (lo > ro)) | ((mc < 0) & (lo < ro))
+            gain = leaf_gain_given_output(lg, lh, hp.lambda_l1,
+                                          hp.lambda_l2, lo) + \
+                leaf_gain_given_output(rg, rh, hp.lambda_l1, hp.lambda_l2, ro)
+            gain = jnp.where(bad, K_MIN_SCORE, gain)
+        gain = jnp.where(ok & (gain > mgs), gain, K_MIN_SCORE)
+        return gain, (lg, lh - K_EPSILON, lc)
+
+    # valid thresholds: t in [0, num_bin-2], t not the missing bin when Zero
+    # thresholds stop one short of the last scannable bin; with a dedicated
+    # NaN bin the last REAL bin is num_bin-2, so t <= num_bin-3 (reference
+    # scan bound: num_bin - 2 - NA_AS_MISSING, feature_histogram.hpp:782+)
+    na_dir = has_missing_dir & (missing_type == MissingType.NAN)
+    t_valid = (bins <
+               (num_bin - 1 - na_dir.astype(jnp.int32))[:, None]) & valid_bin
+    t_valid &= ~((missing_type[:, None] == MissingType.ZERO) & is_missing_bin)
+    if rand_t_u is not None:
+        rand_t = jnp.floor(
+            rand_t_u * jnp.maximum(num_bin - 1, 1).astype(jnp.float32)
+        ).astype(jnp.int32)
+        t_valid &= bins == rand_t[:, None]
+
+    gain_r, left_r = eval_dir(jnp.zeros((F, 1), dtype=bool))   # missing -> R
+    gain_l, left_l = eval_dir(jnp.ones((F, 1), dtype=bool))    # missing -> L
+    gain_r = jnp.where(t_valid, gain_r, K_MIN_SCORE)
+    gain_l = jnp.where(t_valid, gain_l, K_MIN_SCORE)
+    # features without missing handling: reference runs the REVERSE scan only
+    # (missing mass is zero so directions agree); default_left = True there.
+    gain_r = jnp.where(has_missing_dir[:, None], gain_r, K_MIN_SCORE)
+
+    # reverse (missing->left) wins ties; within a direction larger threshold
+    # wins for reverse, smaller for forward (reference iteration order).
+    def argmax_last(x):
+        rev = x[..., ::-1]
+        idx = jnp.argmax(rev, axis=-1)
+        t = x.shape[-1] - 1 - idx
+        return t, jnp.take_along_axis(x, t[..., None], -1)[..., 0]
+
+    t_l, g_l = argmax_last(gain_l)                 # [..., F]
+    t_r_idx = jnp.argmax(gain_r, axis=-1)
+    g_r = jnp.take_along_axis(gain_r, t_r_idx[..., None], -1)[..., 0]
+    use_left = g_l >= g_r                          # ties -> missing-left
+    num_gain = jnp.where(use_left, g_l, g_r)
+    num_thr = jnp.where(use_left, t_l, t_r_idx).astype(jnp.int32)
+
+    def pick(a, b):
+        return jnp.where(
+            use_left,
+            jnp.take_along_axis(a, t_l[..., None], -1)[..., 0],
+            jnp.take_along_axis(b, t_r_idx[..., None], -1)[..., 0])
+
+    num_lg = pick(left_l[0], left_r[0])
+    num_lh = pick(left_l[1], left_r[1])
+    num_lc = pick(left_l[2], left_r[2])
+    # plain-scan features: the reference emits default_left=false for
+    # NaN-type (so NaN-bin rows follow the ordinary bin comparison at the
+    # partition) and default_left=true otherwise (feature_histogram.hpp:
+    # 89,200)
+    num_dl = jnp.where(has_missing_dir, use_left,
+                       missing_type != MissingType.NAN)
+    num_gain = jnp.where(jnp.isfinite(num_gain),
+                         num_gain - min_gain_shift[..., None], K_MIN_SCORE)
+    return NumericFeatureBest(
+        gain=num_gain, threshold=num_thr, default_left=num_dl,
+        left_sum_grad=num_lg, left_sum_hess=num_lh, left_count=num_lc)
+
+
 def feature_best_splits(
     hist: jax.Array,            # [3, F, B] (grad, hess, count leading)
     sum_grad: jax.Array,        # scalar: leaf totals
@@ -154,115 +335,20 @@ def feature_best_splits(
     use_rand = hp.extra_trees and extra_rand_u is not None
 
     num_data = num_data.astype(jnp.float32)
-    parent_gain = leaf_gain(sum_grad, sum_hess + 2 * K_EPSILON, hp.lambda_l1, hp.lambda_l2)
-    min_gain_shift = parent_gain + hp.min_gain_to_split
-
-    # ---- numerical features ------------------------------------------------
-    # missing bin per feature: NaN bin = num_bin-1, Zero bin = default_bin.
-    # Features WITHOUT a dedicated missing direction (missing_type None, or
-    # num_bin <= 2 — the reference's dispatch guard) run the plain scan
-    # with the missing bin treated as an ordinary bin
-    # (feature_histogram.hpp:96-258: the two-direction template is only
-    # instantiated for num_bin > 2 with missing handling).
-    has_missing_dir = (missing_type != MissingType.NONE) & (num_bin > 2)
-    miss_bin = jnp.where(
-        missing_type == MissingType.NAN, num_bin - 1,
-        jnp.where(missing_type == MissingType.ZERO, default_bin, -1),
-    )  # [F]; -1 = no missing handling
-    miss_bin = jnp.where(has_missing_dir, miss_bin, -1)
-    is_missing_bin = bins[None, :] == miss_bin[:, None]             # [F, B]
     valid_bin = bins[None, :] < num_bin[:, None]                    # [F, B]
 
-    drop = (is_missing_bin | ~valid_bin)[None, :, :]
-    hist_nm = jnp.where(drop, 0.0, hist)
-    prefix = jnp.cumsum(hist_nm, axis=2)                            # [3, F, B]
-    miss = jnp.where(is_missing_bin[None, :, :], hist, 0.0).sum(axis=2)  # [3, F]
-
-    total_g, total_h, _ = sum_grad, sum_hess + 2 * K_EPSILON, num_data
-
-    def eval_dir(missing_left: jax.Array):
-        # left sums at threshold t (non-missing bins <= t, missing by dir)
-        lg = prefix[0] + jnp.where(missing_left, miss[0][:, None], 0.0)
-        lh = prefix[1] + jnp.where(missing_left, miss[1][:, None], 0.0) + K_EPSILON
-        lc = prefix[2] + jnp.where(missing_left, miss[2][:, None], 0.0)
-        rg = total_g - lg
-        rh = total_h - lh
-        rc = num_data - lc
-        ok = (
-            (lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf)
-            & (lh >= hp.min_sum_hessian_in_leaf) & (rh >= hp.min_sum_hessian_in_leaf)
-        )
-        if monotone_constraints is None:
-            gain = leaf_gain(lg, lh, hp.lambda_l1, hp.lambda_l2) + \
-                leaf_gain(rg, rh, hp.lambda_l1, hp.lambda_l2)
-        else:
-            # monotone mode (reference: GetSplitGains USE_MC,
-            # feature_histogram.hpp:714-747): child outputs are clamped
-            # to the leaf's propagated bounds, the gain is computed FROM
-            # the clamped outputs, and the split is rejected when the
-            # clamped outputs violate the feature's constraint direction.
-            lo = leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
-            ro = leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
-            if leaf_output_bounds is not None:
-                lob, upb = leaf_output_bounds
-                lo = jnp.clip(lo, lob, upb)
-                ro = jnp.clip(ro, lob, upb)
-            mc = monotone_constraints[:, None]
-            bad = ((mc > 0) & (lo > ro)) | ((mc < 0) & (lo < ro))
-            gain = leaf_gain_given_output(lg, lh, hp.lambda_l1, hp.lambda_l2, lo) + \
-                leaf_gain_given_output(rg, rh, hp.lambda_l1, hp.lambda_l2, ro)
-            gain = jnp.where(bad, K_MIN_SCORE, gain)
-        gain = jnp.where(ok & (gain > min_gain_shift), gain, K_MIN_SCORE)
-        return gain, (lg, lh - K_EPSILON, lc)
-
-    # valid thresholds: t in [0, num_bin-2], t not the missing bin when Zero
-    # thresholds stop one short of the last scannable bin; with a dedicated
-    # NaN bin the last REAL bin is num_bin-2, so t <= num_bin-3 (reference
-    # scan bound: num_bin - 2 - NA_AS_MISSING, feature_histogram.hpp:782+)
-    na_dir = has_missing_dir & (missing_type == MissingType.NAN)
-    t_valid = (bins[None, :] <
-               (num_bin - 1 - na_dir.astype(jnp.int32))[:, None]) & valid_bin
-    t_valid &= ~((missing_type[:, None] == MissingType.ZERO) & is_missing_bin)
-    if use_rand:
-        rand_t = jnp.floor(
-            extra_rand_u[:, 0] * jnp.maximum(num_bin - 1, 1).astype(jnp.float32)
-        ).astype(jnp.int32)
-        t_valid &= bins[None, :] == rand_t[:, None]
-
-    gain_r, left_r = eval_dir(jnp.zeros((F, 1), dtype=bool))   # missing -> right
-    gain_l, left_l = eval_dir(jnp.ones((F, 1), dtype=bool))    # missing -> left
-    gain_r = jnp.where(t_valid, gain_r, K_MIN_SCORE)
-    gain_l = jnp.where(t_valid, gain_l, K_MIN_SCORE)
-    # features without missing handling: reference runs the REVERSE scan only
-    # (missing mass is zero so directions agree); default_left = True there.
-    gain_r = jnp.where(has_missing_dir[:, None], gain_r, K_MIN_SCORE)
-
-    # reverse (missing->left) wins ties; within a direction larger threshold
-    # wins for reverse, smaller for forward (reference iteration order).
-    def argmax_last(x):
-        rev = x[:, ::-1]
-        idx = jnp.argmax(rev, axis=1)
-        return (x.shape[1] - 1 - idx), jnp.take_along_axis(x, (x.shape[1] - 1 - idx)[:, None], 1)[:, 0]
-
-    t_l, g_l = argmax_last(gain_l)                 # [F]
-    t_r_idx = jnp.argmax(gain_r, axis=1)
-    g_r = jnp.take_along_axis(gain_r, t_r_idx[:, None], 1)[:, 0]
-    use_left = g_l >= g_r                          # ties -> missing-left
-    num_gain = jnp.where(use_left, g_l, g_r)
-    num_thr = jnp.where(use_left, t_l, t_r_idx).astype(jnp.int32)
-    pick = lambda a, b: jnp.where(use_left, a, b)
-    num_lg = pick(jnp.take_along_axis(left_l[0], t_l[:, None], 1)[:, 0],
-                  jnp.take_along_axis(left_r[0], t_r_idx[:, None], 1)[:, 0])
-    num_lh = pick(jnp.take_along_axis(left_l[1], t_l[:, None], 1)[:, 0],
-                  jnp.take_along_axis(left_r[1], t_r_idx[:, None], 1)[:, 0])
-    num_lc = pick(jnp.take_along_axis(left_l[2], t_l[:, None], 1)[:, 0],
-                  jnp.take_along_axis(left_r[2], t_r_idx[:, None], 1)[:, 0])
-    # plain-scan features: the reference emits default_left=false for
-    # NaN-type (so NaN-bin rows follow the ordinary bin comparison at the
-    # partition) and default_left=true otherwise (feature_histogram.hpp:
-    # 89,200)
-    num_dl = jnp.where(has_missing_dir, use_left,
-                       missing_type != MissingType.NAN)
+    # ---- numerical features ------------------------------------------------
+    # the shared scan body (also the fused Pallas megakernel's in-kernel
+    # epilogue, ops/fused.py — ONE implementation so the staged and fused
+    # per-feature-best tuples can never drift); returns SHIFTED gains
+    nf = numeric_feature_scan(
+        hist, sum_grad, sum_hess, num_data, num_bin, missing_type,
+        default_bin, hp, monotone_constraints=monotone_constraints,
+        leaf_output_bounds=leaf_output_bounds,
+        rand_t_u=(extra_rand_u[:, 0] if use_rand else None))
+    num_gain, num_thr, num_dl = nf.gain, nf.threshold, nf.default_left
+    num_lg, num_lh, num_lc = (nf.left_sum_grad, nf.left_sum_hess,
+                              nf.left_count)
 
     # ---- categorical features ---------------------------------------------
     cat = _best_categorical(
@@ -274,8 +360,7 @@ def feature_best_splits(
     # each feature's gain is shifted by ITS OWN parent gain (categorical
     # uses l2+cat_l2, reference feature_histogram.hpp:268-276) so the
     # cross-feature argmax compares the same quantity the reference does
-    num_gain = jnp.where(jnp.isfinite(num_gain), num_gain - min_gain_shift,
-                         K_MIN_SCORE)
+    # (the numeric gains come back from the scan already shifted)
     if cat is not None:
         c_gain, c_thr, c_lg, c_lh, c_lc, c_bitset = cat
         feat_gain = jnp.where(is_categorical, c_gain, num_gain)
@@ -520,15 +605,23 @@ def quant_rescale_hist(hist_int: jax.Array, g_scale, h_scale, num_data,
     leaf's rows (every row has exactly one bin per feature).  Voting's
     local-candidate pass overrides it with the globally-derived factor
     (grower.py ``leaf_best_voting``).
+
+    Accepts arbitrary leading batch axes on ``hist_int`` (with
+    ``num_data``/``cnt_factor`` broadcastable to them) — the fused
+    megakernel's epilogue rescales a whole frontier of children through
+    THIS body (ops/fused.py), so the staged and fused rescales can never
+    drift; the batched ops are elementwise and bit-identical to the
+    historical unbatched code.
     """
     # true f64 only when the session enabled x64 (requesting f64 under
     # the default x64-off config would just warn and truncate to f32)
     wide = jnp.float64 if jax.config.x64_enabled else jnp.float32
     hi = hist_int.astype(wide)
-    g = hi[0] * jnp.asarray(g_scale, wide)
-    h = hi[1] * jnp.asarray(h_scale, wide)
+    g = hi[..., 0, :, :] * jnp.asarray(g_scale, wide)
+    h = hi[..., 1, :, :] * jnp.asarray(h_scale, wide)
     if cnt_factor is None:
-        tot = jnp.sum(hist_int[1, 0, :]).astype(jnp.float32)
+        tot = jnp.sum(hist_int[..., 1, 0, :], axis=-1).astype(jnp.float32)
         cnt_factor = num_data / jnp.maximum(tot, 1.0)
-    c = jnp.round(hi[1] * jnp.asarray(cnt_factor, wide))
-    return jnp.stack([g, h, c]).astype(jnp.float32)
+    cf = jnp.asarray(cnt_factor, wide)
+    c = jnp.round(hi[..., 1, :, :] * cf[..., None, None])
+    return jnp.stack([g, h, c], axis=-3).astype(jnp.float32)

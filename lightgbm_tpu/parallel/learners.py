@@ -68,6 +68,20 @@ def pad_rows_to(n: int, devices: int) -> int:
     return (n + devices - 1) // devices * devices
 
 
+def fused_best_payload_bytes(num_features: int) -> int:
+    """Bytes of ONE per-feature-best tuple set (the fused megakernel's
+    writeback: gain, bin, direction, left grad/hess/count — 6 cells × F,
+    ops/fused.py) — what a collective would move if it exchanged
+    candidates instead of histograms: F·~6 cells vs the histogram
+    payload's F·B·ch (``ops.histogram.hist_payload_bytes``).  Pure
+    accounting, reported by tools/hist_probe.py next to the histogram
+    payloads; the EXACT data-parallel reduction still psums histograms
+    (gains are not summable across shards — the same reason
+    voting-parallel exchanges elected candidates, PV-Tree).  This is the
+    DCN/ICI headroom figure the voting/fused combination targets."""
+    return 6 * num_features * 4
+
+
 def make_sharded_grower(
     mesh: Mesh,
     meta: FeatureMeta,
@@ -95,6 +109,18 @@ def make_sharded_grower(
             "group layout (GBDT._build_group_sharding); train through the "
             "engine (lgb.train with tree_learner=feature) or disable "
             "bundling for this standalone grower")
+    if cfg.hist_method == "fused":
+        # recorded design exclusion: the fused megakernel scans LOCAL
+        # histograms in VMEM, but exact data-parallel training must psum
+        # the GLOBAL histogram before any gain is computed (gains are not
+        # summable across shards) — so sharded growth stays on the staged
+        # family.  The growers would gate this off anyway; resolving here
+        # keeps the planner's variant model honest too.
+        from ..utils.log import log_info
+        log_info("hist_method=fused is a single-shard arm (the in-kernel "
+                 "gain scan needs the global histogram); sharded growth "
+                 "uses the staged kernel family")
+        cfg = cfg._replace(hist_method="auto")
     row_spec = P(data_axis) if data_axis else P()
     binned_spec = (P(feature_axis, data_axis) if feature_axis
                    else P(None, data_axis))

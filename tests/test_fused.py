@@ -1,0 +1,488 @@
+"""Fused Pallas histogram→split megakernel (ops/fused.py) parity suite.
+
+The contract (docs/PERF.md "fused megakernel"):
+
+- QUANT/INT paths: per-feature-best tuples (gain/bin/direction/left
+  sums) BIT-IDENTICAL to the staged ``build_histogram_int`` /
+  ``segment_histogram_int`` + ``quant_rescale_hist`` +
+  ``feature_best_splits`` pipeline, across tile/block sizes (incl. a
+  ragged last tile) and sibling-subtraction children — integer
+  accumulation is associative and the scan body is SHARED
+  (``ops.split.numeric_feature_scan``), so equality is exact.
+- F32 paths: the fused histogram matches the staged one to f32
+  accumulation order (allclose), and the in-kernel scan is bit-identical
+  to the shared scan applied to the fused kernel's own histograms —
+  pinning the kernel's epilogue exactly; end-to-end the grower produces
+  structurally identical trees and the quantized engine run is
+  model-text-identical.
+- ``hist_method=auto`` elects fused only when the planner proves the
+  VMEM arena fits; the staged family is the fallback arm.
+
+Everything here runs in ``interpret=True`` on the tier-1 CPU run; the
+``pallas``-marked stress test exercises the compiled kernel on
+accelerators.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.dataset import FeatureMeta
+from lightgbm_tpu.grower import GrowerConfig, grow_tree
+from lightgbm_tpu.grower_rounds import grow_tree_rounds
+from lightgbm_tpu.ops import fused as FU
+from lightgbm_tpu.ops import histogram as H
+from lightgbm_tpu.ops.split import (SplitHyperparams, feature_best_splits,
+                                    numeric_feature_scan, quant_rescale_hist)
+
+pytestmark = pytest.mark.pallas
+
+
+def _meta(B, F):
+    return FeatureMeta(
+        num_bin=np.full(F, B, np.int32),
+        missing_type=np.zeros(F, np.int32),
+        default_bin=np.zeros(F, np.int32),
+        most_freq_bin=np.zeros(F, np.int32),
+        is_categorical=np.zeros(F, bool),
+        max_num_bin=B,
+    )
+
+
+def _data(seed=0, n=3000, F=7, B=32, K=4):
+    rng = np.random.RandomState(seed)
+    binned = jnp.asarray(rng.randint(0, B - 1, (F, n)), jnp.uint8)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    h = jnp.abs(g) + 0.1
+    w = jnp.asarray((rng.rand(n) > 0.3).astype(np.float32) * 1.5)
+    slot = jnp.asarray(
+        np.where(rng.rand(n) < 0.8, rng.randint(0, K, n), K), jnp.int32)
+    return binned, g, h, w, slot
+
+
+def _slot_sums(seg_ref):
+    """Per-slot totals from the staged reference hist (channel sums of
+    any one feature's bins — here summed over all features / F)."""
+    return jnp.stack([seg_ref[:, c].sum((-1, -2)) / seg_ref.shape[-2]
+                      for c in range(3)])
+
+
+_HP = SplitHyperparams(min_data_in_leaf=5)
+# tile/block sizes: ragged last tile (3000 % 512 != 0), minimum block,
+# and a feature tile that does not divide F
+_SHAPES = [(None, None), (4, 128), (3, 256), (8, 512), (1, 128)]
+
+
+@pytest.mark.parametrize("feat_tile,block_rows", _SHAPES)
+def test_fused_quant_bit_identical(feat_tile, block_rows):
+    """Quant leaf mode: hist AND per-feature-best tuples bit-identical
+    to the staged pipeline for every arena/tile decomposition."""
+    n, F, B, K = 3000, 7, 32, 4
+    binned, g, h, w, slot = _data(n=n, F=F, B=B, K=K)
+    member = w > 0
+    gq, hq, gs, hs = H.quantize_gradients(g, h, w, 8, jax.random.PRNGKey(0))
+    slot_w = jnp.where(member, slot, K)
+    seg_i = H.segment_histogram_int(binned, gq, hq, member, slot, K, B,
+                                    levels=H.quant_levels(8))
+    seg_f = H.segment_histogram(binned, g, h, w, slot, K, B)
+    sums = _slot_sums(seg_f)
+    nb = jnp.full((F,), B, jnp.int32)
+    zz = jnp.zeros((F,), jnp.int32)
+    fh, fb = FU.fused_segment_splits(
+        binned, H._vals_t_int(gq, hq, member), slot_w, K, B, sums,
+        nb, zz, zz, _HP, quant_scales=(gs, hs),
+        feat_tile=feat_tile, block_rows=block_rows)
+    assert np.array_equal(np.asarray(fh), np.asarray(seg_i))
+    for k in range(K):
+        h3 = quant_rescale_hist(seg_i[k], gs, hs, sums[2][k])
+        ref = numeric_feature_scan(h3, sums[0][k], sums[1][k], sums[2][k],
+                                   nb, zz, zz, _HP)
+        for name in ref._fields:
+            assert np.array_equal(np.asarray(getattr(fb, name))[k],
+                                  np.asarray(getattr(ref, name))), \
+                (name, k, feat_tile, block_rows)
+
+
+@pytest.mark.parametrize("feat_tile,block_rows", [(None, None), (3, 128)])
+def test_fused_f32_hist_and_scan_parity(feat_tile, block_rows):
+    """F32 leaf mode: fused hist tracks the staged scatter hist to f32
+    accumulation order; the in-kernel scan is BIT-identical to the
+    shared scan run on the fused kernel's own histograms."""
+    n, F, B, K = 3000, 7, 32, 4
+    binned, g, h, w, slot = _data(n=n, F=F, B=B, K=K)
+    seg_ref = H.segment_histogram(binned, g, h, w, slot, K, B)
+    sums = _slot_sums(seg_ref)
+    nb = jnp.full((F,), B, jnp.int32)
+    zz = jnp.zeros((F,), jnp.int32)
+    fh, fb = FU.fused_segment_splits(
+        binned, H._vals_t(g, h, w), slot, K, B, sums, nb, zz, zz, _HP,
+        feat_tile=feat_tile, block_rows=block_rows)
+    np.testing.assert_allclose(np.asarray(fh), np.asarray(seg_ref),
+                               rtol=1e-5, atol=2e-3)
+    ref = numeric_feature_scan(fh, sums[0], sums[1], sums[2],
+                               nb, zz, zz, _HP)
+    for name in ref._fields:
+        assert np.array_equal(np.asarray(getattr(fb, name)),
+                              np.asarray(getattr(ref, name))), name
+    # and the tuples agree with the STAGED scan to f32 tolerance
+    staged = numeric_feature_scan(seg_ref, sums[0], sums[1], sums[2],
+                                  nb, zz, zz, _HP)
+    sg, fg = np.asarray(staged.gain), np.asarray(fb.gain)
+    finite = np.isfinite(sg) & np.isfinite(fg)
+    assert (np.isfinite(sg) == np.isfinite(fg)).all()
+    np.testing.assert_allclose(fg[finite], sg[finite], rtol=1e-4)
+
+
+def test_fused_frontier_sibling_derivation():
+    """Parent mode: the in-kernel ``sibling = parent − smaller``
+    derivation + both-children scan must equal the staged subtraction
+    pipeline — bit-identical in quant, scan-exact in f32."""
+    n, F, B, K = 2000, 5, 16, 3
+    binned, g, h, w, slot = _data(seed=2, n=n, F=F, B=B, K=K)
+    member = w > 0
+    gq, hq, gs, hs = H.quantize_gradients(g, h, w, 8, jax.random.PRNGKey(1))
+    slot_w = jnp.where(member, slot, K)
+    small = H.segment_histogram_int(binned, gq, hq, member, slot_w, K, B,
+                                    levels=H.quant_levels(8))
+    rng = np.random.RandomState(3)
+    # REAL parents: the small child's rows plus extra rows drawn from the
+    # currently-dropped lanes, slotted the same way (a genuine histogram
+    # — every feature's bins partition the same parent rows, which is
+    # what the kernel's per-block count factor relies on)
+    extra_slot = jnp.asarray(
+        np.where((np.asarray(slot_w) == K) & (rng.rand(n) < 0.5),
+                 rng.randint(0, K, n), K), jnp.int32)
+    slot_parent = jnp.where(slot_w < K, slot_w, extra_slot)
+    parent = H.segment_histogram_int(binned, gq, hq, member, slot_parent,
+                                     K, B, levels=H.quant_levels(8))
+    small_left = jnp.asarray([True, False, True])
+    h_left = jnp.where(small_left[:, None, None, None], small,
+                       parent - small)
+    h_right = parent - h_left
+    nb = jnp.full((F,), B, jnp.int32)
+    zz = jnp.zeros((F,), jnp.int32)
+    # per-child totals consistent with the child histograms (sums from
+    # the integer hists rescaled; counts = member-row counts)
+    children = jnp.concatenate([h_left, h_right])
+    csums = jnp.stack([
+        children[:, 0].sum((-1, -2)).astype(jnp.float32) / F * gs,
+        children[:, 1].sum((-1, -2)).astype(jnp.float32) / F * hs,
+        children[:, 1, 0, :].sum(-1).astype(jnp.float32)])
+    fh, fb = FU.fused_frontier_splits(
+        binned, H._vals_t_int(gq, hq, member), slot_w, K, B, csums,
+        small_left, parent, nb, zz, zz, _HP, quant_scales=(gs, hs),
+        feat_tile=2, block_rows=128)
+    assert np.array_equal(np.asarray(fh), np.asarray(small))
+    for c in range(2 * K):
+        h3 = quant_rescale_hist(children[c], gs, hs, csums[2][c])
+        ref = numeric_feature_scan(h3, csums[0][c], csums[1][c],
+                                   csums[2][c], nb, zz, zz, _HP)
+        for name in ref._fields:
+            assert np.array_equal(np.asarray(getattr(fb, name))[c],
+                                  np.asarray(getattr(ref, name))), (name, c)
+
+
+@pytest.mark.parametrize("grower", ["serial", "rounds"])
+def test_fused_grower_quant_bit_identical_trees(grower):
+    """Both growers' fused arm must produce BIT-identical TreeArrays to
+    the staged arm in quantized mode (integer hists + shared scan)."""
+    rng = np.random.RandomState(1)
+    n, F, B = 4000, 6, 32
+    binned = rng.randint(0, B - 1, (n, F)).astype(np.uint8)
+    y = np.sin(binned[:, 0] * 0.3) + 0.2 * binned[:, 1] + rng.randn(n) * 0.1
+    grad = (-y).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    mask = np.ones(n, np.float32)
+    meta = _meta(B, F)
+    gq, hq, gs, hs = H.quantize_gradients(
+        jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask), 8,
+        jax.random.PRNGKey(7))
+    cfg = GrowerConfig(num_leaves=15, hp=SplitHyperparams(min_data_in_leaf=5),
+                       num_bins=B, round_width=8, quant=True, quant_bins=8)
+    fn = grow_tree if grower == "serial" else grow_tree_rounds
+    args = (jnp.asarray(binned.T), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(mask), meta)
+    t_st, lid_st = fn(*args, cfg, quant_vals=(gq, hq, gs, hs))
+    t_fu, lid_fu = fn(*args, cfg._replace(hist_method="fused",
+                                          fused_feat_tile=3,
+                                          fused_block_rows=128),
+                      quant_vals=(gq, hq, gs, hs))
+    assert int(t_fu.num_leaves) == 15
+    for name in t_st._fields:
+        assert np.array_equal(np.asarray(getattr(t_st, name)),
+                              np.asarray(getattr(t_fu, name))), name
+    assert np.array_equal(np.asarray(lid_st), np.asarray(lid_fu))
+
+
+@pytest.mark.parametrize("tile", [0, 256])
+@pytest.mark.parametrize("grower", ["serial", "rounds"])
+def test_fused_grower_f32_structurally_identical(grower, tile):
+    """F32 fused arm, untiled AND under planner row tiling (the tile
+    caps the kernel's DMA block, refining the f32 dot partition): same
+    splits/structure as staged (floats may differ in the last bits —
+    different accumulation order, the CPU-vs-GPU class of difference;
+    this is why the f32 fused row is absent from test_macro's
+    byte-identical tiled==untiled matrix, where only fused_quant rides)."""
+    rng = np.random.RandomState(4)
+    n, F, B = 4000, 6, 32
+    binned = rng.randint(0, B - 1, (n, F)).astype(np.uint8)
+    y = np.sin(binned[:, 0] * 0.3) + 0.2 * binned[:, 1] + rng.randn(n) * 0.1
+    grad = (-y).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    mask = np.ones(n, np.float32)
+    meta = _meta(B, F)
+    cfg = GrowerConfig(num_leaves=15, hp=SplitHyperparams(min_data_in_leaf=5),
+                       num_bins=B, round_width=8)
+    fn = grow_tree if grower == "serial" else grow_tree_rounds
+    args = (jnp.asarray(binned.T), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(mask), meta)
+    t_st, lid_st = fn(*args, cfg._replace(tile_rows=tile))
+    t_fu, lid_fu = fn(*args, cfg._replace(hist_method="fused",
+                                          fused_feat_tile=3,
+                                          fused_block_rows=128,
+                                          tile_rows=tile))
+    for name in ("split_feature", "threshold_bin", "default_left",
+                 "left_child", "right_child", "num_leaves"):
+        assert np.array_equal(np.asarray(getattr(t_st, name)),
+                              np.asarray(getattr(t_fu, name))), name
+    assert np.array_equal(np.asarray(lid_st), np.asarray(lid_fu))
+    np.testing.assert_allclose(np.asarray(t_fu.leaf_value),
+                               np.asarray(t_st.leaf_value),
+                               rtol=3e-5, atol=1e-7)
+
+
+def _strip_param_lines(text):
+    return "\n".join(ln for ln in text.splitlines()
+                     if not ln.startswith("[tpu_hist_method"))
+
+
+def test_fused_engine_quant_model_text_identical():
+    """End-to-end ``lgb.train``: quantized fused == staged model text
+    (modulo the echoed tpu_hist_method parameter line)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(3000, 8).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.randn(3000) * 0.1 > 0.3
+         ).astype(np.float32)
+    texts = {}
+    for method in ("auto", "fused"):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(
+            dict(objective="binary", num_leaves=15, min_data_in_leaf=5,
+                 verbose=-1, tpu_hist_method=method,
+                 use_quantized_grad=True, num_grad_quant_bins=8),
+            ds, num_boost_round=5)
+        texts[method] = _strip_param_lines(bst.model_to_string())
+    assert texts["auto"] == texts["fused"]
+
+
+def test_fused_engine_f32_predictions_close():
+    rng = np.random.RandomState(6)
+    X = rng.randn(2500, 8).astype(np.float32)
+    y = (X[:, 0] - 0.3 * X[:, 2] + rng.randn(2500) * 0.1).astype(np.float32)
+    preds = {}
+    for method in ("auto", "fused"):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(
+            dict(objective="regression", num_leaves=15, verbose=-1,
+                 tpu_hist_method=method), ds, num_boost_round=5)
+        preds[method] = bst.predict(X[:400])
+    np.testing.assert_allclose(preds["fused"], preds["auto"],
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_fused_gate_falls_back_for_unsupported_modes():
+    """Contexts outside the fused arm (categorical features, monotone
+    constraints, extra_trees) must warn/fall back and still train."""
+    rng = np.random.RandomState(8)
+    X = rng.randn(800, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        dict(objective="binary", num_leaves=7, verbose=-1,
+             tpu_hist_method="fused",
+             monotone_constraints=[1, 0, 0, 0, 0]),
+        ds, num_boost_round=3)
+    assert bst.num_trees() == 3
+    # categorical gate
+    Xc = np.column_stack([rng.randint(0, 6, 800), X[:, 1:]]).astype(
+        np.float32)
+    ds2 = lgb.Dataset(Xc, label=y, categorical_feature=[0])
+    bst2 = lgb.train(dict(objective="binary", num_leaves=7, verbose=-1,
+                          tpu_hist_method="fused"),
+                     ds2, num_boost_round=3)
+    assert bst2.num_trees() == 3
+
+
+def test_fused_auto_elects_on_accelerator(monkeypatch):
+    """Regression: hist_method=auto must reach the planner's fused
+    election AS "auto" on accelerators — the measured-kernel probe
+    resolving auto to a concrete name first would make the election
+    unreachable (the planner only elects for method in {auto, fused})."""
+    import lightgbm_tpu.boosting.gbdt as G
+    monkeypatch.setattr(G, "on_accelerator", lambda: True)
+    # fused_kernel_verified consults ops.fused.on_accelerator (not
+    # patched): CPU -> trivially verified, no accelerator probe runs;
+    # measured_best_method likewise short-circuits off-accelerator if
+    # the election ever declined to it
+    rng = np.random.RandomState(12)
+    Xa = rng.randn(1500, 6).astype(np.float32)
+    ya = (Xa[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(Xa, label=ya, free_raw_data=False)
+    bst = lgb.Booster(params=dict(objective="binary", num_leaves=7,
+                                  verbosity=-1, tpu_hist_method="auto"),
+                      train_set=ds)
+    plan = bst.boosting.hist_plan
+    assert plan.fused, plan.summary()
+    assert bst.boosting.grower_cfg.hist_method == "fused"
+    assert bst.boosting.grower_cfg.fused_feat_tile == plan.fused_feat_tile
+
+
+def test_fused_env_gate(monkeypatch):
+    """LGBM_TPU_FUSED=0 drops the fused arm: the planner must never
+    elect it and explicit hist_method=fused degrades to staged."""
+    from lightgbm_tpu.ops.planner import plan_histograms
+    monkeypatch.setenv("LGBM_TPU_FUSED", "0")
+    plan = plan_histograms(10_000, 8, 64, method="fused", round_width=8,
+                           fused_ok=True)
+    assert not plan.fused and plan.variant != "fused"
+    monkeypatch.delenv("LGBM_TPU_FUSED")
+    plan = plan_histograms(10_000, 8, 64, method="fused", round_width=8,
+                           fused_ok=True)
+    assert plan.fused and plan.variant == "fused"
+    assert plan.fused_feat_tile > 0 and plan.fused_block_rows >= 128
+
+
+def test_fused_planner_vmem_election():
+    """plan_fused: fits at sane shapes, degrades feat_tile under a tight
+    fake VMEM budget, refuses when nothing fits (auto then keeps the
+    staged family)."""
+    from lightgbm_tpu.ops.planner import (fused_vmem_bytes, plan_fused,
+                                          plan_histograms)
+    fp = plan_fused(128, 256, quant=True)
+    assert fp is not None
+    # monotone in feat_tile
+    assert fused_vmem_bytes(128, 256, 8, 512, True) > \
+        fused_vmem_bytes(128, 256, 1, 128, True)
+    # a 256 KiB budget fits nothing at frontier width 128
+    assert plan_fused(128, 256, quant=False, vmem_bytes=256 << 10) is None
+    plan = plan_histograms(100_000, 28, 256, method="auto", round_width=128,
+                           fused_ok=True, vmem_bytes=256 << 10)
+    assert not plan.fused
+    assert plan.variant != "fused"
+    # the same shape with the real default budget elects fused
+    plan2 = plan_histograms(100_000, 28, 256, method="auto",
+                            round_width=128, fused_ok=True)
+    assert plan2.fused and plan2.variant == "fused"
+    assert plan2.fused_vmem_bytes <= plan2.vmem_limit_bytes
+
+
+def test_fused_apply_plan_threading():
+    """apply_plan flips hist_method to fused (with kernel shape) when
+    elected, and degrades an explicit fused that cannot fit."""
+    from lightgbm_tpu.ops.planner import apply_plan
+    cfg = GrowerConfig(num_leaves=15, num_bins=64, round_width=8,
+                       hist_method="auto")
+    cfg2, plan = apply_plan(cfg, 10_000, 8, fused_ok=True)
+    assert plan.fused and cfg2.hist_method == "fused"
+    assert cfg2.fused_feat_tile == plan.fused_feat_tile > 0
+    cfg3, plan3 = apply_plan(cfg._replace(hist_method="fused"), 10_000, 8,
+                             fused_ok=False)
+    assert not plan3.fused and cfg3.hist_method == "auto"
+
+
+def test_fused_sharded_grower_downgrades():
+    """make_sharded_grower resolves hist_method=fused to the staged
+    family (the in-kernel scan needs the global histogram) and the
+    payload accounting helpers stay in lockstep with the writeback."""
+    from lightgbm_tpu.parallel.learners import fused_best_payload_bytes
+    assert fused_best_payload_bytes(28) == 6 * 28 * 4
+    assert FU.hist_scan_traffic_bytes(8, 28, 64) == 8 * 3 * 28 * 64 * 4 * 4
+    assert FU.hist_scan_traffic_bytes(8, 28, 64, quant=True) == \
+        8 * 2 * 28 * 64 * 4 * 4
+    if jax.device_count() >= 2:
+        from lightgbm_tpu.parallel.learners import (make_mesh,
+                                                    make_sharded_grower,
+                                                    shard_dataset)
+        rng = np.random.RandomState(0)
+        n, F, B = 2048, 5, 16
+        binned = rng.randint(0, B - 1, (n, F)).astype(np.uint8)
+        g = rng.randn(n).astype(np.float32)
+        mesh = make_mesh(2)
+        cfg = GrowerConfig(num_leaves=7, num_bins=B,
+                           hp=SplitHyperparams(min_data_in_leaf=5),
+                           hist_method="fused")
+        grower = make_sharded_grower(mesh, _meta(B, F), cfg)
+        (bt, gg, hh, mm), _ = shard_dataset(
+            mesh, binned, g, np.ones(n, np.float32),
+            np.ones(n, np.float32))
+        tree, leaf_id = grower(bt, gg, hh, mm)
+        assert int(tree.num_leaves) >= 2
+
+
+def test_fused_probe_json():
+    """tools/hist_probe.py --fused column: staged vs fused sec/level +
+    accounting fields ride the bench hist_probe stage journal."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from hist_probe import run_probe
+    out = run_probe(rows=8000, features=6, max_bin=15, quant_bins=4,
+                    leaves=15, reps=1)
+    f = out["fused"]
+    assert f["hist_scan_traffic_bytes"] > 0
+    assert f["best_tuple_payload_bytes"] == 6 * 6 * 4
+    assert "staged" in f and "fused" in f
+    if "error" not in f["fused"]:
+        assert f["fused"]["sec_per_level"] > 0
+
+
+def test_histogram_pallas_tile_rows_parity():
+    """Satellite: the bin-only Pallas kernel under the tile_rows regime —
+    capping the block must leave results equal to the scatter reference,
+    and the planner now models a "pallas" variant peak."""
+    from lightgbm_tpu.ops.planner import predict_peak_bytes
+    rng = np.random.RandomState(3)
+    n, F, B = 2579, 5, 17
+    binned = jnp.asarray(rng.randint(0, B, (F, n)), jnp.uint8)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    h = jnp.asarray(rng.rand(n), jnp.float32)
+    m = jnp.asarray((rng.rand(n) < 0.6), jnp.float32)
+    ref = np.asarray(H.build_histogram(binned, g, h, m, B, method="scatter"))
+    for tile in (192, 7, 4096):
+        got = np.asarray(H.build_histogram(binned, g, h, m, B,
+                                           method="pallas", tile_rows=tile))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # peak model: the pallas variant's transient is O(tile), far below
+    # the scatter variant's lane-padded update buffer
+    pal = predict_peak_bytes(1_000_000, 28, 64, variant="pallas",
+                             accel=True)[0]
+    sca = predict_peak_bytes(1_000_000, 28, 64, variant="scatter",
+                             accel=True)[0]
+    assert pal < sca
+
+
+@pytest.mark.slow
+def test_fused_stress_wide_frontier():
+    """Accelerator-shaped stress: full round_width=64 frontier, B=64,
+    u16-capable shapes — quant bit-parity at scale (interpret mode on
+    CPU; the compiled kernel on accelerators via -m 'pallas and slow')."""
+    n, F, B, K = 20_000, 12, 64, 64
+    binned, g, h, w, slot = _data(seed=9, n=n, F=F, B=B, K=K)
+    member = w > 0
+    gq, hq, gs, hs = H.quantize_gradients(g, h, w, 16, jax.random.PRNGKey(2))
+    slot_w = jnp.where(member, slot, K)
+    seg_i = H.segment_histogram_int(binned, gq, hq, member, slot, K, B,
+                                    levels=H.quant_levels(16))
+    sums = _slot_sums(H.segment_histogram(binned, g, h, w, slot, K, B))
+    nb = jnp.full((F,), B, jnp.int32)
+    zz = jnp.zeros((F,), jnp.int32)
+    fh, fb = FU.fused_segment_splits(
+        binned, H._vals_t_int(gq, hq, member), slot_w, K, B, sums,
+        nb, zz, zz, _HP, quant_scales=(gs, hs))
+    assert np.array_equal(np.asarray(fh), np.asarray(seg_i))
+    assert np.isfinite(np.asarray(fb.left_count)).all()

@@ -49,6 +49,16 @@ PARITY_CASES = {
                      "quant_train_renew_leaf": True,
                      "bagging_fraction": 0.7, "bagging_freq": 2,
                      "bagging_seed": 11}, Y_BIN),
+    # fused Pallas histogram→split megakernel arm (ops/fused.py, CPU
+    # interpret mode): the in-kernel scan + VMEM arena must keep chunked
+    # == per-iteration byte-identical, f32 and quantized
+    "fused": ({"objective": "binary", "num_leaves": 15,
+               "learning_rate": 0.1, "tpu_hist_method": "fused"}, Y_BIN),
+    "fused_quant": ({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.1, "tpu_hist_method": "fused",
+                     "use_quantized_grad": True,
+                     "bagging_fraction": 0.7, "bagging_freq": 2,
+                     "bagging_seed": 11}, Y_BIN),
 }
 
 
@@ -78,7 +88,7 @@ def test_chunked_equals_per_iteration(case):
     assert mixed == per_iter, f"{case}: mixed chunks != per-iteration"
 
 
-@pytest.mark.parametrize("case", ["gbdt", "quant"])
+@pytest.mark.parametrize("case", ["gbdt", "quant", "fused_quant"])
 def test_chunked_equals_per_iteration_tiled(case, monkeypatch):
     """Planner row tiling active (LGBM_TPU_TILE_ROWS forces tiles far
     smaller than n): chunked == per-iteration must hold unchanged, and

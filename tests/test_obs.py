@@ -390,13 +390,20 @@ def test_devprof_histogram_table_small():
     t = histogram_utilization_table(rows=2000, features=6, num_bins=16,
                                     slots=4, reps=1, quant=True)
     keys = [k for k in t if "/" in k]
-    # the full family x {f32, quant} x {untiled, tiled}
-    assert len(keys) == 18
+    # the full family x {f32, quant} x {untiled, tiled}, incl. the
+    # Pallas rows (bin-only VPU kernel + fused megakernel)
+    assert len(keys) == 24
+    for fam in ("f32/pallas", "f32/fused", "quant/fused"):
+        assert f"{fam}/untiled" in t and f"{fam}/tiled" in t
     for k in keys:
         v = t[k]
         assert "error" in v or v["seconds_per_call"] > 0, (k, v)
     timed = [k for k in keys if "error" not in t[k]]
     assert timed, "every variant errored"
+    # the fused rows must actually measure (interpret mode on CPU), not
+    # error out — they are the bench's acceptance figure
+    assert "seconds_per_call" in t["f32/fused/untiled"], t["f32/fused/untiled"]
+    assert "seconds_per_call" in t["quant/fused/tiled"], t["quant/fused/tiled"]
 
 
 def test_obs_dump_tool(tmp_path):

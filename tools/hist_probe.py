@@ -25,6 +25,14 @@ per-pass time (and measured peak where the device allocator reports
 ``memory_stats``) — the predicted-vs-measured table that validates the
 planner's model at bench time.
 
+Fused column (``--fused``, default on; ``--no-fused`` skips): the
+histogram→split megakernel (ops/fused.py) vs the staged pipeline
+(``build_histogram`` + ``feature_best_splits``) at one frontier level —
+sec/level, HBM ``bytes_accessed`` from the compiler's cost model
+(``obs/devprof.measure_program``), measured MFU for both, and the
+accounting drop (``hist_scan_traffic_bytes``: the [ch, F, B] scan
+re-read + sibling write/read the fused kernel never performs).
+
 The LAST stdout line is a single JSON object so bench.py's worker can
 bank it as a stage (``stage: hist_probe``, wired next to
 ``dispatch_probe``; ``BENCH_SKIP_HIST_PROBE=1`` skips the stage).
@@ -101,8 +109,87 @@ def tile_sweep(binned_t, grad, hess, ones, B, tiles, reps, sync,
     return out
 
 
+def fused_probe(binned_t, grad, hess, ones, B, reps, leaves=255,
+                slots=None) -> dict:
+    """Fused megakernel vs staged pipeline at one frontier level.
+
+    Staged = per-slot segment histogram + per-slot
+    ``feature_best_splits`` scan (TWO stages with the [S, ch, F, B]
+    histogram materialized between them); fused = ONE
+    ``fused_segment_splits`` program.  Reports measured sec/level and
+    MFU for both (``obs/devprof.measure_program``) plus the compiler's
+    ``bytes_accessed`` so the per-level HBM-traffic drop is a measured
+    number next to the ``hist_scan_traffic_bytes`` accounting term.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.obs.devprof import measure_program
+    from lightgbm_tpu.ops import fused as FU
+    from lightgbm_tpu.ops import histogram as H
+    from lightgbm_tpu.ops.split import SplitHyperparams, feature_best_splits
+
+    F, n = binned_t.shape
+    # frontier width: one level of a `leaves`-leaf tree, capped at the
+    # 8-candidate slice that keeps the staged comparator cheap
+    S = int(slots) if slots else max(1, min(8, int(leaves) - 1))
+    hp = SplitHyperparams(min_data_in_leaf=1)
+    nb = jnp.full((F,), B, jnp.int32)
+    zz = jnp.zeros((F,), jnp.int32)
+    slot = jnp.asarray(np.random.RandomState(5).randint(0, S, n), jnp.int32)
+    oh = slot[None, :] == jnp.arange(S)[:, None]
+    sums = jnp.stack([jnp.sum(jnp.where(oh, grad[None, :], 0.0), axis=1),
+                      jnp.sum(jnp.where(oh, hess[None, :], 0.0), axis=1),
+                      jnp.sum(oh.astype(jnp.float32), axis=1)])
+    iscat = jnp.zeros((F,), bool)
+
+    def staged(b, g, h, m):
+        seg = H.segment_histogram_sorted(b, g, h, m, slot, S, B,
+                                         f32_vals=True) \
+            if H.use_sorted_seghist() else \
+            H.segment_histogram(b, g, h, m, slot, S, B)
+        return jax.vmap(
+            lambda hs, sg, sh, cnt: feature_best_splits(
+                hs, sg, sh, cnt, nb, zz, zz, iscat, hp).gain
+        )(seg, sums[0], sums[1], sums[2])
+
+    def fused(b, g, h, m):
+        _, best = FU.fused_segment_splits(
+            b, H._vals_t(g, h, m), slot, S, B, sums, nb, zz, zz, hp)
+        return best.gain
+
+    args = (binned_t, grad, hess, ones)
+    out = {"slots": S}
+    for name, fn in (("staged", staged), ("fused", fused)):
+        try:
+            m = measure_program(jax.jit(fn), args, reps=reps)
+            out[name] = {
+                "sec_per_level": round(m["seconds_per_call"], 5),
+                "mfu_measured": round(m.get("mfu", 0.0), 6),
+                "hbm_bytes_accessed": int(m.get("bytes_accessed", 0)),
+                "hbm_util": round(m.get("hbm_util", 0.0), 6),
+            }
+        except Exception as e:      # a variant may not lower here
+            out[name] = {"error": str(e)[:160]}
+    if "error" not in out.get("staged", {}) and \
+            "error" not in out.get("fused", {}):
+        out["speedup_vs_staged"] = round(
+            out["staged"]["sec_per_level"]
+            / max(out["fused"]["sec_per_level"], 1e-12), 3)
+        sb = out["staged"]["hbm_bytes_accessed"]
+        fb = out["fused"]["hbm_bytes_accessed"]
+        if sb and fb:
+            out["hbm_bytes_dropped"] = sb - fb
+    # accounting twin: the scan re-read + sibling write/read the fused
+    # arm deletes per level of S candidates (tests pin this formula)
+    out["hist_scan_traffic_bytes"] = FU.hist_scan_traffic_bytes(S, F, B)
+    from lightgbm_tpu.parallel.learners import fused_best_payload_bytes
+    out["best_tuple_payload_bytes"] = fused_best_payload_bytes(F)
+    return out
+
+
 def run_probe(rows=1_000_000, features=28, max_bin=63, quant_bins=4,
-              leaves=255, reps=5, tiles=None) -> dict:
+              leaves=255, reps=5, tiles=None, fused=True) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -176,6 +263,18 @@ def run_probe(rows=1_000_000, features=28, max_bin=63, quant_bins=4,
     sweep = tile_sweep(binned_t, grad, hess, ones, B, tiles, reps, sync,
                        leaves=leaves)
 
+    # ---- fused megakernel vs staged pipeline (--fused column) ---------
+    if fused:
+        # interpret-mode emulation off-accelerator is slow at probe
+        # scale: cap the fused comparison shape there (the on-device
+        # bench worker runs the full size)
+        if H.on_accelerator() or rows <= 200_000:
+            fb, fg, fh, fo = binned_t, grad, hess, ones
+        else:
+            fb = binned_t[:, :200_000]
+            fg, fh, fo = grad[:200_000], hess[:200_000], ones[:200_000]
+        out["fused"] = fused_probe(fb, fg, fh, fo, B, reps, leaves=leaves)
+
     out.update({
         "reps": reps,
         "tile_sweep": sweep,
@@ -211,12 +310,16 @@ def main():
     ap.add_argument("--tile-sweep", type=str, default=None,
                     help="comma-separated row-tile sizes (0 = untiled); "
                          "default: a small automatic sweep")
+    ap.add_argument("--fused", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="fused megakernel vs staged column (default on; "
+                         "--no-fused skips)")
     args = ap.parse_args()
     tiles = None
     if args.tile_sweep:
         tiles = [max(int(v), 0) for v in args.tile_sweep.split(",") if v]
     out = run_probe(args.rows, args.features, args.max_bin, args.quant_bins,
-                    args.leaves, args.reps, tiles=tiles)
+                    args.leaves, args.reps, tiles=tiles, fused=args.fused)
     print(json.dumps(out))
     return 0
 
