@@ -71,6 +71,14 @@ Memory/caching: LGBM_TPU_TILE_ROWS / LGBM_TPU_HBM_BYTES steer the HBM
 budget planner (ops/planner.py; the >=10M-row stage is gated on its
 feasibility verdict and degrades to smaller row tiles instead of
 crashing — the decision is journaled as the "hbm_plan" stage);
+out-of-core streaming (lightgbm_tpu/data/): BENCH_SKIP_STREAM_PROBE=1
+skips the block-pump micro-bench (tools/stream_probe.py),
+BENCH_SKIP_STREAM=1 skips the graduated 100M-row streamed stage
+(BENCH_STREAM_ROWS / BENCH_STREAM_TREES size it; its two-level
+host+HBM verdict banks as the "stream_plan" stage and the run
+journals planner-predicted vs measured peaks on BOTH memories;
+LGBM_TPU_STREAM / LGBM_TPU_STREAM_BLOCK_ROWS / LGBM_TPU_HOST_BYTES
+steer the election);
 LGBM_TPU_VMEM_BYTES steers the fused-megakernel VMEM arena election and
 LGBM_TPU_FUSED=0 drops the fused arm entirely (staged family only);
 LGBM_TPU_COMPILE_CACHE=<dir> wires the persistent XLA compile cache
@@ -248,16 +256,37 @@ def run_ranking_bench(n_queries, docs_per_query, trees, leaves, max_bin):
     }
 
 
-def make_higgs_like(n, f, seed=0):
-    # the label concept (w) is drawn from a FIXED rng so train (seed=0) and
-    # holdout (seed=1) share one distribution; `seed` varies only the draw
+def higgs_like_chunks(n, f, chunk_rows, seed0=0):
+    """The synthetic-HIGGS source, generated chunk by chunk so the raw
+    float matrix need never be resident (the out-of-core stage's data
+    source; ``make_higgs_like`` is the single-chunk special case — ONE
+    signal formula for train, holdout and streamed stages).
+
+    The label concept (w) is drawn from a FIXED rng so train (seed 0)
+    and holdout (seed 1) share one distribution; the label threshold is
+    calibrated on the first chunk (~the global median — chunks are
+    i.i.d. draws), which IS the global median in the single-chunk case.
+    """
     w = np.random.RandomState(12345).randn(f).astype(np.float32)
-    rng = np.random.RandomState(seed)
-    X = rng.rand(n, f).astype(np.float32)
-    signal = X @ w
-    signal += 2.0 * X[:, 0] * X[:, 1] - 1.5 * (X[:, 2] > 0.5) * X[:, 3]
-    signal += rng.randn(n).astype(np.float32) * 0.2 * signal.std()
-    y = (signal > np.median(signal)).astype(np.float32)
+    thresh = None
+    lo = 0
+    ci = 0
+    while lo < n:
+        rows = min(chunk_rows, n - lo)
+        rng = np.random.RandomState(seed0 + 7919 * ci)
+        X = rng.rand(rows, f).astype(np.float32)
+        signal = X @ w
+        signal += 2.0 * X[:, 0] * X[:, 1] - 1.5 * (X[:, 2] > 0.5) * X[:, 3]
+        signal += rng.randn(rows).astype(np.float32) * 0.2 * signal.std()
+        if thresh is None:
+            thresh = float(np.median(signal))
+        yield lo, X, (signal > thresh).astype(np.float32)
+        lo += rows
+        ci += 1
+
+
+def make_higgs_like(n, f, seed=0):
+    _lo, X, y = next(higgs_like_chunks(n, f, n, seed0=seed))
     return X, y
 
 
@@ -637,6 +666,115 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
     return result
 
 
+def run_stream_bench(n, trees, leaves, max_bin, features=None):
+    """The graduated out-of-core stage (lightgbm_tpu/data/): build a
+    spill-store dataset of ``n`` rows CHUNK BY CHUNK (the binned matrix
+    is never resident on host or device), train ``trees`` streamed
+    trees, and journal the planner's predicted peaks on BOTH memories
+    next to the measured ones (host VmHWM delta, device allocator
+    peak).  LGBM_TPU_STREAM=1 is pinned for the stage — its claim is
+    out-of-core execution, not a residency election."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.data.stream import (host_rss_bytes,
+                                          host_rss_peak_bytes)
+    from lightgbm_tpu.dataset import Dataset
+    from lightgbm_tpu.ops.planner import plan_stream
+
+    f = features or F
+    trees = max(int(trees), 2)      # the clock starts after iteration 1;
+    #                                 one tree would journal a ~0 s value
+    plan = plan_stream(rows=n, features=f, num_bins=max_bin + 1,
+                       num_leaves=leaves)
+    if plan.stream and not plan.feasible:
+        raise RuntimeError(
+            f"stream planner: {n} rows infeasible even at block_rows="
+            f"{plan.block_rows} (predicted device "
+            f"{plan.predicted_device_peak_bytes / 1e9:.1f} GB / host "
+            f"{plan.predicted_host_peak_bytes / 1e9:.1f} GB)")
+    rss_peak0 = host_rss_peak_bytes()
+    from lightgbm_tpu.obs.metrics import global_registry as _reg
+    blocks0 = int(_reg.counter("stream_blocks_total").value)
+    params = {"objective": "binary", "num_leaves": leaves,
+              "learning_rate": 0.1, "max_bin": max_bin,
+              "metric": "None", "verbosity": -1}
+    prev_stream = os.environ.get("LGBM_TPU_STREAM")
+    os.environ["LGBM_TPU_STREAM"] = "1"
+    try:
+        block_rows = plan.block_rows or min(n, 1 << 20)
+        chunk_rows = min(block_rows, 1 << 20)
+        t0 = time.perf_counter()
+        gen = higgs_like_chunks(n, f, chunk_rows)
+        lo0, X0, y0 = next(gen)
+        ds = Dataset.from_sample(X0[:200_000], n, params=params,
+                                 spill=True, spill_block_rows=block_rows)
+        labels = np.empty(n, np.float32)
+        ds.push_rows(X0)
+        labels[lo0:lo0 + len(y0)] = y0
+        del X0
+        for lo, X, y in gen:
+            ds.push_rows(X)
+            labels[lo:lo + len(y)] = y
+        ds.set_label(labels)
+        spill_seconds = time.perf_counter() - t0
+        store = ds._block_store
+
+        t0 = time.perf_counter()
+        booster = lgb.Booster(params=params, train_set=ds)
+        if booster.boosting._stream is None:
+            raise RuntimeError("stream stage trained RESIDENT — the "
+                               "out-of-core claim would be false")
+        booster.update()                      # compiles the block programs
+        dsync(booster.boosting.train_score)
+        compile_seconds = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(max(trees - 1, 0)):
+            booster.update()
+        dsync(booster.boosting.train_score)
+        train_seconds = (time.perf_counter() - t0) * trees / max(trees - 1,
+                                                                 1)
+        auc = holdout_auc(booster, f)
+        mem = device_memory_stats()
+        measured_host_peak = host_rss_peak_bytes()
+        result = {
+            "metric": f"out-of-core streamed train {n}x{f}, {trees} trees"
+                      f" x {leaves} leaves (holdout AUC {auc:.4f})",
+            "value": round(train_seconds, 3),
+            "unit": "seconds",
+            "rows": n,
+            "trees": trees,
+            "sec_per_tree": round(train_seconds / max(trees, 1), 4),
+            "spill_seconds": round(spill_seconds, 2),
+            "compile_seconds": round(compile_seconds, 2),
+            "holdout_auc": round(float(auc), 5),
+            "store_bytes": store.nbytes(),
+            "num_blocks": store.num_blocks,
+            "block_rows": store.block_rows,
+            # this STAGE's pumped blocks (the counter is process-wide
+            # and the stream_probe stage pumps the same instrument)
+            "blocks_streamed": int(
+                _reg.counter("stream_blocks_total").value) - blocks0,
+            "stream_plan": plan.summary(),
+            "host_predicted_vs_measured": {
+                "predicted_peak_bytes": plan.predicted_host_peak_bytes,
+                "measured_rss_bytes": host_rss_bytes(),
+                "measured_peak_bytes": measured_host_peak,
+                "measured_peak_delta_bytes":
+                    measured_host_peak - rss_peak0,
+            },
+            "hbm_predicted_vs_measured": {
+                "predicted_peak_bytes": plan.predicted_device_peak_bytes,
+                "measured_peak_bytes": int(mem.get("peak_hbm_bytes", 0)),
+            },
+        }
+        result.update(mem)
+        return result
+    finally:
+        if prev_stream is None:
+            os.environ.pop("LGBM_TPU_STREAM", None)
+        else:
+            os.environ["LGBM_TPU_STREAM"] = prev_stream
+
+
 def run_serving_bench(n_train=100_000, trees=50, leaves=63, max_bin=63,
                       n_requests=600, n_threads=8, max_request_rows=700,
                       max_batch_rows=1024):
@@ -968,6 +1106,18 @@ def tpu_worker():
                             max_bin=MAX_BIN, leaves=LEAVES)
         run_stage("hist_probe", _hist)
 
+    # out-of-core block-pump micro-bench (tools/stream_probe.py):
+    # blocks/sec, device_put overlap efficiency, host-RSS peak vs the
+    # two-level planner's prediction — cheap, banked early; errors are
+    # never journaled so a failed probe retries
+    if os.environ.get("BENCH_SKIP_STREAM_PROBE") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _stream_probe():
+            from stream_probe import run_probe as stream_run
+            return stream_run(rows=min(N, 2_000_000), features=F)
+        run_stage("stream_probe", _stream_probe)
+
     # whole-plane observability smoke (tools/obs_dump.py): a tiny
     # instrumented train+serve cycle dumping trace/metrics/prometheus
     # artifacts — cheap, banked before the long stages; errors are never
@@ -1016,6 +1166,28 @@ def tpu_worker():
     full = run_stage("full", _full, key=f"full@{n_full}")
     if full is not None and "error" in full:
         return 4
+
+    # the >=10M stage, GRADUATED (lightgbm_tpu/data/): a journaled
+    # 100M-row streamed run whose binned matrix never resides whole on
+    # host or HBM, with planner-predicted vs measured peaks on BOTH
+    # memories.  The two-level verdict banks as its own stage first so
+    # the decision survives even if the run dies.
+    stream_n = int(os.environ.get("BENCH_STREAM_ROWS", 100_000_000))
+
+    def _stream_plan():
+        from lightgbm_tpu.ops.planner import plan_stream
+        return plan_stream(rows=stream_n, features=F,
+                           num_bins=MAX_BIN + 1,
+                           num_leaves=min(LEAVES, 63)).summary()
+    run_stage("stream_plan", _stream_plan, key=f"stream_plan@{stream_n}")
+    if os.environ.get("BENCH_SKIP_STREAM") != "1":
+        run_stage(
+            "stream",
+            lambda: run_stream_bench(
+                stream_n,
+                trees=int(os.environ.get("BENCH_STREAM_TREES", 3)),
+                leaves=min(LEAVES, 63), max_bin=MAX_BIN),
+            key=f"stream@{stream_n}", budget_floor=1500)
 
     # MSLR-side benchmark (lambdarank + NDCG@10, BASELINE.md) with the
     # leftover budget — strictly after the headline number is banked

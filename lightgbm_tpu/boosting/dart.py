@@ -33,6 +33,8 @@ from .gbdt import GBDT
 
 class DART(GBDT):
     boosting_type = "dart"
+    _stream_ok = False       # drops re-evaluate saved trees over the
+    #                          resident matrix — no out-of-core streaming
     _defer_host_ok = False   # per-iteration host drop & rescale of models
     _macro_ok = False        # same reason: no fused macro-steps (the chunk
     # scheduler in engine.py falls back to c=1 per-iteration training)

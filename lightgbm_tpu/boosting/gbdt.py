@@ -78,6 +78,13 @@ class GBDT:
     # outputs came from round-local quantization scales, compounding the
     # discretization error in a way the reference never ships
     _quant_ok = True
+    # out-of-core streamed execution (lightgbm_tpu/data/): DART needs
+    # device re-evaluation of dropped trees over the full matrix and RF
+    # renews against running means per iteration — both stay resident
+    _stream_ok = True
+    # streamed-execution context (data/stream.py StreamContext); None =
+    # resident training
+    _stream = None
 
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[ObjectiveFunction]):
@@ -104,9 +111,10 @@ class GBDT:
 
         self.meta = self.train_set.feature_meta()
         self.num_data = self.train_set.num_data
-        n, F = self.train_set.host_binned().shape
-        # captured so _build_jit_fns rebuilds (reset_parameter) never touch
-        # the host binned matrix — it may be released below
+        n, F = self.train_set.binned_shape()     # metadata-only accessor:
+        # valid for host-resident, released AND block-backed (out-of-core)
+        # datasets; captured so _build_jit_fns rebuilds (reset_parameter)
+        # never touch the host binned matrix — it may be released below
         self._binned_shape = (n, F)
         # padded bin axis: power-of-two-ish friendly size
         self.num_bins = int(self.meta.max_num_bin)
@@ -117,10 +125,18 @@ class GBDT:
         # device mesh and the WHOLE per-iteration step runs under shard_map
         self._setup_distribution()
         n_pad = self._n_pad
-        if self._mesh is not None:
+        # out-of-core election (lightgbm_tpu/data/): when the two-level
+        # budget planner rules full residency out on either memory (or
+        # the Dataset is already block-backed), the matrix stays in the
+        # spill store and every histogram pass streams blocks —
+        # self.binned stays None and the streamed executor trains
+        from ..data.stream import maybe_stream_setup
+        if maybe_stream_setup(self):
+            self.binned = None
+        elif self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             if self._data_axis is not None:
-                src = self.train_set.binned
+                src = self.train_set.host_binned()
                 if self._row_perm is not None:
                     # query-aligned layout: gather rows (pads -> bin 0)
                     b = np.concatenate(
@@ -135,7 +151,7 @@ class GBDT:
                     np.ascontiguousarray(b.T),
                     NamedSharding(self._mesh, P(None, self._data_axis)))
             else:
-                src = self.train_set.binned
+                src = self.train_set.host_binned()
                 if self._col_perm is not None:
                     # shard-major EFB columns (pads -> all-zero column)
                     b = np.concatenate(
@@ -148,7 +164,7 @@ class GBDT:
                     NamedSharding(self._mesh, P(self._feature_axis, None)))
         else:
             self.binned = jnp.asarray(
-                np.ascontiguousarray(self.train_set.binned.T))
+                np.ascontiguousarray(self.train_set.host_binned().T))
         self._row_valid = jnp.asarray(self._pad_rows_np(np.ones(n, np.float32)))
         if objective is not None:
             objective.init(self.train_set.metadata, self.num_data)
@@ -276,7 +292,7 @@ class GBDT:
         self._data_axis = None
         self._feature_axis = None
         self._n_pad = self.num_data
-        self._f_pad = self.train_set.binned.shape[1]
+        self._f_pad = self.train_set.binned_shape()[1]
         self._meta_dist = None
         self._row_perm = None      # [n_pad] padded-slot -> original row
         self._inv_perm = None      # [n] original row -> padded slot
@@ -317,7 +333,7 @@ class GBDT:
                 # uniform per-shard counts, meta arranged shard-major
                 self._build_group_sharding(ndev, m)
             else:
-                F = self.train_set.binned.shape[1]
+                F = self.train_set.binned_shape()[1]
                 self._f_pad = (F + ndev - 1) // ndev * ndev
                 if self._f_pad > F:
                     import dataclasses
@@ -580,7 +596,7 @@ class GBDT:
         meta_fused = (self._meta_dist if self._meta_dist is not None
                       else self.meta).resolved()
         fused_ctx = (
-            not cegb_enabled and vote_k == 0
+            not cegb_enabled and vote_k == 0 and self._stream is None
             and self._feature_axis is None and forced_plan is None
             and (self._mesh is None or self._data_axis is None)
             and not self.config.monotone_constraints
@@ -619,7 +635,11 @@ class GBDT:
         # fused election is pending — the planner needs the literal
         # "auto" to elect, and re-resolves below if it declines.
         hist_method = self.config.tpu_hist_method
-        if hist_method == "auto" and on_accelerator() and not want_fused:
+        if hist_method == "auto" and on_accelerator() and not want_fused \
+                and self._stream is None:
+            # (streamed boosters skip the probe: it would allocate
+            # full-scale synthetic data, and the block fold resolves the
+            # kernel family itself — data/stream.py)
             from ..ops.histogram import measured_best_method
             hist_method = measured_best_method(
                 self.num_data, self._binned_shape[1], self.num_bins)
@@ -659,7 +679,21 @@ class GBDT:
         shard_rows = self._n_pad
         if self._mesh is not None and self._data_axis is not None:
             shard_rows = self._n_pad // max(nmach, 1)
-        shard_feats = int(self.binned.shape[0])
+        if self._stream is not None:
+            # streamed execution: the kernels only ever see one block of
+            # rows at a time, so the HBM plan (tile_rows inside a block)
+            # is made at block scale
+            shard_rows = int(self._stream.store.block_rows)
+        # the PADDED device column count, like the device array's leading
+        # axis the plan used to read (self.binned may be None when
+        # streaming): G_pad under sharded-EFB layout, _f_pad under plain
+        # feature sharding, the group count otherwise
+        if self._col_perm is not None:
+            shard_feats = len(self._col_perm)
+        elif self._feature_axis is not None:
+            shard_feats = int(self._f_pad)
+        else:
+            shard_feats = int(self._binned_shape[1])
         if self._feature_axis is not None:
             # the sharded array keeps its GLOBAL shape; each device's
             # kernels see only its feature slice
@@ -682,7 +716,7 @@ class GBDT:
                 machines=max(nmach, 1), fused_ok=True)
             want_fused = probe_plan.fused
         if not want_fused and self.grower_cfg.hist_method == "auto" \
-                and on_accelerator():
+                and on_accelerator() and self._stream is None:
             # the deferred timed-probe resolution (fused declined or was
             # never in play after all)
             from ..ops.histogram import measured_best_method
@@ -931,7 +965,21 @@ class GBDT:
             return (new_score, stacked, jnp.stack(leaf_ids), cegb_used,
                     cegb_rows, qscales)
 
-        if self._mesh is None:
+        if self._stream is not None:
+            # streamed executor (lightgbm_tpu/data/stream.py): the
+            # resident per-iteration/macro programs close over a resident
+            # device matrix this mode does not have — never built.  The
+            # engine's chunk scheduler sees chunk_supported() False and
+            # trains per-iteration; _train_one_iter_inner routes each
+            # step through the StreamGrower instead of _iter_fn.
+            def one_iter(*_a, **_k):
+                raise RuntimeError(
+                    "streamed (out-of-core) booster has no resident "
+                    "iteration program; training routes through "
+                    "data/stream.py")
+            self._iter_fn = one_iter
+            macro_core = None
+        elif self._mesh is None:
             # binned rides as an explicit jit argument: a closed-over
             # device array would be captured as a program CONSTANT, and at
             # HIGGS scale (11M x 28 = 308 MB) constant-embedding bloats
@@ -1066,6 +1114,11 @@ class GBDT:
         self._macro_chunk_jit = None
         self._macro_valid_jit = None
         self._has_forced_plan = forced_plan is not None
+        if self._stream is not None:
+            # (re)built with the programs so reset_parameter rebuilds
+            # refresh the streamed grower's jitted pieces too
+            from ..data.stream import StreamGrower
+            self._stream.grower = StreamGrower(self)
 
         # prediction-side programs share across boosters the same way:
         # bin metadata rides as runtime args, keyed on structure only
@@ -1248,6 +1301,8 @@ class GBDT:
         with global_timer.section("GBDT::Bagging"):
             mask = self._bagging_mask(self.iter)
 
+        if self._stream is not None:
+            return self._stream_step(grad, hess, mask)
         with global_timer.section("TreeLearner::Train(dispatch)"), \
                 _span("gbdt.dispatch", iteration=self.iter):
             (self.train_score, stacked, leaf_ids, cu, cr,
@@ -1261,6 +1316,20 @@ class GBDT:
     def _node_key(self):
         return jax.random.fold_in(self._node_key_base, self.iter)
 
+    def _stream_step(self, grad, hess, mask) -> bool:
+        """One boosting iteration through the out-of-core streamed
+        executor (data/stream.py) — the streamed twin of the _iter_fn
+        dispatch.  Identical RNG/mask draw order, identical bookkeeping
+        via _finish_iter."""
+        from ..utils.timer import global_timer
+        with global_timer.section("TreeLearner::Train(dispatch)"), \
+                _span("stream.iteration", iteration=self.iter):
+            (self.train_score, stacked,
+             self._quant_scales) = self._stream.grower.run_iteration(
+                grad, hess, mask, jnp.float32(self.shrinkage_rate),
+                self._node_key(), self._feature_masks())
+        return self._finish_iter(stacked)
+
     # ------------------------------------------------------ fused macro-steps
 
     def chunk_supported(self) -> bool:
@@ -1270,6 +1339,9 @@ class GBDT:
         (objective None) — report False and the engine's chunk scheduler
         falls back to c=1 per-iteration training."""
         return (type(self)._macro_ok
+                and self._stream is None     # the macro scan cannot
+                # device_put host blocks mid-loop; streamed training is
+                # per-iteration (and hence trivially chunk-invariant)
                 and not self._cegb_enabled
                 and not self._has_forced_plan
                 and self.objective is not None)
@@ -1744,6 +1816,11 @@ class GBDT:
         """reference: GBDT::RollbackOneIter (gbdt.cpp:422)."""
         if self.iter <= 0:
             return
+        if self._stream is not None:
+            raise RuntimeError(
+                "rollback_one_iter re-evaluates trees over the resident "
+                "binned matrix; an out-of-core streamed booster has none "
+                "(DART and rollback stay resident — LGBM_TPU_STREAM=0)")
         K = self.num_tree_per_iteration
         first = len(self.models) - K
         for k in range(K):

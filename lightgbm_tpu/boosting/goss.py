@@ -95,6 +95,10 @@ class GOSS(GBDT):
         return jnp.stack(keys), jnp.asarray(np.asarray(flags))
 
     def _train_with(self, grad, hess, mask):
+        if self._stream is not None:
+            # out-of-core streamed executor (data/stream.py): same mask,
+            # same RNG order, streamed tree growth
+            return self._stream_step(grad, hess, mask)
         (self.train_score, stacked, leaf_ids, cu, cr,
          self._quant_scales) = self._iter_fn(
             self.binned, self.train_score, mask, grad, hess,

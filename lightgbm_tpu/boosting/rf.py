@@ -24,6 +24,8 @@ from ..utils.log import log_warning
 
 class RF(GBDT):
     boosting_type = "rf"
+    _stream_ok = False       # const-gradient renewal + running-mean score
+    #                          renorm ride the resident iteration program
     _defer_host_ok = False   # custom eager finish (averaged extension)
 
     def __init__(self, config, train_set, objective):

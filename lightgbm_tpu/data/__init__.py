@@ -1,0 +1,27 @@
+"""Out-of-core streaming data plane.
+
+Trains datasets whose binned matrix never fits host RAM or HBM at once:
+the two-level budget planner (``ops.planner.plan_stream``) elects
+row-block streaming, the matrix spills to a checksummed block store
+(``blockstore.BlockStore``) and a double-buffered pump feeds device row
+blocks to a host-driven grower that folds per-leaf histograms across
+blocks before each split scan (``stream``).  See docs/PERF.md
+"out-of-core streaming".
+"""
+
+from ..ops.planner import (StreamPlan, host_limit_bytes,  # noqa: F401
+                           plan_stream, predict_host_peak_bytes,
+                           predict_stream_device_peak_bytes)
+from .blockstore import (BlockStore, BlockStoreCorruptError,  # noqa: F401
+                         FORMAT as BLOCKSTORE_FORMAT)
+from .stream import (BlockPump, StreamGrower,  # noqa: F401
+                     default_spill_dir, host_rss_bytes,
+                     host_rss_peak_bytes, maybe_stream_setup)
+
+__all__ = [
+    "BlockPump", "BlockStore", "BlockStoreCorruptError", "StreamGrower",
+    "StreamPlan", "default_spill_dir", "host_limit_bytes",
+    "host_rss_bytes", "host_rss_peak_bytes", "maybe_stream_setup",
+    "plan_stream", "predict_host_peak_bytes",
+    "predict_stream_device_peak_bytes",
+]

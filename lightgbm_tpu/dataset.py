@@ -215,9 +215,14 @@ class Dataset:
         if self.constructed:
             return self
         if getattr(self, "_streaming", False):
+            # name the first gap so an out-of-order loader sees WHERE its
+            # coverage broke, not just a count
+            missing = np.flatnonzero(~self._pushed)
+            first = int(missing[0]) if len(missing) else 0
             raise RuntimeError(
                 f"streaming dataset load incomplete: "
-                f"{int(self._pushed.sum())}/{self.num_data} rows pushed")
+                f"{int(self._pushed.sum())}/{self.num_data} rows pushed "
+                f"(first unpushed row: {first})")
         from .utils.timer import global_timer
         with global_timer.section("Dataset::Construct"):
             return self._construct_inner()
@@ -486,7 +491,8 @@ class Dataset:
 
     @classmethod
     def from_sample(cls, sample, num_total_rows: int, params=None,
-                    feature_name="auto", categorical_feature="auto"):
+                    feature_name="auto", categorical_feature="auto",
+                    spill=None, spill_block_rows: Optional[int] = None):
         """Create a streaming Dataset: bin boundaries + EFB layout from a
         row sample, the binned matrix preallocated for ``num_total_rows``;
         fill it with ``push_rows`` (rows never all resident as floats).
@@ -494,6 +500,15 @@ class Dataset:
         reference: LGBM_DatasetCreateFromSampledColumn (c_api.cpp) decides
         bins from sampled columns, then LGBM_DatasetPushRows streams row
         blocks in; the load auto-finishes when every row has been pushed.
+
+        ``spill`` routes the binned rows to an out-of-core block store
+        (lightgbm_tpu/data/) instead of a host-resident matrix — host RSS
+        stays O(chunk) no matter how many rows stream in, and training
+        executes out-of-core (docs/PERF.md "out-of-core streaming").
+        ``spill=True`` picks a temp directory (``LGBM_TPU_STREAM_DIR``
+        honored); a string is the store directory.  Spill-mode pushes
+        must be sequential (append-only); chunk sizes may vary freely,
+        including a ragged final chunk.
         """
         ds = cls(sample, params=params, feature_name=feature_name,
                  categorical_feature=categorical_feature)
@@ -510,12 +525,38 @@ class Dataset:
                             categorical)
         G = ds.num_groups
         dtype = np.uint8 if ds.max_group_bin <= 256 else np.uint16
-        ds.binned = np.zeros((ds.num_data, G), dtype=dtype)
+        if spill:
+            ds._setup_spill(spill, dtype, spill_block_rows)
+        else:
+            ds.binned = np.zeros((ds.num_data, G), dtype=dtype)
         ds.raw_data = None
         ds._pushed = np.zeros(ds.num_data, bool)   # per-row coverage
         ds._streaming = True
         ds._append_cursor = 0
         return ds
+
+    def _setup_spill(self, spill, dtype, block_rows: Optional[int]) -> None:
+        """Route streamed pushes to a block store (spill mode)."""
+        import weakref
+
+        from .data.blockstore import BlockStore
+        from .data.stream import default_spill_dir
+        path = spill if isinstance(spill, (str, os.PathLike)) \
+            else default_spill_dir()
+        if block_rows is None:
+            from .ops.planner import plan_stream
+            plan = plan_stream(rows=self.num_data, features=self.num_groups,
+                               num_bins=self.max_group_bin)
+            block_rows = plan.block_rows or self.num_data
+        self.binned = None
+        self._block_store = BlockStore.create(
+            str(path), self.num_data, self.num_groups, dtype,
+            int(block_rows))
+        self._block_store_owned = not isinstance(spill, (str, os.PathLike))
+        if self._block_store_owned:
+            weakref.finalize(self, BlockStore.cleanup, self._block_store)
+        # spill scratch: one chunk of binned rows, reused per push
+        self._spill_scratch = None
 
     @classmethod
     def from_reference_streaming(cls, reference: "Dataset",
@@ -548,8 +589,17 @@ class Dataset:
     def push_rows(self, chunk, start_row: Optional[int] = None) -> "Dataset":
         """Bin a block of raw rows into [start_row, start_row+len) of the
         preallocated matrix (reference: LGBM_DatasetPushRows, c_api.h:98).
-        ``start_row=None`` appends after the previous push.  The dataset
-        marks itself constructed when every row has been pushed."""
+        ``start_row=None`` appends after the previous push.  Chunk sizes
+        may vary push to push — a ragged final chunk smaller than the
+        sample/chunk-size hint is fine.  The dataset marks itself
+        constructed when every row has been pushed.
+
+        Overlap with already-pushed rows raises (a silent overwrite would
+        corrupt the load invisibly); a retry of a FAILED push is not an
+        overlap — coverage is only recorded after a chunk bins cleanly.
+        Spill-mode datasets (``from_sample(spill=...)``) additionally
+        require appends in order: the block store is append-only, so a
+        ``start_row`` past the cursor (a gap) raises too."""
         if not getattr(self, "_streaming", False):
             raise RuntimeError(
                 "push_rows requires a Dataset created by from_sample")
@@ -567,12 +617,37 @@ class Dataset:
         if start_row + rows > self.num_data:
             raise ValueError(
                 f"push past the end: {start_row}+{rows} > {self.num_data}")
-        self._bin_block(raw, sp, self.binned[start_row:start_row + rows])
-        # per-ROW coverage (not a count): overlapping pushes — e.g. a retry
-        # of a failed chunk — must not mark unpushed rows as loaded
+        # per-ROW coverage (not a count): a silent overwrite of loaded
+        # rows would make the finished matrix depend on push order
+        already = np.flatnonzero(self._pushed[start_row:start_row + rows])
+        if len(already):
+            raise ValueError(
+                f"push_rows overlap: row {start_row + int(already[0])} was "
+                f"already pushed (chunk covers [{start_row}, "
+                f"{start_row + rows})); pushes must cover disjoint row "
+                "ranges — only a failed push may be retried")
+        store = getattr(self, "_block_store", None)
+        if store is not None:
+            if start_row != self._append_cursor:
+                raise ValueError(
+                    f"spill-mode push_rows must append in order: expected "
+                    f"start_row={self._append_cursor}, got {start_row} "
+                    "(the block store is append-only)")
+            if self._spill_scratch is None \
+                    or self._spill_scratch.shape[0] < rows:
+                self._spill_scratch = np.zeros(
+                    (rows, self.num_groups), store.dtype)
+            out = self._spill_scratch[:rows]
+            out[:] = 0
+            self._bin_block(raw, sp, out)
+            store.append_rows(out)
+        else:
+            self._bin_block(raw, sp, self.binned[start_row:start_row + rows])
         self._pushed[start_row:start_row + rows] = True
         self._append_cursor = max(self._append_cursor, start_row + rows)
         if self._pushed.all():                   # auto-finish like the C API
+            if store is not None:
+                store.finalize()
             self.metadata.check(self.num_data)
             if self.metadata.label is None:
                 self.metadata.label = np.zeros(self.num_data, np.float32)
@@ -786,16 +861,39 @@ class Dataset:
         return self
 
     def host_binned(self) -> np.ndarray:
-        """The host binned matrix, with an informative error after
-        ``release_host_binned`` dropped it."""
-        if self.binned is None and getattr(self, "_host_binned_released",
-                                           False):
-            raise RuntimeError(
-                "the Dataset's host binned matrix was released after device "
-                "upload (free_raw_data=True on an accelerator backend); "
-                "pass free_raw_data=False or set LGBM_TPU_FREE_BINNED=0 to "
-                "keep it for reuse")
+        """The host binned matrix DATA, with an informative error when it
+        is not resident.  Consumers that only need shape/dtype metadata
+        must use ``binned_shape``/``binned_dtype`` instead — those stay
+        valid on released and block-backed (out-of-core) datasets."""
+        if self.binned is None:
+            if getattr(self, "_block_store", None) is not None:
+                raise RuntimeError(
+                    "this Dataset's binned matrix lives in an out-of-core "
+                    "block store (lightgbm_tpu/data/), not host memory; "
+                    "metadata consumers should use binned_shape()/"
+                    "binned_dtype(), bulk consumers must stream blocks "
+                    "via Dataset._block_store.read_block")
+            if getattr(self, "_host_binned_released", False):
+                raise RuntimeError(
+                    "the Dataset's host binned matrix was released after "
+                    "device upload (free_raw_data=True on an accelerator "
+                    "backend); pass free_raw_data=False or set "
+                    "LGBM_TPU_FREE_BINNED=0 to keep it for reuse")
         return self.binned
+
+    def binned_shape(self) -> tuple:
+        """(num_data, num_groups) of the binned matrix — metadata only,
+        valid whether the data is host-resident, released after device
+        upload, or spilled to an out-of-core block store."""
+        self.construct()
+        return (self.num_data, self.num_groups)
+
+    def binned_dtype(self) -> np.dtype:
+        """Storage dtype of the binned matrix (metadata twin of
+        ``binned_shape``)."""
+        self.construct()
+        return np.dtype(np.uint8 if self.max_group_bin <= 256
+                        else np.uint16)
 
     def get_params(self) -> dict:
         return dict(self.params)

@@ -139,8 +139,19 @@ def histogram_matmul(
     block_rows: int = _DEFAULT_BLOCK_ROWS,
     onehot_dtype=jnp.bfloat16,
     tile_rows: Optional[int] = None,
+    init: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Histogram via one-hot matmul over row blocks. Returns [3, F, B] f32."""
+    """Histogram via one-hot matmul over row blocks. Returns [3, F, B] f32.
+
+    ``init`` is the carry-in accumulator for the out-of-core streaming
+    fold (lightgbm_tpu/data/stream.py): a block pass that STARTS from the
+    running histogram continues the same block-ascending accumulation
+    sequence the one-shot kernel runs internally, so folding row blocks
+    through carried calls is bit-identical to one resident call — the
+    invariant behind streamed == resident f32 parity (the tile partition
+    must align across the two runs for the matmul family; scatter is
+    partition-free).
+    """
     F, n = binned_t.shape
     B = num_bins
     block_rows = _tile_block(block_rows, resolve_tile_rows(tile_rows, n))
@@ -163,8 +174,9 @@ def histogram_matmul(
                        preferred_element_type=jnp.float32)
         return acc + part, None
 
-    init = jnp.zeros((3, F * B), dtype=jnp.float32)
-    acc, _ = lax.scan(body, init, jnp.arange(nb, dtype=jnp.int32))
+    acc0 = (jnp.zeros((3, F * B), dtype=jnp.float32) if init is None
+            else init.reshape(3, F * B))
+    acc, _ = lax.scan(body, acc0, jnp.arange(nb, dtype=jnp.int32))
     return acc.reshape(3, F, B)
 
 
@@ -172,10 +184,12 @@ def histogram_matmul_f32(
     binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
     block_rows: int = _DEFAULT_BLOCK_ROWS,
     tile_rows: Optional[int] = None,
+    init: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Like histogram_matmul but f32 one-hot (exact grads; ~2x slower MXU)."""
     return histogram_matmul(binned_t, vals_t, num_bins, block_rows,
-                            onehot_dtype=jnp.float32, tile_rows=tile_rows)
+                            onehot_dtype=jnp.float32, tile_rows=tile_rows,
+                            init=init)
 
 
 def histogram_pallas(
@@ -274,6 +288,7 @@ def histogram_pallas(
 def histogram_scatter(
     binned_t: jax.Array, vals_t: jax.Array, num_bins: int,
     tile_rows: Optional[int] = None,
+    init: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Scatter-add histogram (XLA scatter). Reference semantics check path
     (CPU-oriented: the [n, F, 3] update buffer lane-pads on TPU).
@@ -283,19 +298,26 @@ def histogram_scatter(
     (f32[n*F, 3] lane-padded 42x at 11M rows).  Tiles accumulate into one
     shared histogram in ascending row order, so per-bin adds happen in
     the same sequence as the untiled scatter: tiled == untiled
-    bit-identical (padded tail rows carry +0 values into bin 0)."""
+    bit-identical (padded tail rows carry +0 values into bin 0).
+
+    ``init`` carries a running [3, F, B] accumulator in for the
+    out-of-core block fold (data/stream.py): per-bin adds always land in
+    ascending row order, so a carried fold over row blocks is
+    bit-identical to one resident pass regardless of the block
+    partition."""
     F, n = binned_t.shape
     B = num_bins
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    hist0 = (jnp.zeros((F * B, 3), dtype=jnp.float32) if init is None
+             else init.transpose(1, 2, 0).reshape(F * B, 3))
     T = resolve_tile_rows(tile_rows, n)
     if T is None:
         binned = binned_t.T                                # [n, F]
         vals = vals_t.T                                    # [n, 3]
         flat_idx = binned.astype(jnp.int32) + offsets      # [n, F]
-        hist = jnp.zeros((F * B, 3), dtype=jnp.float32)
         # vals broadcast across features: updates [n, F, 3]
         updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
-        hist = hist.at[flat_idx.reshape(-1)].add(updates.reshape(-1, 3))
+        hist = hist0.at[flat_idx.reshape(-1)].add(updates.reshape(-1, 3))
         return hist.reshape(F, B, 3).transpose(2, 0, 1)    # [3, F, B]
     nt = _pad_rows(n, T) // T
     n_pad = nt * T
@@ -309,8 +331,7 @@ def histogram_scatter(
         upd = jnp.broadcast_to(v[:, None, :], (T, F, 3))
         return hist.at[flat.reshape(-1)].add(upd.reshape(-1, 3))
 
-    hist = lax.fori_loop(0, nt, body,
-                         jnp.zeros((F * B, 3), dtype=jnp.float32))
+    hist = lax.fori_loop(0, nt, body, hist0)
     return hist.reshape(F, B, 3).transpose(2, 0, 1)
 
 
@@ -323,13 +344,16 @@ def build_histogram(
     method: str = "auto",
     block_rows: int = _DEFAULT_BLOCK_ROWS,
     tile_rows: Optional[int] = None,
+    init: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Masked histogram [3, F, B] = sum over rows with mask of (g, h, 1).
 
     ``mask`` is f32 and may carry bagging weights; leaf membership is encoded
     by zeroing non-member rows.  ``tile_rows`` streams the pass through
     row tiles so peak transient HBM is O(tile), not O(n) (planner-selected;
-    see ops/planner.py).
+    see ops/planner.py).  ``init`` is the streaming block fold's carry-in
+    accumulator (scatter/matmul families only — the pallas kernel
+    initializes its VMEM accumulator in-grid).
     """
     vals_t = _vals_t(grad, hess, mask)
     # "fused" is a grower-level arm (ops/fused.py pairs the histogram
@@ -346,14 +370,17 @@ def build_histogram(
     method = resolve_hist_method(method)
     if method == "matmul":
         return histogram_matmul(binned_t, vals_t, num_bins, block_rows,
-                                tile_rows=tile_rows)
+                                tile_rows=tile_rows, init=init)
     if method == "matmul_f32":
         return histogram_matmul_f32(binned_t, vals_t, num_bins, block_rows,
-                                    tile_rows=tile_rows)
+                                    tile_rows=tile_rows, init=init)
     if method == "scatter":
         return histogram_scatter(binned_t, vals_t, num_bins,
-                                 tile_rows=tile_rows)
+                                 tile_rows=tile_rows, init=init)
     if method == "pallas":
+        if init is not None:
+            raise ValueError("histogram_pallas does not take a carry-in "
+                             "accumulator; stream folds use scatter/matmul")
         return histogram_pallas(binned_t, vals_t, num_bins,
                                 tile_rows=tile_rows)
     raise ValueError(f"unknown histogram method {method!r}")
@@ -516,10 +543,17 @@ def segment_histogram(
     num_slots: int,
     num_bins: int,
     tile_rows: Optional[int] = None,
+    init: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-slot masked histogram: [S, 3, F, B] where row r contributes its
     (g, h, 1)*w to slot[r]'s histogram.  Rows with slot == num_slots are
     dropped (the dummy slot).
+
+    ``init`` carries a running [S, 3, F, B] accumulator in for the
+    out-of-core block fold (data/stream.py): the dummy slot restarts at
+    zero each block (it is dropped from the output anyway) while the S
+    real slots continue the global ascending-row add sequence —
+    bit-identical to one resident pass over the concatenated rows.
 
     This is the batched-frontier generalization of ``build_histogram``: one
     pass over the data builds the histograms of EVERY smaller child of a
@@ -539,15 +573,20 @@ def segment_histogram(
     B = num_bins
     S = num_slots
     offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    if init is None:
+        hist0 = jnp.zeros(((S + 1) * F * B, 3), dtype=jnp.float32)
+    else:
+        hist0 = jnp.concatenate(
+            [init.transpose(0, 2, 3, 1).reshape(S * F * B, 3),
+             jnp.zeros((F * B, 3), jnp.float32)])
     T = resolve_tile_rows(tile_rows, n)
     if T is None:
         binned = binned_t.T
         vals = _vals_t(grad, hess, weights).T              # [n, 3]
         flat = (slot[:, None].astype(jnp.int32) * (F * B)
                 + binned.astype(jnp.int32) + offsets)      # [n, F]
-        hist = jnp.zeros(((S + 1) * F * B, 3), dtype=jnp.float32)
         updates = jnp.broadcast_to(vals[:, None, :], (n, F, 3))
-        hist = hist.at[flat.reshape(-1)].add(updates.reshape(-1, 3))
+        hist = hist0.at[flat.reshape(-1)].add(updates.reshape(-1, 3))
         return hist.reshape(S + 1, F, B, 3)[:S].transpose(0, 3, 1, 2)
     nt = _pad_rows(n, T) // T
     n_pad = nt * T
@@ -563,8 +602,7 @@ def segment_histogram(
         upd = jnp.broadcast_to(v[:, None, :], (T, F, 3))
         return hist.at[flat.reshape(-1)].add(upd.reshape(-1, 3))
 
-    hist = lax.fori_loop(0, nt, body,
-                         jnp.zeros(((S + 1) * F * B, 3), jnp.float32))
+    hist = lax.fori_loop(0, nt, body, hist0)
     return hist.reshape(S + 1, F, B, 3)[:S].transpose(0, 3, 1, 2)
 
 

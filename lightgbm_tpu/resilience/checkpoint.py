@@ -105,11 +105,26 @@ def build_bundle_bytes(booster, iteration: int,
     # restores into an untiled one and vice versa — recorded so an OOM
     # post-mortem can see what the planner chose
     plan = getattr(booster.boosting, "hist_plan", None)
+    # out-of-core provenance (lightgbm_tpu/data/): streamed == resident
+    # is bit-invariant (pinned block order), so a bundle from a streamed
+    # run restores into a resident one and vice versa; the plan + the
+    # spill store's block geometry are recorded so a mid-stream resume's
+    # post-mortem can see what the pump was doing
+    splan = getattr(booster.boosting, "stream_plan", None)
+    sctx = getattr(booster.boosting, "_stream", None)
+    stream_prov = None
+    if splan is not None:
+        stream_prov = dict(splan.summary())
+        if sctx is not None:
+            stream_prov["store_path"] = sctx.store.path
+            stream_prov["store_block_rows"] = int(sctx.store.block_rows)
+            stream_prov["store_num_blocks"] = int(sctx.store.num_blocks)
     manifest = {
         "format": FORMAT,
         "iteration": int(iteration),
         "chunk_cap": chunk_cap(),
         "hist_plan": plan.summary() if plan is not None else None,
+        "stream_plan": stream_prov,
         "members": {
             "model.txt": {"sha256": _sha256(model_txt),
                           "size": len(model_txt)},

@@ -103,6 +103,42 @@ def test_chunked_equals_per_iteration_tiled(case, monkeypatch):
     assert per_iter == untiled, f"{case}: tiled != untiled"
 
 
+@pytest.mark.parametrize("case", ["gbdt", "quant"])
+def test_streamed_equals_resident_chunk_matrix(case, monkeypatch):
+    """Out-of-core streamed training (lightgbm_tpu/data/) joins the
+    chunked==per-iteration matrix: the streamed executor must reproduce
+    the resident models byte-for-byte — quant by integer associativity,
+    f32 by the pinned-block-order carry fold — under BOTH chunk-gate
+    settings (streamed training is per-iteration by construction, so
+    the scheduler's c=1 fallback must change nothing)."""
+    params, y = PARITY_CASES[case]
+    params = dict(params, tpu_tree_growth="rounds")  # the streamed
+    # grower mirrors the rounds grower; pin the resident comparator
+    monkeypatch.setenv("LGBM_TPU_STREAM", "0")
+    resident = _train(params, y, [1] * 12)
+    resident_chunked = _train(params, y, [8, 4])
+    monkeypatch.setenv("LGBM_TPU_STREAM", "1")
+    monkeypatch.setenv("LGBM_TPU_STREAM_BLOCK_ROWS", "256")
+    streamed = _train(params, y, [1] * 12)
+    assert resident_chunked == resident
+    assert streamed == resident, f"{case}: streamed != resident"
+
+    # engine runs (the chunk SCHEDULER in play): engine-streamed must
+    # equal engine-resident under both gate settings
+    def run_engine(stream, chunk):
+        monkeypatch.setenv("LGBM_TPU_STREAM", "1" if stream else "0")
+        monkeypatch.setenv("LGBM_TPU_CHUNK", chunk)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        return lgb.train(dict(params, verbosity=-1), ds,
+                         num_boost_round=12,
+                         verbose_eval=False).model_to_string()
+
+    engine_resident = run_engine(False, "32")
+    for env in ("0", "32"):
+        assert run_engine(True, env) == engine_resident, \
+            f"{case}: streamed engine run (chunk={env}) != resident"
+
+
 def test_chunked_equals_per_iteration_deferred_host(monkeypatch):
     """The deferred-host banking path (accelerator default) slices the
     chunk bundle into per-iteration pending entries; the drain must see

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Out-of-core streaming micro-bench: block pump throughput + overlap.
+
+Measures, on the live backend, against a real spill store
+(lightgbm_tpu/data/blockstore.py) built from synthetic rows:
+
+- ``spill``: rows/sec of chunked binning + atomic block writes
+  (``Dataset.from_sample(spill=...)``'s write path, run standalone);
+- ``pump``: blocks/sec and GB/s of the double-buffered
+  ``BlockPump`` (read + checksum-verify + ``jax.device_put`` + one
+  touch op per block), next to the SAME scan with prefetch disabled —
+  their ratio is ``overlap_efficiency`` (1.0 = the device_put of block
+  t+1 fully hides behind block t's compute; <=1 observed when the
+  reader can't keep ahead);
+- host-RSS accounting: the planner's PREDICTED streamed host peak
+  (``predict_host_peak_bytes``) next to the process's measured
+  VmHWM delta across the scan — the number that says whether the
+  host side of the two-level budget model is honest;
+- the ``plan_stream`` verdict for the probed shape, journal-ready.
+
+The LAST stdout line is a single JSON object so bench.py's worker can
+bank it as a stage (``stage: stream_probe``;
+``BENCH_SKIP_STREAM_PROBE=1`` skips the stage).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/stream_probe.py \
+        [--rows 2000000] [--features 28] [--block-rows 262144] \
+        [--passes 3]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_probe(rows: int = 2_000_000, features: int = 28,
+              block_rows: int = 262_144, passes: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.data.blockstore import BlockStore
+    from lightgbm_tpu.data.stream import (BlockPump, host_rss_bytes,
+                                          host_rss_peak_bytes)
+    from lightgbm_tpu.ops.planner import (plan_stream,
+                                          predict_host_peak_bytes)
+
+    rows = int(rows)
+    block_rows = min(int(block_rows), rows)
+    out = {
+        "rows": rows, "features": features, "block_rows": block_rows,
+        "backend": jax.default_backend(),
+        "plan": plan_stream(rows=rows, features=features,
+                            num_bins=64).summary(),
+    }
+
+    path = tempfile.mkdtemp(prefix="stream_probe_")
+    try:
+        # -- spill: chunked binned-row writes (synthetic bins, so the
+        # probe times the STORE, not the binning arithmetic)
+        rng = np.random.RandomState(0)
+        st = BlockStore.create(path, rows, features, np.uint8, block_rows)
+        chunk = rng.randint(0, 64, (min(block_rows, rows), features),
+                            dtype=np.uint8)
+        t0 = time.perf_counter()
+        done = 0
+        while done < rows:
+            take = min(chunk.shape[0], rows - done)
+            st.append_rows(chunk[:take])
+            done += take
+        st.finalize()
+        spill_s = time.perf_counter() - t0
+        out["spill"] = {
+            "seconds": round(spill_s, 3),
+            "rows_per_sec": round(rows / max(spill_s, 1e-9), 1),
+            "store_bytes": st.nbytes(),
+            "num_blocks": st.num_blocks,
+        }
+
+        # -- pump: prefetch on vs off; one cheap device op per block so
+        # the overlap has compute to hide behind
+        touch = jax.jit(lambda b: jnp.sum(b.astype(jnp.int32)))
+
+        def scan(prefetch: bool) -> float:
+            best = float("inf")
+            for _ in range(max(int(passes), 1)):
+                t0 = time.perf_counter()
+                acc = None
+                for (_i, _s, _r, blk) in BlockPump(st, prefetch=prefetch):
+                    acc = touch(blk) if acc is None else acc + touch(blk)
+                acc.block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        rss_before_peak = host_rss_peak_bytes()
+        rss_before = host_rss_bytes()
+        warm = scan(prefetch=True)          # first scan pays checksums
+        pumped = scan(prefetch=True)
+        serial = scan(prefetch=False)
+        gb = st.nbytes() / 1e9
+        out["pump"] = {
+            "first_scan_seconds": round(warm, 3),
+            "seconds": round(pumped, 3),
+            "seconds_no_prefetch": round(serial, 3),
+            "blocks_per_sec": round(st.num_blocks / max(pumped, 1e-9), 1),
+            "gb_per_sec": round(gb / max(pumped, 1e-9), 3),
+            "overlap_efficiency": round(serial / max(pumped, 1e-9), 3),
+        }
+        pred_host = predict_host_peak_bytes(rows, features, 1,
+                                            block_rows)[0]
+        out["host_rss"] = {
+            "predicted_stream_peak_bytes": int(pred_host),
+            "measured_rss_bytes": host_rss_bytes(),
+            "measured_rss_delta_bytes": host_rss_bytes() - rss_before,
+            "measured_peak_bytes": host_rss_peak_bytes(),
+            "measured_peak_delta_bytes":
+                host_rss_peak_bytes() - rss_before_peak,
+        }
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=2_000_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--block-rows", type=int, default=262_144)
+    ap.add_argument("--passes", type=int, default=3)
+    a = ap.parse_args()
+    out = run_probe(rows=a.rows, features=a.features,
+                    block_rows=a.block_rows, passes=a.passes)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
