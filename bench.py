@@ -79,6 +79,11 @@ host+HBM verdict banks as the "stream_plan" stage and the run
 journals planner-predicted vs measured peaks on BOTH memories;
 LGBM_TPU_STREAM / LGBM_TPU_STREAM_BLOCK_ROWS / LGBM_TPU_HOST_BYTES
 steer the election);
+BENCH_SKIP_FLEET=1 skips the serving-fleet stage (lightgbm_tpu/fleet/:
+N-model registry under a shared-HBM residency plan — measured eviction
+with every model still servable, AOT zero-compile replica restart, and
+the opt-in bf16/int8 accuracy deltas via tools/fleet_smoke.py; a missed
+acceptance bar raises so failed fleet runs are never journaled);
 LGBM_TPU_VMEM_BYTES steers the fused-megakernel VMEM arena election and
 LGBM_TPU_FUSED=0 drops the fused arm entirely (staged family only);
 LGBM_TPU_COMPILE_CACHE=<dir> wires the persistent XLA compile cache
@@ -833,6 +838,26 @@ def run_serving_bench(n_train=100_000, trees=50, leaves=63, max_bin=63,
     return out
 
 
+def run_fleet_bench(n_models=3, rows=20_000, trees=16, requests=300,
+                    threads=6):
+    """Serving-fleet metric (lightgbm_tpu/fleet/): N models behind one
+    weighted front door under a shared-HBM residency plan — measured
+    eviction with every model still servable (no OOM, no serve failure),
+    an AOT-restored replica whose first request completes with ZERO
+    compile events, and the opt-in bf16/int8 accuracy deltas, all via
+    tools/fleet_smoke.py's phased run.  Raises on any missed acceptance
+    bar so a failed fleet run is never journaled (PR 4 convention)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from fleet_smoke import run_smoke
+    summary = run_smoke(n_models=n_models, rows=rows, trees=trees,
+                        requests=requests, threads=threads)
+    if summary.get("failed"):
+        raise RuntimeError(
+            f"fleet smoke failed phases: "
+            f"{[k for k, ok in summary['phase_ok'].items() if not ok]}")
+    return summary
+
+
 def run_resilience_bench(n_train=50_000, trees=24, leaves=63, max_bin=63,
                          snapshot_freq=8):
     """Fault-tolerance overhead metric: checkpoint-bundle save/load
@@ -1202,6 +1227,12 @@ def tpu_worker():
     if os.environ.get("BENCH_SKIP_SERVING") != "1":
         run_stage("serving", run_serving_bench, budget_floor=300)
 
+    # serving-fleet stage (lightgbm_tpu/fleet/): N-model registry under a
+    # shared-HBM plan — measured eviction, AOT zero-compile restart,
+    # opt-in low-precision deltas
+    if os.environ.get("BENCH_SKIP_FLEET") != "1":
+        run_stage("fleet", run_fleet_bench, budget_floor=240)
+
     # fault-tolerance overhead (lightgbm_tpu/resilience/): checkpoint
     # save/load cost + resume bit-parity on the live backend
     if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
@@ -1278,6 +1309,13 @@ def cpu_worker():
             except Exception as e:
                 res["serving"] = {"error": str(e)[-300:]}
             emit(res)
+        if os.environ.get("BENCH_SKIP_FLEET") != "1":
+            try:
+                res["fleet"] = run_fleet_bench(
+                    rows=10_000, trees=10, requests=200, threads=4)
+            except Exception as e:
+                res["fleet"] = {"error": str(e)[-300:]}
+            emit(res)
         if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
             try:
                 res["resilience"] = run_resilience_bench(
@@ -1342,6 +1380,15 @@ def _annotate(line, tpu_stages, cpu_result):
             "error" not in cpu_result["serving"]:
         line["serving"] = dict(cpu_result["serving"],
                                note="cpu-fallback serving numbers")
+    fl = collect_ok(tpu_stages, "fleet")
+    if fl:
+        line["fleet"] = {k: v for k, v in fl.items()
+                         if k not in ("stage", "elapsed")}
+    if "fleet" not in line and cpu_result and \
+            isinstance(cpu_result.get("fleet"), dict) and \
+            "error" not in cpu_result["fleet"]:
+        line["fleet"] = dict(cpu_result["fleet"],
+                             note="cpu-fallback fleet numbers")
     resil = collect_ok(tpu_stages, "resilience")
     if resil:
         line["resilience"] = {k: v for k, v in resil.items()

@@ -17,7 +17,9 @@ from .callback import (EarlyStopException, early_stopping, print_evaluation,
 from .config import Config
 from .dataset import Dataset
 from . import serving  # noqa: F401  (in-process inference server)
+from . import fleet  # noqa: F401  (multi-model serving fleet)
 from .engine import CVBooster, cv, serve, train
+from .fleet import Fleet
 
 __version__ = "0.1.0"
 
@@ -25,6 +27,7 @@ __all__ = [
     "Dataset", "Booster", "Config", "LightGBMError", "train", "cv",
     "CVBooster", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "serve", "serving",
+    "fleet", "Fleet",
 ]
 
 try:  # sklearn API is optional at import time

@@ -1,0 +1,33 @@
+"""Production serving fleet: multi-model registry with planner-driven
+shared-HBM eviction, AOT cold start, and opt-in low-precision inference
+(docs/SERVING.md fleet section).
+
+Quick start::
+
+    fleet = lightgbm_tpu.Fleet(max_batch_rows=512)
+    fleet.add_model("ranker", "ranker.txt", weight=3.0,
+                    deadline_class="interactive")
+    fleet.add_model("scorer", booster, precision="bf16",
+                    accuracy_budget=1e-2)
+    scores = fleet.predict("ranker", X)        # or .submit() -> Future
+    fleet.export_aot()                         # compile-free replicas
+    print(fleet.prometheus_text())             # model="..."-labelled
+    fleet.close()
+
+Module map: ``registry`` (Fleet front door: weighted admission, deadline
+classes, residency replans), ``aot`` (jax.export serialize/restore of
+bucket programs under LGBM_TPU_COMPILE_CACHE/serving), ``lowprec``
+(bf16/int8 forest quantization + the accuracy-budget measurement).
+The single-model building blocks stay in ``lightgbm_tpu.serving``.
+"""
+
+from .aot import AOTStore, aot_dir_from_env
+from .lowprec import measure_accuracy_delta, quantize_forest
+from .registry import (DEFAULT_DEADLINE_CLASSES, Fleet, FleetConfig,
+                       FleetEntry)
+
+__all__ = [
+    "Fleet", "FleetConfig", "FleetEntry", "DEFAULT_DEADLINE_CLASSES",
+    "AOTStore", "aot_dir_from_env", "quantize_forest",
+    "measure_accuracy_delta",
+]

@@ -151,10 +151,47 @@ def _prom_name(name: str, prefix: str = "") -> str:
     return s
 
 
+def _esc_label(v) -> str:
+    # Prometheus text format: backslash, quote AND line feed must be
+    # escaped in label values or one bad value splits the sample across
+    # lines and the scraper rejects the whole exposition
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_suffix(labels: Optional[dict]) -> str:
+    """Canonical ``{k="v",...}`` series suffix (sorted keys) — also the
+    instrument-key suffix, so the same (name, labels) pair always
+    resolves to the same instrument."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _series(name: str, labels: Optional[dict],
+            extra: Optional[dict] = None) -> str:
+    """One exposition sample name: metric name + merged label set
+    (instrument labels first, then per-sample ones like ``le``)."""
+    merged = dict(labels or {})
+    if extra:
+        merged.update(extra)
+    return name + _labels_suffix(merged)
+
+
 class MetricsRegistry:
     """Named instrument registry; ``counter``/``gauge``/``histogram`` are
     get-or-create so call sites never race on registration.  Child
-    registries (``attach_child``) appear in snapshots as components."""
+    registries (``attach_child``) appear in snapshots as components.
+
+    ``labels={"model": "ranker"}`` creates a LABELLED series of the same
+    metric (the serving fleet's per-model instruments): distinct label
+    values are distinct instruments, keyed ``name{k="v"}``.  Unlabelled
+    instruments keep their exact historical keys in ``to_dict`` — the
+    labelled series appear ADDITIVELY under their suffixed keys — and
+    ``to_prometheus`` emits proper label sets (one # TYPE line per
+    metric name, per-sample labels like ``le`` merged in)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -163,25 +200,38 @@ class MetricsRegistry:
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._children: Dict[str, "MetricsRegistry"] = {}
+        # key -> (bare name, labels dict) for labelled series only
+        self._meta: Dict[str, tuple] = {}
 
-    def counter(self, name: str) -> Counter:
-        with self._reg_lock:
-            if name not in self._counters:
-                self._counters[name] = Counter(self._lock)
-            return self._counters[name]
+    def _key(self, name: str, labels: Optional[dict]) -> str:
+        if not labels:
+            return name
+        key = name + _labels_suffix(labels)
+        self._meta.setdefault(key, (name, dict(labels)))
+        return key
 
-    def gauge(self, name: str) -> Gauge:
+    def counter(self, name: str, labels: Optional[dict] = None) -> Counter:
         with self._reg_lock:
-            if name not in self._gauges:
-                self._gauges[name] = Gauge(self._lock)
-            return self._gauges[name]
+            key = self._key(name, labels)
+            if key not in self._counters:
+                self._counters[key] = Counter(self._lock)
+            return self._counters[key]
+
+    def gauge(self, name: str, labels: Optional[dict] = None) -> Gauge:
+        with self._reg_lock:
+            key = self._key(name, labels)
+            if key not in self._gauges:
+                self._gauges[key] = Gauge(self._lock)
+            return self._gauges[key]
 
     def histogram(self, name: str,
-                  buckets: Sequence[float] = LATENCY_BUCKETS_MS) -> Histogram:
+                  buckets: Sequence[float] = LATENCY_BUCKETS_MS,
+                  labels: Optional[dict] = None) -> Histogram:
         with self._reg_lock:
-            if name not in self._histograms:
-                self._histograms[name] = Histogram(self._lock, buckets)
-            return self._histograms[name]
+            key = self._key(name, labels)
+            if key not in self._histograms:
+                self._histograms[key] = Histogram(self._lock, buckets)
+            return self._histograms[key]
 
     # ----------------------------------------------------------- components
 
@@ -243,32 +293,45 @@ class MetricsRegistry:
             gauges = dict(self._gauges)
             hists = dict(self._histograms)
             children = dict(self._children)
+            meta = dict(self._meta)
         lines: List[str] = []
+        typed: set = set()      # one # TYPE line per metric name
+
+        def head(key):
+            name, labels = meta.get(key, (key, None))
+            return _prom_name(name, prefix), labels
+
+        def declare(n, kind):
+            if n not in typed:
+                typed.add(n)
+                lines.append(f"# TYPE {n} {kind}")
+
         for k, c in sorted(counters.items()):
-            n = _prom_name(k, prefix)
-            lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n} {c.value}")
+            n, labels = head(k)
+            declare(n, "counter")
+            lines.append(f"{_series(n, labels)} {c.value}")
         for k, g in sorted(gauges.items()):
-            n = _prom_name(k, prefix)
+            n, labels = head(k)
             v = g.value
             if isinstance(v, bool):
                 v = int(v)
             if isinstance(v, (int, float)) and math.isfinite(v):
-                lines.append(f"# TYPE {n} gauge")
-                lines.append(f"{n} {v}")
+                declare(n, "gauge")
+                lines.append(f"{_series(n, labels)} {v}")
             else:
-                sv = str(v).replace("\\", "\\\\").replace('"', '\\"')
-                lines.append(f"# TYPE {n}_info gauge")
-                lines.append(f'{n}_info{{value="{sv}"}} 1')
+                declare(f"{n}_info", "gauge")
+                lines.append(
+                    f"{_series(n + '_info', labels, {'value': v})} 1")
         for k, h in sorted(hists.items()):
-            n = _prom_name(k, prefix)
+            n, labels = head(k)
             cum, total, count = h.cumulative()
-            lines.append(f"# TYPE {n} histogram")
+            declare(n, "histogram")
             for bound, c in cum:
                 le = "+Inf" if math.isinf(bound) else repr(float(bound))
-                lines.append(f'{n}_bucket{{le="{le}"}} {c}')
-            lines.append(f"{n}_sum {total}")
-            lines.append(f"{n}_count {count}")
+                lines.append(
+                    f"{_series(n + '_bucket', labels, {'le': le})} {c}")
+            lines.append(f"{_series(n + '_sum', labels)} {total}")
+            lines.append(f"{_series(n + '_count', labels)} {count}")
         for name, child in sorted(children.items()):
             lines.append(child.to_prometheus(
                 prefix=_prom_name(name, prefix)).rstrip("\n"))

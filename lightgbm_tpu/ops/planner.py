@@ -666,6 +666,195 @@ def predict_stream_device_peak_bytes(
     return int(dev)
 
 
+# ======================================================================
+# Serving-fleet residency budget: multi-model shared-HBM election
+#
+# The serving tier (lightgbm_tpu/fleet/) keeps N models' device routing
+# arrays (DeviceForest) plus their per-bucket compiled programs resident
+# in the SAME HBM the training plans above budget.  ``plan_fleet``
+# applies the training planner's discipline to the fleet: model the
+# per-model resident bytes, elect which models (and which of their
+# ladder buckets) stay device-resident under the budget, and mark the
+# rest EVICTED — an evicted model keeps serving through the bit-identical
+# host path instead of OOMing the chip.  Low-precision models
+# (bf16/int8 thresholds, host-gathered leaves — the fixed-point GBDT
+# accelerator direction of arXiv 2011.02022) charge proportionally less,
+# so opting a model into low precision buys residency for its neighbors.
+# ======================================================================
+
+
+def predict_forest_bytes(num_trees: int, nodes_dim: int, leaves_dim: int,
+                         precision: str = "f32", cat_words: int = 0,
+                         accel: Optional[bool] = None,
+                         routing_only: bool = False) -> int:
+    """Resident device bytes of ONE model's DeviceForest arrays.
+
+    ``nodes_dim``/``leaves_dim`` are the padded [T, I]/[T, L] axes of the
+    stacked forest (predict.py).  ``precision`` prices the threshold
+    array (f32 = 4, bf16 = 2, int8 = 1 byte + a per-tree f32 dequant
+    scale); ``routing_only`` drops the leaf-value array (low-precision
+    serving gathers leaves on the host, so it never uploads them).
+    Deliberately simple — the right ORDER for the residency election,
+    like ``predict_peak_bytes``.
+    """
+    if accel is None:
+        from .histogram import on_accelerator
+        accel = on_accelerator()
+    T = max(int(num_trees), 1)
+    I = max(int(nodes_dim), 1)
+    L = max(int(leaves_dim), 1)
+    thr_item = {"f32": 4, "bf16": 2, "int8": 1}.get(precision, 4)
+    b = 3 * _arr(I, T, 4, accel)            # split_feature, left, right i32
+    b += _arr(I, T, thr_item, accel)        # thresholds
+    b += 2 * _arr(I, T, 1, accel)           # is_cat, default_left bool
+    b += _arr(I, T, 4, accel)               # missing_type i32
+    if precision == "int8":
+        b += _arr(1, T, 4, accel)           # per-tree dequant scale f32
+    if not routing_only:
+        b += _arr(L, T, 4, accel)           # leaf_value f32
+    if cat_words > 0:
+        b += 2 * _arr(I, T, 8, accel) + _arr(int(cat_words), 1, 4, accel)
+    return int(b)
+
+
+def predict_program_bytes(num_trees: int, bucket_rows: int, features: int,
+                          accel: Optional[bool] = None) -> int:
+    """Transient device bytes of one bucket-shaped serving program
+    invocation: the padded [bucket, F] f32 input, the [T, bucket]
+    traversal state (node + gathered attrs live across the while-loop
+    step) and the leaf-index output.  This is what the residency
+    election charges per WARMED bucket — the executable itself is small
+    next to its activations."""
+    if accel is None:
+        from .histogram import on_accelerator
+        accel = on_accelerator()
+    T = max(int(num_trees), 1)
+    C = max(int(bucket_rows), 1)
+    F = max(int(features), 1)
+    b = _arr(F, C, 4, accel)                # input batch f32
+    b += 4 * _arr(C, T, 4, accel)           # node/next/fval/threshold state
+    b += _arr(C, T, 4, accel)               # leaves out i32
+    return int(b)
+
+
+class FleetModelShape(NamedTuple):
+    """One serving model's shape as the fleet election sees it."""
+
+    name: str
+    num_trees: int
+    nodes_dim: int              # padded internal-node axis I
+    leaves_dim: int             # padded leaf axis L
+    features: int
+    num_class: int = 1
+    buckets: tuple = ()         # the model's bucket ladder (row counts)
+    weight: float = 1.0         # admission weight (fleet config)
+    age_s: float = 0.0          # seconds since last request (0 = hot)
+    precision: str = "f32"      # "f32" | "bf16" | "int8"
+    cat_words: int = 0
+
+
+class FleetModelPlan(NamedTuple):
+    """Residency verdict for one model."""
+
+    name: str
+    resident: bool              # device forest stays in HBM
+    resident_buckets: tuple     # buckets whose programs stay warm
+    forest_bytes: int           # charged when resident
+    program_bytes: int          # charged for the resident buckets
+    priority: float             # weight / (1 + age): the election key
+
+
+class FleetPlan(NamedTuple):
+    """Shared-HBM residency plan for a serving fleet (see section
+    docstring).  Always servable: eviction falls back to the host path,
+    so ``feasible`` is about DEVICE residency, not about serving."""
+
+    models: tuple               # FleetModelPlan per input model, input order
+    total_resident_bytes: int
+    budget_bytes: int
+    limit_bytes: int
+    limit_source: str           # "memory_stats" | "env" | "default" | "caller"
+    evicted: tuple              # names of non-resident models
+    pressure: float             # wanted-resident bytes / budget
+    feasible: bool              # every model got device residency
+
+    def summary(self) -> dict:
+        """JSON-friendly form for bench journals / telemetry."""
+        return {
+            "models": [
+                {"name": m.name, "resident": m.resident,
+                 "resident_buckets": list(m.resident_buckets),
+                 "forest_bytes": m.forest_bytes,
+                 "program_bytes": m.program_bytes,
+                 "priority": round(m.priority, 6)}
+                for m in self.models
+            ],
+            "total_resident_bytes": self.total_resident_bytes,
+            "budget_bytes": self.budget_bytes,
+            "hbm_limit_bytes": self.limit_bytes,
+            "limit_source": self.limit_source,
+            "evicted": list(self.evicted),
+            "pressure": round(self.pressure, 4),
+            "feasible": self.feasible,
+        }
+
+
+def plan_fleet(models, budget_bytes: Optional[int] = None,
+               accel: Optional[bool] = None) -> FleetPlan:
+    """Elect per-model device residency for a serving fleet.
+
+    Greedy by priority ``weight / (1 + age_s)`` — hot, heavily-weighted
+    models first.  A model is admitted when its forest plus at least its
+    smallest bucket's program fit the remaining budget; further buckets
+    are admitted smallest-first (the cheapest warm shapes give the most
+    service per byte).  Models that do not fit are EVICTED: their device
+    arrays and compiled programs are released and they serve through the
+    bit-identical host path until a replan readmits them.  ``HEADROOM``
+    applies to every limit source, exactly like ``plan_histograms``.
+    """
+    if budget_bytes is not None:
+        limit, source = int(budget_bytes), "caller"
+    else:
+        limit, source = hbm_limit_bytes()
+    budget = int(limit * HEADROOM)
+    models = list(models)
+    order = sorted(
+        range(len(models)),
+        key=lambda i: (-(models[i].weight / (1.0 + max(models[i].age_s, 0.0))),
+                       i))
+    plans: dict = {}
+    used = 0
+    wanted = 0
+    for i in order:
+        m = models[i]
+        prio = m.weight / (1.0 + max(m.age_s, 0.0))
+        fb = predict_forest_bytes(
+            m.num_trees, m.nodes_dim, m.leaves_dim, m.precision,
+            m.cat_words, accel, routing_only=m.precision != "f32")
+        ladder = sorted(set(int(b) for b in m.buckets)) or [8]
+        prog = {b: predict_program_bytes(m.num_trees, b, m.features, accel)
+                for b in ladder}
+        wanted += fb + sum(prog.values())
+        if used + fb + prog[ladder[0]] > budget:
+            plans[i] = FleetModelPlan(m.name, False, (), fb, 0, prio)
+            continue
+        used += fb
+        taken, pb = [], 0
+        for b in ladder:
+            if used + prog[b] <= budget:
+                taken.append(b)
+                used += prog[b]
+                pb += prog[b]
+        plans[i] = FleetModelPlan(m.name, True, tuple(taken), fb, pb, prio)
+    ordered = tuple(plans[i] for i in range(len(models)))
+    evicted = tuple(p.name for p in ordered if not p.resident)
+    return FleetPlan(
+        models=ordered, total_resident_bytes=used, budget_bytes=budget,
+        limit_bytes=limit, limit_source=source, evicted=evicted,
+        pressure=(wanted / budget) if budget > 0 else float("inf"),
+        feasible=not evicted)
+
+
 def plan_stream(
     rows: int,
     features: int,               # device column count (groups under EFB)

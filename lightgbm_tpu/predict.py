@@ -32,6 +32,23 @@ K_ZERO_THRESHOLD = 1e-35
 _CHUNK_ROWS = 1 << 16
 
 
+def gather_leaf_sum(forest, leaves: np.ndarray, num_class: int) -> np.ndarray:
+    """Host float64 leaf-value gather + iteration-sum epilogue:
+    [T, rows] leaf indices -> [K, rows] raw scores.
+
+    Shared by ``DeviceForest.predict_raw_padded`` and the AOT-restored
+    serving programs (fleet/aot.py) so the two epilogues cannot drift —
+    the serving bit-parity contract hangs on this exact gather +
+    ``sum(axis=0)`` reduction order matching ``StackedForest.predict_raw``.
+    """
+    K = max(num_class, 1)
+    iters = forest.num_trees // K
+    rows = leaves.shape[1]
+    tid = np.arange(forest.num_trees)
+    lv = forest.leaf_value[tid[:, None], leaves]             # [T, rows] f64
+    return lv.reshape(iters, K, rows).sum(axis=0)            # [K, rows]
+
+
 class StackedForest:
     """Padded [T, nodes] arrays for a list of HostTrees (raw-feature space)."""
 
@@ -276,30 +293,84 @@ class DeviceForest:
     float64 host path exactly for f32-precision data (float64 inputs with
     sub-f32 precision may route differently at bin boundaries — use the
     host path when that matters).
+
+    ``precision`` controls the device STORAGE of the numeric thresholds
+    (the fixed-point serving direction of arXiv 2011.02022): "bf16"
+    stores them as bfloat16 and "int8" as int8 codes plus one f32
+    dequantization scale per tree — both expect a forest whose host
+    thresholds already sit on that grid (fleet/lowprec.quantize_forest),
+    so the narrowing is lossless relative to the quantized host forest
+    and routing still matches ITS host path exactly.  ``routing_only``
+    skips the leaf-value upload entirely (the serving path gathers
+    leaves on the host): ``predict_raw`` then refuses; the leaf-index
+    paths still work.
     """
 
-    def __init__(self, forest: StackedForest, chunk_rows: int = 1 << 16):
+    def __init__(self, forest: StackedForest, chunk_rows: int = 1 << 16,
+                 precision: str = "f32", routing_only: bool = False):
         import jax
         import jax.numpy as jnp
+        if precision not in ("f32", "bf16", "int8"):
+            raise ValueError(f"unknown DeviceForest precision {precision!r}")
         self.forest = forest
         self.chunk_rows = chunk_rows
+        self.precision = precision
+        self.routing_only = routing_only
         f = forest
-        # round thresholds toward -inf in f32
+        # round thresholds toward -inf in f32 (identity for bf16/int8-grid
+        # forests: their values are exactly f32-representable)
         thr32 = f.threshold.astype(np.float32)
         over = thr32.astype(np.float64) > f.threshold
         thr32[over] = np.nextafter(thr32[over], -np.inf, dtype=np.float32)
-        self.threshold = jnp.asarray(thr32)
+        self._thr_scale = None
+        if precision == "bf16":
+            self.threshold = jnp.asarray(thr32, dtype=jnp.bfloat16)
+        elif precision == "int8":
+            # the quantized forest carries its own int8 artifacts
+            # (fleet/lowprec.quantize_forest): code array + per-tree f32
+            # scale, so the in-kernel dequantization q * scale reproduces
+            # the host threshold grid BIT-exactly instead of re-deriving
+            # a scale that could drift an ulp
+            q = getattr(f, "threshold_q", None)
+            if q is None:
+                raise ValueError(
+                    "int8 DeviceForest needs a forest quantized by "
+                    "fleet/lowprec.quantize_forest (threshold_q missing)")
+            self.threshold = jnp.asarray(q)                # int8 codes
+            self._thr_scale = jnp.asarray(
+                f.threshold_scale.astype(np.float32)[:, None])  # [T, 1]
+            # non-quantized nodes (non-finite padding, categorical
+            # bitset indices) keep their f32 value through a sparse
+            # correction applied at decision time
+            self._thr_fix_mask = jnp.asarray(f.threshold_skip)
+            self._thr_fix = jnp.asarray(thr32)
+        else:
+            self.threshold = jnp.asarray(thr32)
         self.split_feature = jnp.asarray(f.split_feature)
         self.left = jnp.asarray(f.left)
         self.right = jnp.asarray(f.right)
         self.is_cat = jnp.asarray(f.is_cat)
         self.default_left = jnp.asarray(f.default_left)
         self.missing_type = jnp.asarray(f.missing_type.astype(np.int32))
-        self.leaf_value = jnp.asarray(f.leaf_value.astype(np.float32))
+        self.leaf_value = (None if routing_only else
+                           jnp.asarray(f.leaf_value.astype(np.float32)))
         self.cat_offset = jnp.asarray(f.cat_offset)
         self.cat_nwords = jnp.asarray(f.cat_nwords)
         self.cat_words = jnp.asarray(f.cat_words)
         self._leaves_jit = jax.jit(self._leaves)
+
+    def _thr_at(self, tid2, nd):
+        """Gather the [T', nc] threshold block in f32 whatever the device
+        storage precision is."""
+        import jax.numpy as jnp
+        if self.precision == "bf16":
+            return self.threshold[tid2, nd].astype(jnp.float32)
+        if self.precision == "int8":
+            thr = (self.threshold[tid2, nd].astype(jnp.float32)
+                   * self._thr_scale[tid2, 0])
+            return jnp.where(self._thr_fix_mask[tid2, nd],
+                             self._thr_fix[tid2, nd], thr)
+        return self.threshold[tid2, nd]
 
     def _leaves(self, Xc):
         """[nc, F] f32 -> leaf index [T, nc]."""
@@ -316,7 +387,7 @@ class DeviceForest:
         def body(node):
             nd = jnp.maximum(node, 0)
             fval = Xc[rows, self.split_feature[tid2, nd]]
-            thr = self.threshold[tid2, nd]
+            thr = self._thr_at(tid2, nd)
             mt = self.missing_type[tid2, nd]
             nan = jnp.isnan(fval)
             fz = jnp.where(nan & (mt != 2), 0.0, fval)
@@ -361,17 +432,15 @@ class DeviceForest:
         import jax.numpy as jnp
         leaves = np.asarray(self._leaves_jit(
             jnp.asarray(np.asarray(Xpad, np.float32))))      # [T, rows]
-        f = self.forest
-        K = max(num_class, 1)
-        iters = f.num_trees // K
-        rows = leaves.shape[1]
-        tid = np.arange(f.num_trees)
-        lv = f.leaf_value[tid[:, None], leaves]              # [T, rows] f64
-        return lv.reshape(iters, K, rows).sum(axis=0)        # [K, rows]
+        return gather_leaf_sum(self.forest, leaves, num_class)
 
     def predict_raw(self, X: np.ndarray, num_class: int = 1) -> np.ndarray:
         """Summed raw scores [K, n] (float32 accumulation on device)."""
         import jax.numpy as jnp
+        if self.leaf_value is None:
+            raise ValueError(
+                "routing-only DeviceForest has no device leaf values; use "
+                "predict_raw_padded (host leaf gather) instead")
         n = X.shape[0]
         K = max(num_class, 1)
         T = self.forest.num_trees

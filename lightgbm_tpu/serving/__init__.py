@@ -17,7 +17,8 @@ instrument registry), ``errors`` (typed rejections).
 """
 
 from .batcher import BucketLadder
-from .errors import (DeadlineExceeded, QueueFull, ServerClosed, ServingError,
+from .errors import (DeadlineExceeded, LowPrecisionQuarantined,
+                     ModelNotFound, QueueFull, ServerClosed, ServingError,
                      SwapQuarantined)
 from .metrics import MetricsRegistry
 from .registry import CompiledModel, ModelRegistry, ProgramRegistry
@@ -27,5 +28,5 @@ __all__ = [
     "Server", "ServingConfig", "BucketLadder", "MetricsRegistry",
     "ProgramRegistry", "ModelRegistry", "CompiledModel",
     "ServingError", "QueueFull", "DeadlineExceeded", "ServerClosed",
-    "SwapQuarantined",
+    "SwapQuarantined", "LowPrecisionQuarantined", "ModelNotFound",
 ]

@@ -148,6 +148,13 @@ class MicroBatcher:
         self.metrics.gauge("queue_depth_items").set(
             len(self._q) + (1 if self._carry is not None else 0))
 
+    def queued_rows(self) -> int:
+        """Rows currently occupying the queue (carry included) — the
+        fleet's weighted-admission input (fleet/registry.py).  A plain
+        int attribute read: atomic under the GIL, intentionally lock-free
+        on the submit path."""
+        return self._queued_rows
+
     # ------------------------------------------------------------- enqueue
 
     def submit_items(self, items: List[WorkItem]) -> None:

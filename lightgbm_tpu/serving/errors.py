@@ -32,3 +32,15 @@ class SwapQuarantined(ServingError):
     """A hot-swap candidate failed its pre-promotion probe batch (raised,
     or produced non-finite output) and was NOT promoted; serving continues
     on the previous model (registry.py swap probe)."""
+
+
+class LowPrecisionQuarantined(SwapQuarantined):
+    """A bf16/int8 candidate's measured probe-batch accuracy delta
+    exceeded its declared ``accuracy_budget`` and it was NOT promoted
+    (registry.py low-precision probe; docs/SERVING.md fleet section).
+    Subclasses SwapQuarantined so existing quarantine handlers catch it."""
+
+
+class ModelNotFound(ServingError):
+    """A fleet request named a model the registry does not hold
+    (fleet/registry.py) — a routing error, not an overload condition."""

@@ -62,3 +62,118 @@ def fire_requests(server, n_requests: int, n_threads: int,
         "mismatches": mismatches,
         "errors": errors,
     }
+
+
+def _latency_summary(lat_ms: list) -> dict:
+    """p50/p90/p99 + mean/max from client-measured latencies (exact
+    percentiles over the sample, not histogram-bucket interpolation)."""
+    if not lat_ms:
+        return {"count": 0}
+    a = np.asarray(lat_ms, np.float64)
+    return {
+        "count": int(a.size),
+        "mean": round(float(a.mean()), 3),
+        "p50": round(float(np.percentile(a, 50)), 3),
+        "p90": round(float(np.percentile(a, 90)), 3),
+        "p99": round(float(np.percentile(a, 99)), 3),
+        "max": round(float(a.max()), 3),
+    }
+
+
+def fire_fleet_requests(fleet, mix: dict, n_requests: int, n_threads: int,
+                        max_request_rows: int, verify: Optional[dict] = None,
+                        timeout: float = 300.0, seed: int = 100) -> dict:
+    """Multi-model traffic storm against a ``fleet.Fleet``.
+
+    ``mix`` maps model name -> traffic weight: every request picks its
+    model by weighted draw, so the fleet bench models a real mixed
+    workload instead of N sequential single-model storms.  Sheds
+    (``QueueFull`` — the fleet's weighted-admission verdict) and deadline
+    expiries (``DeadlineExceeded`` — the model's SLO class rejecting
+    queue-aged work) are counted per model, NOT as errors: under
+    deliberate overload both are the correct, typed behavior.  ``verify`` maps model name -> full-precision
+    ``StackedForest``; every verified response must be bit-equal to
+    ``predict_raw`` (the serving acceptance bar — only meaningful for
+    f32-precision models).  The summary carries per-model request/row
+    counts and CLIENT-measured latency percentiles.
+    """
+    from .errors import DeadlineExceeded, QueueFull
+
+    names = sorted(mix)
+    w = np.asarray([float(mix[n]) for n in names], np.float64)
+    p = w / w.sum()
+    feats = {n: fleet.entry(n).model.num_features for n in names}
+    classes = {n: fleet.entry(n).model.num_class for n in names}
+    per_thread = n_requests // n_threads
+    lock = threading.Lock()
+    per_model = {n: {"requests": 0, "rows": 0, "shed": 0, "expired": 0,
+                     "lat_ms": [], "mismatches": 0} for n in names}
+    errors: list = []
+
+    def worker(tidx: int) -> None:
+        r = np.random.RandomState(seed + tidx)
+        try:
+            for _ in range(per_thread):
+                name = names[int(r.choice(len(names), p=p))]
+                m = int(r.randint(1, max_request_rows + 1))
+                Xr = r.randn(m, feats[name]).astype(np.float32) \
+                    .astype(np.float64)
+                t0 = time.perf_counter()
+                try:
+                    out = fleet.predict(name, Xr, timeout=timeout)
+                except QueueFull:
+                    with lock:
+                        per_model[name]["shed"] += 1
+                    continue
+                except DeadlineExceeded:
+                    with lock:
+                        per_model[name]["expired"] += 1
+                    continue
+                lat = (time.perf_counter() - t0) * 1e3
+                ok = True
+                if verify is not None and name in verify:
+                    K = classes[name]
+                    ref = verify[name].predict_raw(Xr, num_class=K)
+                    ok = np.array_equal(out, ref[0] if K == 1 else ref.T)
+                with lock:
+                    s = per_model[name]
+                    s["requests"] += 1
+                    s["rows"] += m
+                    s["lat_ms"].append(lat)
+                    if not ok:
+                        s["mismatches"] += 1
+        except Exception as e:  # a dead thread must not bank clean numbers
+            errors.append(
+                f"thread {tidx}: {type(e).__name__}: {str(e)[:200]}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    models_out = {}
+    for n in names:
+        s = per_model[n]
+        models_out[n] = {
+            "weight": float(mix[n]),
+            "requests": s["requests"],
+            "rows": s["rows"],
+            "shed": s["shed"],
+            "expired": s["expired"],
+            "mismatches": s["mismatches"],
+            "latency_ms": _latency_summary(s["lat_ms"]),
+        }
+    return {
+        "requests": sum(s["requests"] for s in per_model.values()),
+        "requests_planned": per_thread * n_threads,
+        "rows": sum(s["rows"] for s in per_model.values()),
+        "shed": sum(s["shed"] for s in per_model.values()),
+        "expired": sum(s["expired"] for s in per_model.values()),
+        "mismatches": sum(s["mismatches"] for s in per_model.values()),
+        "wall_seconds": wall,
+        "errors": errors,
+        "models": models_out,
+    }

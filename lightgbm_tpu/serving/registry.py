@@ -31,7 +31,7 @@ from typing import Callable, Dict, Optional, Set, Tuple
 
 import numpy as np
 
-from .errors import SwapQuarantined
+from .errors import LowPrecisionQuarantined, SwapQuarantined
 
 
 def forest_digest(forest) -> str:
@@ -48,13 +48,27 @@ def forest_digest(forest) -> str:
 
 class CompiledModel:
     """One immutable loaded model: booster + host forest (+ device forest
-    for the "device" backend), its digest, and its output transform."""
+    for the "device" backend), its digest, and its output transform.
+
+    ``precision`` opts the model into low-precision serving ("bf16" /
+    "int8"): the served forest is the quantized twin
+    (fleet/lowprec.quantize_forest) — distinct digest, host-gathered
+    leaves, narrowed device thresholds — while ``forest_full`` keeps the
+    exact forest for the accuracy probe.  ``aot`` is an optional
+    fleet.aot.AOTStore consulted before compiling a bucket program.
+    The device arrays are EVICTABLE (``drop_device``/``restore_device``,
+    driven by the fleet's shared-HBM plan): programs read the pointer at
+    call time and fall back to the bit-identical host path while the
+    model is evicted."""
 
     def __init__(self, booster, backend: str = "device",
                  num_iteration: Optional[int] = None,
-                 start_iteration: int = 0):
+                 start_iteration: int = 0,
+                 precision: str = "f32", aot=None):
         self.booster = booster
         self.backend = backend
+        self.precision = precision
+        self.aot = aot
         K = max(booster.num_tree_per_iteration, 1)
         self.num_class = K
         n_total_iter = len(booster.models) // K
@@ -63,20 +77,51 @@ class CompiledModel:
                              if booster.best_iteration > 0 else n_total_iter)
         stop_iter = min(start_iteration + num_iteration, n_total_iter)
         self.num_iterations = stop_iter - start_iteration
-        self.forest = booster._forest(start_iteration, stop_iter)
+        self.forest_full = booster._forest(start_iteration, stop_iter)
+        if precision != "f32":
+            from ..fleet.lowprec import quantize_forest
+            self.forest = quantize_forest(self.forest_full, precision)
+        else:
+            self.forest = self.forest_full
         self.num_features = booster.num_features()
-        # share Booster.predict's cached DeviceForest: predict() then
-        # serve() on the same model must not re-trace per shape twice
-        self.device_forest = (booster._device_forest(self.forest)
-                              if backend == "device" else None)
+        self.device_forest = None
+        if backend == "device":
+            self.restore_device()
         self.digest = forest_digest(self.forest)
         self.average_output = bool(getattr(booster, "average_output", False))
+
+    # --------------------------------------------------------- device state
+
+    def restore_device(self) -> None:
+        """(Re-)upload the routing arrays; no-op off the device backend or
+        when already resident."""
+        if self.backend != "device" or self.device_forest is not None:
+            return
+        if self.precision == "f32":
+            # share Booster.predict's cached DeviceForest: predict() then
+            # serve() on the same model must not re-trace per shape twice
+            self.device_forest = self.booster._device_forest(self.forest)
+        else:
+            from ..predict import DeviceForest
+            self.device_forest = DeviceForest(
+                self.forest, precision=self.precision, routing_only=True)
+
+    def drop_device(self) -> None:
+        """Release the device routing arrays (fleet eviction).  Serving
+        continues through the host path — bit-identical for the same
+        inputs — until ``restore_device``."""
+        dropped, self.device_forest = self.device_forest, None
+        cache = getattr(self.booster, "_device_forest_cache", None)
+        if (cache is not None and dropped is not None
+                and cache[1] is dropped):
+            self.booster._device_forest_cache = None
 
     def make_program(self, bucket_rows: int) -> Callable:
         """Predict callable for one bucket shape: [bucket, F] float64
         padded batch -> raw scores [K, bucket] float64.
 
         Both backends are bit-identical to ``StackedForest.predict_raw``
+        (of the SERVED forest — the quantized twin under low precision)
         per row — "host" unconditionally (it IS predict_raw on the padded
         batch; per-row work is independent of the padding rows), "device"
         for float32-precision feature values (DeviceForest's documented
@@ -84,19 +129,49 @@ class CompiledModel:
         host in float64 in the same order as predict_raw).
         """
         K = self.num_class
+        forest = self.forest
         if self.backend == "host":
-            forest = self.forest
 
             def run(Xpad: np.ndarray) -> np.ndarray:
                 return forest.predict_raw(Xpad, num_class=K)
 
             return run
-        dev = self.device_forest
+        if self.aot is not None and self.device_forest is not None:
+            from ..fleet.aot import make_aot_program
+            prog = make_aot_program(self.aot, self, bucket_rows)
+            if prog is not None:
+                return prog
+        model = self
 
         def run(Xpad: np.ndarray) -> np.ndarray:
+            dev = model.device_forest
+            if dev is None:     # evicted mid-flight: host path, same bits
+                return forest.predict_raw(Xpad, num_class=K)
             return dev.predict_raw_padded(Xpad, num_class=K)
 
+        # built while evicted: nothing traces or compiles until the model
+        # is restored, so the program registry must not count it as a
+        # compile_event (that counter is the AOT zero-compile
+        # cold-start discriminator)
+        run.host_fallback = self.device_forest is None
         return run
+
+    def export_aot(self, store, buckets) -> int:
+        """Serialize this model's routing program for ``buckets`` into
+        ``store`` (fleet.aot.AOTStore); returns entries written."""
+        if self.device_forest is None:
+            return 0
+        return store.export_device_forest(
+            self.device_forest, self.num_features, buckets, self.digest)
+
+    def measure_accuracy(self, X: np.ndarray) -> float:
+        """max |served raw - full-precision raw| over probe rows ``X``
+        (0.0 for f32 models by construction)."""
+        if self.precision == "f32":
+            return 0.0
+        from ..fleet.lowprec import measure_accuracy_delta
+        return measure_accuracy_delta(self.forest_full, self.forest, X,
+                                      num_class=self.num_class)
 
     def scale_raw(self, raw: np.ndarray) -> np.ndarray:
         """The average_output division Booster.predict applies to BOTH
@@ -144,11 +219,33 @@ class ProgramRegistry:
             self._lru[key] = prog
             self.seen_buckets.add((bucket_rows, model.num_class))
             self.metrics.counter("bucket_misses").inc()
-            self.metrics.counter("compile_events").inc()
+            if getattr(prog, "aot", False):
+                # restored from the AOT serving cache (fleet/aot.py):
+                # no trace, backend compile rides the persistent cache —
+                # the zero-compile cold-start discriminator
+                self.metrics.counter("aot_program_loads").inc()
+            elif getattr(prog, "host_fallback", False):
+                # device-backend program built while the model was
+                # evicted: serves through the host path, no compile
+                self.metrics.counter("host_fallback_builds").inc()
+            else:
+                self.metrics.counter("compile_events").inc()
             while len(self._lru) > self.max_programs:
                 self._lru.popitem(last=False)
                 self.metrics.counter("program_evictions").inc()
         return prog
+
+    def evict_model(self, digest: str) -> int:
+        """Drop every cached program of one model digest (fleet residency
+        eviction/restore: the next ``get`` rebuilds against the model's
+        CURRENT device/host state).  Returns the number evicted."""
+        with self._lock:
+            keys = [k for k in self._lru if k[0] == digest]
+            for k in keys:
+                del self._lru[k]
+            if keys:
+                self.metrics.counter("program_evictions").inc(len(keys))
+        return len(keys)
 
     def warm(self, model: CompiledModel,
              buckets: Optional[Set[Tuple[int, int]]] = None) -> int:
@@ -175,15 +272,27 @@ class ModelRegistry:
     def __init__(self, booster, programs: ProgramRegistry, metrics,
                  backend: str = "device",
                  num_iteration: Optional[int] = None,
-                 start_iteration: int = 0):
+                 start_iteration: int = 0,
+                 precision: str = "f32",
+                 accuracy_budget: Optional[float] = None,
+                 probe_X=None, aot=None):
         self.programs = programs
         self.metrics = metrics
         self.backend = backend
+        self.precision = precision
+        self.accuracy_budget = accuracy_budget
+        self.probe_X = probe_X
+        self.aot = aot
         self._swap_lock = threading.Lock()    # serializes swaps, not reads
         self._seq_lock = threading.Lock()     # ticket allocation only
         self._active = CompiledModel(booster, backend=backend,
                                      num_iteration=num_iteration,
-                                     start_iteration=start_iteration)
+                                     start_iteration=start_iteration,
+                                     precision=precision, aot=aot)
+        # a low-precision model must pass its accuracy budget BEFORE it
+        # ever serves — construction is the same admission boundary a
+        # swap probe guards
+        self._probe_lowprec(self._active)
         metrics.gauge("active_model_digest").set(self._active.digest)
         metrics.gauge("model_generation").set(0)
         self._generation = 0
@@ -226,6 +335,37 @@ class ModelRegistry:
                 f"hot-swap candidate {model.digest} produced non-finite "
                 f"probe output; swap rolled back")
 
+    def _probe_rows(self, model: CompiledModel) -> np.ndarray:
+        """Probe rows for the low-precision accuracy measurement: the
+        caller-supplied batch when given (representative data routes far
+        more realistically than noise), else a deterministic
+        float32-precise standard-normal batch."""
+        if self.probe_X is not None:
+            return np.asarray(self.probe_X, np.float64)
+        rng = np.random.RandomState(0x1F1EE7)
+        return rng.randn(256, model.num_features) \
+            .astype(np.float32).astype(np.float64)
+
+    def _probe_lowprec(self, model: CompiledModel) -> None:
+        """Measure a bf16/int8 candidate's raw-score drift on the probe
+        batch and QUARANTINE it when the drift exceeds the declared
+        ``accuracy_budget`` — the low-precision counterpart of ``_probe``:
+        a model that cannot meet its own budget never serves.  The
+        measured delta is journaled either way (``lowprec_accuracy_delta``
+        gauge) so operators see what the precision actually costs."""
+        if model.precision == "f32":
+            return
+        delta = model.measure_accuracy(self._probe_rows(model))
+        self.metrics.gauge("lowprec_accuracy_delta").set(delta)
+        self.metrics.gauge("lowprec_precision").set(model.precision)
+        if self.accuracy_budget is not None and delta > self.accuracy_budget:
+            self.metrics.counter("swap_quarantines").inc()
+            self.metrics.counter("lowprec_quarantines").inc()
+            raise LowPrecisionQuarantined(
+                f"{model.precision} candidate {model.digest} measured "
+                f"probe accuracy delta {delta:.3e} over the declared "
+                f"budget {self.accuracy_budget:.3e}; not promoted")
+
     def swap(self, booster, warm: bool = True, block: bool = True,
              num_iteration: Optional[int] = None,
              start_iteration: int = 0,
@@ -240,10 +380,13 @@ class ModelRegistry:
         meanwhile).  With ``probe=True`` (default) the candidate must
         first survive a probe batch — exceptions or non-finite output
         quarantine it (``SwapQuarantined``; ``swap_quarantines`` metric)
-        and the old model keeps serving."""
+        and the old model keeps serving.  A registry configured for
+        low-precision serving additionally holds the candidate to its
+        ``accuracy_budget`` (``LowPrecisionQuarantined``)."""
         new = CompiledModel(booster, backend=self.backend,
                             num_iteration=num_iteration,
-                            start_iteration=start_iteration)
+                            start_iteration=start_iteration,
+                            precision=self.precision, aot=self.aot)
         # ticket taken at CALL time: two block=False swaps whose daemon
         # threads win the lock out of order must still converge on the
         # later call's model, not the later lock acquirer's.  Allocation
@@ -260,6 +403,7 @@ class ModelRegistry:
                         return      # a newer swap already landed
                     if probe:
                         self._probe(new)
+                        self._probe_lowprec(new)
                     if warm:
                         self.programs.warm(new)
                     self._applied_seq = seq

@@ -55,10 +55,24 @@ class ServingConfig:
     raw_score: bool = True            # False: predict()-style transform
     num_iteration: Optional[int] = None
     start_iteration: int = 0
+    # opt-in low-precision serving (docs/SERVING.md fleet section):
+    # "bf16" / "int8" serve the quantized twin of the model, held to
+    # accuracy_budget on a probe batch (probe_X, else deterministic
+    # noise) at admission AND at every hot-swap; "f32" (default) keeps
+    # raw-score bit-parity with Booster.predict(raw_score=True)
+    precision: str = "f32"
+    accuracy_budget: Optional[float] = None
+    probe_X: Optional[object] = None
+    # AOT serving-program cache directory (fleet/aot.py); None = look at
+    # LGBM_TPU_COMPILE_CACHE/serving, "" / "off" = disabled
+    aot_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.backend not in ("device", "host"):
             raise ValueError(f"unknown serving backend {self.backend!r}")
+        if self.precision not in ("f32", "bf16", "int8"):
+            raise ValueError(f"unknown serving precision "
+                             f"{self.precision!r}")
 
 
 class _Request:
@@ -137,10 +151,14 @@ class Server:
                                    config.max_batch_rows)
         self.programs = ProgramRegistry(self.metrics,
                                         max_programs=config.max_programs)
+        self.aot = self._resolve_aot(config.aot_dir)
         self.models = ModelRegistry(
             booster, self.programs, self.metrics, backend=config.backend,
             num_iteration=config.num_iteration,
-            start_iteration=config.start_iteration)
+            start_iteration=config.start_iteration,
+            precision=config.precision,
+            accuracy_budget=config.accuracy_budget,
+            probe_X=config.probe_X, aot=self.aot)
         self._batcher = MicroBatcher(
             self.ladder, self._run_batch, self.metrics,
             batch_window_ms=config.batch_window_ms,
@@ -152,6 +170,45 @@ class Server:
         # live server as a named component; detached at close()
         self._obs_component = _obs_registry.attach_child(
             "serving", self.metrics)
+
+    @staticmethod
+    def _resolve_aot(aot_dir):
+        """AOT serving-program store (fleet/aot.py): an explicit dir wins;
+        ``None`` follows LGBM_TPU_COMPILE_CACHE/serving (the PR 5
+        persistent cache, extended to serving buckets); "" / "off"
+        disables."""
+        from ..fleet.aot import AOTStore, aot_dir_from_env
+        if aot_dir is None:
+            aot_dir = aot_dir_from_env()
+        elif not str(aot_dir).strip() or \
+                str(aot_dir).strip().lower() in ("0", "off", "none"):
+            aot_dir = None
+        return AOTStore(aot_dir) if aot_dir else None
+
+    def _ladder_rows(self, buckets) -> set:
+        """Map requested row counts through the bucket ladder (default:
+        the whole ladder) — traffic only ever sees bucket shapes, so
+        warming or exporting a raw row count would build a shape never
+        served.  Shared by ``warm`` and ``export_aot`` so the exported
+        buckets can never diverge from the warmed ones."""
+        return {self.ladder.bucket_for(min(b, self.ladder.max_rows))
+                for b in (buckets if buckets is not None
+                          else self.ladder.buckets)}
+
+    def export_aot(self, path: Optional[str] = None, buckets=None) -> int:
+        """Serialize the active model's routing programs for ``buckets``
+        (default: the whole ladder) into the AOT store so a fresh
+        replica's first request pays no trace and no fresh XLA compile
+        (fleet/aot.py).  Returns the number of entries written."""
+        from ..fleet.aot import AOTStore
+        store = AOTStore(path) if path is not None else self.aot
+        if store is None:
+            raise ServingError(
+                "no AOT store configured: pass path=, set aot_dir, or "
+                "set LGBM_TPU_COMPILE_CACHE")
+        model = self.models.active
+        rows = self._ladder_rows(buckets)
+        return model.export_aot(store, rows)
 
     # --------------------------------------------------------------- submit
 
@@ -292,9 +349,7 @@ class Server:
         model = self.models.active
         # map through the ladder: traffic only ever sees bucket shapes,
         # so warming a raw row count would compile a shape never served
-        rows = {self.ladder.bucket_for(min(b, self.ladder.max_rows))
-                for b in (buckets if buckets is not None
-                          else self.ladder.buckets)}
+        rows = self._ladder_rows(buckets)
         return self.programs.warm(model,
                                   {(b, model.num_class) for b in rows})
 

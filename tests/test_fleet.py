@@ -1,0 +1,623 @@
+"""Serving fleet (lightgbm_tpu/fleet/): multi-model registry,
+planner-driven shared-HBM eviction, AOT cold start, opt-in low-precision
+inference (docs/SERVING.md fleet section).
+
+All CPU-runnable under the tier-1 command.  Data is float32-precise so
+the "device" backend's routing-exactness domain applies: the default
+(f32) fleet path must be BIT-equal to ``Booster.predict(raw_score=True)``
+— resident, evicted, and AOT-restored alike.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import AOTStore, Fleet, quantize_forest
+from lightgbm_tpu.fleet.lowprec import int8_rows, measure_accuracy_delta
+from lightgbm_tpu.ops.planner import (HEADROOM, FleetModelShape, plan_fleet,
+                                      predict_forest_bytes,
+                                      predict_program_bytes)
+from lightgbm_tpu.serving import (LowPrecisionQuarantined, ModelNotFound,
+                                  QueueFull)
+
+pytestmark = pytest.mark.fleet
+
+F = 10
+
+
+def _f32_data(rng, n, f=F):
+    return rng.randn(n, f).astype(np.float32).astype(np.float64)
+
+
+def _train(n=1200, rounds=10, leaves=15, seed=0, num_class=None):
+    rng = np.random.RandomState(seed)
+    X = _f32_data(rng, n)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": leaves}
+    if num_class:
+        params = {"objective": "multiclass", "num_class": num_class,
+                  "verbosity": -1, "num_leaves": leaves}
+        y = rng.randint(0, num_class, n).astype(float)
+    else:
+        y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds, verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def boosters():
+    return [_train(seed=0), _train(seed=1), _train(seed=2, num_class=3)]
+
+
+def _fleet3(boosters, **kw):
+    kw.setdefault("max_batch_rows", 128)
+    fleet = Fleet(**kw)
+    # keep the interactive class generous: a first-compile stall on a
+    # loaded CI box must not expire legitimate traffic mid-test (the
+    # deadline-class mechanics get their own tightened test below)
+    fleet.config.deadline_classes["interactive"] = 10_000.0
+    fleet.add_model("m0", boosters[0], weight=3.0,
+                    deadline_class="interactive")
+    fleet.add_model("m1", boosters[1], weight=1.0)
+    fleet.add_model("m2", boosters[2], weight=1.0, deadline_class="batch")
+    return fleet
+
+
+def _hot_only_budget(fleet, hot="m0"):
+    """A caller budget that fits exactly the hottest model's residency."""
+    plan = fleet.replan()
+    mp = next(m for m in plan.models if m.name == hot)
+    return int((mp.forest_bytes + mp.program_bytes + 1024) / HEADROOM)
+
+
+# ------------------------------------------------------------- planner
+
+
+def test_plan_fleet_budget_election():
+    shapes = [
+        FleetModelShape("hot", 100, 30, 31, F, buckets=(8, 64), weight=4.0),
+        FleetModelShape("cold", 100, 30, 31, F, buckets=(8, 64),
+                        weight=1.0, age_s=300.0),
+    ]
+    big = plan_fleet(shapes, budget_bytes=1 << 30, accel=False)
+    assert big.feasible and big.evicted == ()
+    assert all(m.resident_buckets == (8, 64) for m in big.models)
+    hot_cost = (big.models[0].forest_bytes + big.models[0].program_bytes)
+    small = plan_fleet(shapes, budget_bytes=int((hot_cost + 512) / HEADROOM),
+                       accel=False)
+    assert small.evicted == ("cold",)
+    assert not small.feasible
+    assert small.models[0].resident
+    # the verdict order follows the INPUT order, not the priority order
+    assert [m.name for m in small.models] == ["hot", "cold"]
+    # priority election: recency beats nominal weight — a hot low-weight
+    # model keeps residency over a long-stale heavy one
+    shapes2 = [
+        FleetModelShape("stale", 100, 30, 31, F, buckets=(8,), weight=4.0,
+                        age_s=1e6),
+        FleetModelShape("fresh", 100, 30, 31, F, buckets=(8,), weight=1.0),
+    ]
+    one_cost = (predict_forest_bytes(100, 30, 31, accel=False)
+                + predict_program_bytes(100, 8, F, accel=False))
+    one = plan_fleet(
+        shapes2, budget_bytes=int((one_cost + 512) / HEADROOM), accel=False)
+    assert one.evicted == ("stale",)
+
+
+def test_plan_fleet_partial_bucket_residency():
+    shapes = [FleetModelShape("m", 200, 60, 61, F,
+                              buckets=(8, 512, 4096), weight=1.0)]
+    fb = predict_forest_bytes(200, 60, 61, accel=False)
+    small_prog = predict_program_bytes(200, 8, F, accel=False)
+    mid_prog = predict_program_bytes(200, 512, F, accel=False)
+    plan = plan_fleet(
+        shapes, budget_bytes=int((fb + small_prog + mid_prog + 256)
+                                 / HEADROOM), accel=False)
+    (mp,) = plan.models
+    assert mp.resident
+    # smallest-first bucket admission: 8 and 512 fit, 4096 does not
+    assert mp.resident_buckets == (8, 512)
+    assert plan.feasible          # the model IS resident; buckets degrade
+
+
+def test_predict_forest_bytes_precision_ladder():
+    f32 = predict_forest_bytes(100, 30, 31, "f32", accel=False)
+    bf16 = predict_forest_bytes(100, 30, 31, "bf16", accel=False,
+                                routing_only=True)
+    int8 = predict_forest_bytes(100, 30, 31, "int8", accel=False,
+                                routing_only=True)
+    assert f32 > bf16 > int8
+    assert predict_forest_bytes(200, 30, 31, accel=False) > f32
+    assert predict_program_bytes(100, 64, F, accel=False) > \
+        predict_program_bytes(100, 8, F, accel=False)
+
+
+# ------------------------------------------------------- default parity
+
+
+def test_fleet_default_bit_parity(boosters):
+    fleet = _fleet3(boosters)
+    try:
+        rng = np.random.RandomState(5)
+        for name, b in zip(("m0", "m1", "m2"), boosters):
+            X = _f32_data(rng, 33)
+            out = fleet.predict(name, X, timeout=60)
+            assert np.array_equal(out, b.predict(X, raw_score=True)), name
+    finally:
+        fleet.close()
+
+
+def test_fleet_unknown_model_and_classes(boosters):
+    fleet = _fleet3(boosters)
+    try:
+        with pytest.raises(ModelNotFound):
+            fleet.predict("nope", np.zeros((1, F)))
+        with pytest.raises(ValueError):
+            fleet.add_model("bad_class", boosters[0],
+                            deadline_class="warp-speed")
+        with pytest.raises(ValueError):
+            fleet.add_model("m0", boosters[0])     # duplicate name
+        with pytest.raises(ValueError):
+            fleet.add_model("w", boosters[0], weight=0.0)
+    finally:
+        fleet.close()
+
+
+def test_fleet_traffic_mix_loadgen(boosters):
+    from lightgbm_tpu.serving.loadgen import fire_fleet_requests
+    fleet = _fleet3(boosters)
+    try:
+        verify = {}
+        for name, b in zip(("m0", "m1", "m2"), boosters):
+            n_iter = len(b.models) // b.num_tree_per_iteration
+            verify[name] = b._forest(0, n_iter)
+        storm = fire_fleet_requests(
+            fleet, {"m0": 3.0, "m1": 1.0, "m2": 1.0}, n_requests=60,
+            n_threads=4, max_request_rows=100, verify=verify, timeout=60)
+        assert storm["errors"] == []
+        assert storm["mismatches"] == 0
+        assert storm["requests"] + storm["shed"] + storm["expired"] \
+            == storm["requests_planned"]
+        # per-model latency percentiles ride the summary
+        for name in ("m0", "m1", "m2"):
+            s = storm["models"][name]
+            if s["requests"]:
+                assert set(s["latency_ms"]) >= {"p50", "p90", "p99"}
+        # weighted draw: the weight-3 model sees the most traffic
+        assert storm["models"]["m0"]["requests"] >= \
+            storm["models"]["m1"]["requests"]
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------------- eviction
+
+
+def test_fleet_eviction_keeps_models_servable(boosters):
+    fleet = _fleet3(boosters)
+    try:
+        fleet.config.hbm_budget_bytes = _hot_only_budget(fleet)
+        plan = fleet.replan()
+        assert len(plan.evicted) >= 1 and "m0" not in plan.evicted
+        rng = np.random.RandomState(6)
+        for name, b in zip(("m0", "m1", "m2"), boosters):
+            X = _f32_data(rng, 21)
+            out = fleet.predict(name, X, timeout=60)
+            assert np.array_equal(out, b.predict(X, raw_score=True)), name
+        for name in plan.evicted:
+            e = fleet.entry(name)
+            assert e.model.device_forest is None
+            assert not e.resident
+        c = fleet.metrics_dict()["counters"]
+        assert sum(v for k, v in c.items()
+                   if k.startswith("fleet_evictions")) == len(plan.evicted)
+    finally:
+        fleet.close()
+
+
+def test_fleet_evict_then_restore_round_trip(boosters):
+    fleet = _fleet3(boosters)
+    try:
+        fleet.config.hbm_budget_bytes = _hot_only_budget(fleet)
+        plan = fleet.replan()
+        evicted = plan.evicted
+        assert evicted
+        fleet.config.hbm_budget_bytes = None
+        plan2 = fleet.replan()
+        assert plan2.evicted == ()
+        rng = np.random.RandomState(7)
+        for name in evicted:
+            e = fleet.entry(name)
+            assert e.model.device_forest is not None and e.resident
+            b = boosters[int(name[1:])]
+            X = _f32_data(rng, 17)
+            assert np.array_equal(fleet.predict(name, X, timeout=60),
+                                  b.predict(X, raw_score=True))
+        c = fleet.metrics_dict()["counters"]
+        assert sum(v for k, v in c.items()
+                   if k.startswith("fleet_restores")) == len(evicted)
+    finally:
+        fleet.close()
+
+
+def test_fleet_eviction_under_load(boosters):
+    """Replanning back and forth WHILE requests are in flight: no
+    errors, every response still bit-equal (programs read the device
+    pointer at call time; the host fallback is bit-identical)."""
+    fleet = _fleet3(boosters)
+    tiny = _hot_only_budget(fleet)
+    stop = threading.Event()
+    flips = [0]
+
+    def churn():
+        while not stop.is_set():
+            fleet.config.hbm_budget_bytes = \
+                tiny if flips[0] % 2 == 0 else None
+            fleet.replan()
+            flips[0] += 1
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        rng = np.random.RandomState(8)
+        for i in range(30):
+            name = f"m{i % 3}"
+            b = boosters[i % 3]
+            X = _f32_data(rng, 1 + (i * 7) % 64)
+            out = fleet.predict(name, X, timeout=60)
+            assert np.array_equal(out, b.predict(X, raw_score=True))
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        fleet.close()
+    assert flips[0] >= 1
+
+
+# ---------------------------------------------------- weighted admission
+
+
+def test_weighted_admission_sheds_over_share(boosters):
+    fleet = _fleet3(boosters, max_queue_rows=1000)
+    try:
+        heavy, light = fleet.entry("m0"), fleet.entry("m1")
+        # fake a saturated queue: the fleet-wide total is over cap, the
+        # heavy model holds most of it
+        heavy.server._batcher._queued_rows = 900
+        light.server._batcher._queued_rows = 90
+        try:
+            # m0 (weight 3/5 -> share 600 rows) is OVER its share: shed
+            with pytest.raises(QueueFull):
+                fleet._admit(heavy, 50)
+            # m1 (weight 1/5 -> share 200 rows) is under its share even
+            # though the fleet is saturated: protected, admitted
+            fleet._admit(light, 50)
+            c = fleet.metrics_dict()["counters"]
+            assert c['fleet_shed_total{model="m0"}'] == 1
+            assert 'fleet_shed_total{model="m1"}' not in c
+        finally:
+            heavy.server._batcher._queued_rows = 0
+            light.server._batcher._queued_rows = 0
+    finally:
+        fleet.close()
+
+
+def test_deadline_class_applies_default_deadline(boosters):
+    fleet = _fleet3(boosters)
+    try:
+        # give the interactive class an unmeetable deadline: the batcher
+        # must reject the request at pop time with DeadlineExceeded
+        fleet.config.deadline_classes["interactive"] = 1e-7
+        from lightgbm_tpu.serving import DeadlineExceeded
+        with pytest.raises(DeadlineExceeded):
+            fleet.predict("m0", np.zeros((4, F)), timeout=60)
+        # an explicit per-request deadline overrides the class default
+        out = fleet.predict("m0", np.zeros((4, F)), deadline_ms=60_000,
+                            timeout=60)
+        assert out.shape == (4,)
+        # the "batch" class (None) imposes no deadline
+        assert fleet.predict("m2", np.zeros((4, F)), timeout=60) is not None
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------------------ AOT
+
+
+def test_aot_store_roundtrip(tmp_path, boosters):
+    srv = boosters[0].serve(max_batch_rows=64)
+    try:
+        n = srv.export_aot(path=str(tmp_path))
+        assert n == len(srv.ladder.buckets)
+        store = AOTStore(str(tmp_path))
+        model = srv.models.active
+        assert store.buckets_for(model.digest) == srv.ladder.buckets
+        fn = store.load_leaves(model.digest, 16)
+        X = _f32_data(np.random.RandomState(3), 16).astype(np.float32)
+        got = np.asarray(fn(X))
+        want = np.asarray(model.device_forest._leaves_jit(X))
+        assert np.array_equal(got, want)
+        assert store.load_leaves(model.digest, 4096) is None   # miss
+        assert store.load_leaves("feedface00000000", 16) is None
+    finally:
+        srv.close()
+
+
+def test_aot_replica_first_request_zero_compiles(tmp_path, boosters):
+    fleet = _fleet3(boosters)
+    exported = fleet.export_aot(str(tmp_path))
+    fleet.close()
+    assert exported == 3 * 5            # 3 models x ladder 8..128
+    replica = _fleet3(boosters, aot_dir=str(tmp_path))
+    try:
+        replica.warm()
+        rng = np.random.RandomState(4)
+        for name, b in zip(("m0", "m1", "m2"), boosters):
+            X = _f32_data(rng, 40)
+            out = replica.predict(name, X, timeout=60)
+            assert np.array_equal(out, b.predict(X, raw_score=True)), name
+        for name in ("m0", "m1", "m2"):
+            c = replica.entry(name).server.metrics_dict()["counters"]
+            assert c.get("compile_events", 0) == 0, name
+            assert c.get("aot_program_loads", 0) >= 1, name
+    finally:
+        replica.close()
+
+
+def test_aot_corrupt_entry_is_a_miss_not_a_failure(tmp_path, boosters):
+    srv = boosters[0].serve(max_batch_rows=64)
+    digest = srv.models.active.digest
+    srv.export_aot(path=str(tmp_path))
+    srv.close()
+    # corrupt one blob, truncate another's metadata
+    with open(os.path.join(str(tmp_path), f"{digest}-b16.bin"), "wb") as fh:
+        fh.write(b"not a stablehlo module")
+    with open(os.path.join(str(tmp_path), f"{digest}-b32.json"), "w") as fh:
+        fh.write("{")
+    srv2 = lgb.serve(boosters[0], max_batch_rows=64,
+                     aot_dir=str(tmp_path))
+    try:
+        rng = np.random.RandomState(5)
+        for rows in (16, 32, 8):
+            X = _f32_data(rng, rows)
+            out = srv2.predict(X, timeout=60)
+            assert np.array_equal(out,
+                                  boosters[0].predict(X, raw_score=True))
+        c = srv2.metrics_dict()["counters"]
+        # corrupted buckets compiled fresh, intact ones restored
+        assert c.get("compile_events", 0) >= 2
+        assert c.get("aot_program_loads", 0) >= 1
+    finally:
+        srv2.close()
+
+
+def test_aot_version_and_platform_gate(tmp_path, boosters):
+    srv = boosters[0].serve(max_batch_rows=64)
+    digest = srv.models.active.digest
+    srv.export_aot(path=str(tmp_path))
+    srv.close()
+    store = AOTStore(str(tmp_path))
+    meta_path = os.path.join(str(tmp_path), f"{digest}-b16.json")
+    meta = json.load(open(meta_path))
+    meta["platforms"] = ["tpu_v9"]
+    json.dump(meta, open(meta_path, "w"))
+    assert store.load_leaves(digest, 16) is None
+    meta["platforms"] = ["cpu"]
+    meta["version"] = 999
+    json.dump(meta, open(meta_path, "w"))
+    assert store.load_leaves(digest, 16) is None
+    assert store.load_leaves(digest, 8) is not None
+
+
+# ------------------------------------------------------- low precision
+
+
+def test_quantize_forest_grids(boosters):
+    b = boosters[0]
+    n_iter = len(b.models) // b.num_tree_per_iteration
+    forest = b._forest(0, n_iter)
+    qf = quantize_forest(forest, "bf16")
+    import ml_dtypes
+    finite = np.isfinite(forest.threshold) & ~forest.is_cat
+    # bf16 grid: a second rounding is the identity
+    assert np.array_equal(
+        qf.threshold[finite],
+        qf.threshold[finite].astype(ml_dtypes.bfloat16).astype(np.float64))
+    # +inf padding and leaf grid
+    assert np.array_equal(qf.threshold[~finite], forest.threshold[~finite])
+    assert np.array_equal(
+        qf.leaf_value, qf.leaf_value.astype(ml_dtypes.bfloat16)
+        .astype(np.float64))
+    q8 = quantize_forest(forest, "int8")
+    # per-tree int8: at most 255 distinct levels per tree
+    for t in range(q8.leaf_value.shape[0]):
+        assert len(np.unique(q8.leaf_value[t])) <= 255
+    assert q8.threshold_q.dtype == np.int8
+    # the carried codes reproduce the grid exactly: q * scale == threshold
+    deq = (q8.threshold_q.astype(np.float32)
+           * q8.threshold_scale[:, None]).astype(np.float64)
+    assert np.array_equal(deq[~q8.threshold_skip],
+                          q8.threshold[~q8.threshold_skip])
+    with pytest.raises(ValueError):
+        quantize_forest(forest, "fp4")
+
+
+def test_int8_rows_skip_mask():
+    a = np.array([[1.0, -2.0, np.inf], [0.0, 0.0, 0.0]])
+    q, scale, deq = int8_rows(a)
+    assert q[0, 2] == 0 and deq[0, 2] == np.inf
+    assert np.all(q[1] == 0) and np.all(deq[1] == 0.0)
+    assert abs(deq[0, 1] - (-2.0)) <= 2.0 / 127
+
+
+def test_lowprec_serves_quantized_forest_bitwise(boosters):
+    """The opt-in path serves EXACTLY the quantized twin: device output
+    bit-equal to the quantized forest's host predict_raw, and the
+    measured delta within the declared budget."""
+    b = boosters[0]
+    fleet = Fleet(max_batch_rows=128)
+    try:
+        fleet.add_model("full", b)
+        for prec in ("bf16", "int8"):
+            e = fleet.add_model(prec, b, precision=prec,
+                                accuracy_budget=1.0)
+            delta = e.server.metrics.gauge("lowprec_accuracy_delta").value
+            assert 0 < delta <= 1.0
+            rng = np.random.RandomState(11)
+            X = _f32_data(rng, 50)
+            out = fleet.predict(prec, X, timeout=60)
+            qf = e.model.forest
+            assert np.array_equal(out, qf.predict_raw(X)[0]), prec
+            # and the full-precision member still bit-matches the booster
+            assert np.array_equal(fleet.predict("full", X, timeout=60),
+                                  b.predict(X, raw_score=True))
+            # served drift stays within the probe-declared order
+            drift = np.max(np.abs(out - b.predict(X, raw_score=True)))
+            assert drift <= 1.0
+    finally:
+        fleet.close()
+
+
+def test_lowprec_budget_quarantines_add_and_swap(boosters):
+    fleet = Fleet(max_batch_rows=128)
+    try:
+        fleet.add_model("m", boosters[0])
+        with pytest.raises(LowPrecisionQuarantined):
+            fleet.add_model("tight", boosters[0], precision="int8",
+                            accuracy_budget=0.0)
+        assert fleet.models() == ["m"]       # nothing half-registered
+        # swap path: a registered lowprec member holds ITS budget across
+        # swaps — a candidate over it is quarantined, old model serves on
+        e = fleet.add_model("lp", boosters[0], precision="bf16",
+                            accuracy_budget=1.0)
+        old_digest = e.model.digest
+        e.server.models.accuracy_budget = 1e-12
+        with pytest.raises(LowPrecisionQuarantined):
+            fleet.swap_model("lp", boosters[1])
+        assert e.model.digest == old_digest
+        c = e.server.metrics_dict()["counters"]
+        assert c.get("lowprec_quarantines", 0) >= 1
+        assert c.get("swap_quarantines", 0) >= 1
+        X = _f32_data(np.random.RandomState(2), 9)
+        assert fleet.predict("lp", X, timeout=60) is not None
+    finally:
+        fleet.close()
+
+
+def test_lowprec_caller_probe_batch(boosters):
+    """A caller-supplied probe batch drives the measurement (real data
+    routes more realistically than noise)."""
+    b = boosters[0]
+    rng = np.random.RandomState(13)
+    probe = _f32_data(rng, 64)
+    n_iter = len(b.models) // b.num_tree_per_iteration
+    forest = b._forest(0, n_iter)
+    expected = measure_accuracy_delta(forest,
+                                      quantize_forest(forest, "bf16"), probe)
+    srv = lgb.serve(b, max_batch_rows=64, precision="bf16",
+                    accuracy_budget=1.0, probe_X=probe)
+    try:
+        got = srv.metrics.gauge("lowprec_accuracy_delta").value
+        assert got == expected
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------- metrics
+
+
+def test_fleet_prometheus_labels(boosters):
+    fleet = _fleet3(boosters)
+    try:
+        fleet.predict("m0", np.zeros((3, F)), timeout=60)
+        text = fleet.prometheus_text()
+        assert 'lgbt_fleet_fleet_requests_total{model="m0"} 1' in text
+        assert 'lgbt_fleet_model_weight{model="m0"} 3.0' in text
+        assert 'lgbt_fleet_model_resident{model="m1"} 1' in text
+        d0 = fleet.entry("m0").model.digest
+        assert f'lgbt_fleet_model_digest_info{{model="m0",value="{d0}"}} 1' \
+            in text
+        # labelled histogram series merge per-sample le labels
+        assert 'lgbt_fleet_request_latency_ms_bucket{le="+Inf",model="m0"}' \
+            in text
+        # every sample line still ends in a parseable number
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                float(line.rsplit(" ", 1)[1])
+        # to_dict: labelled series are ADDITIVE suffixed keys
+        d = fleet.metrics_dict()
+        assert d["counters"]['fleet_requests_total{model="m0"}'] == 1
+        assert "servers" in d and set(d["servers"]) == {"m0", "m1", "m2"}
+        # each member server's own layout is unchanged
+        assert "requests_total" in d["servers"]["m0"]["counters"]
+    finally:
+        fleet.close()
+
+
+def test_fleet_joins_process_registry(boosters):
+    from lightgbm_tpu.obs.metrics import global_registry
+    fleet = _fleet3(boosters)
+    try:
+        comp = global_registry.to_dict().get("components", {})
+        assert any(k.startswith("fleet") for k in comp)
+    finally:
+        fleet.close()
+    comp = global_registry.to_dict().get("components", {})
+    assert not any(k.startswith("fleet") for k in comp)
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+def test_remove_and_swap_replan(boosters):
+    fleet = _fleet3(boosters)
+    try:
+        fleet.remove_model("m2")
+        assert fleet.models() == ["m0", "m1"]
+        with pytest.raises(ModelNotFound):
+            fleet.predict("m2", np.zeros((1, F)))
+        fleet.swap_model("m1", boosters[2])     # class-count change
+        X = _f32_data(np.random.RandomState(3), 12)
+        assert np.array_equal(fleet.predict("m1", X, timeout=60),
+                              boosters[2].predict(X, raw_score=True))
+        assert len(fleet.plan.models) == 2
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_stress_mixed_traffic_with_churn(boosters):
+    """Concurrent weighted traffic against 3 models while residency
+    churns: honest completed counts, zero mismatches, zero errors."""
+    from lightgbm_tpu.serving.loadgen import fire_fleet_requests
+    fleet = _fleet3(boosters)
+    verify = {}
+    for name, b in zip(("m0", "m1", "m2"), boosters):
+        n_iter = len(b.models) // b.num_tree_per_iteration
+        verify[name] = b._forest(0, n_iter)
+    tiny = _hot_only_budget(fleet)
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            fleet.config.hbm_budget_bytes = tiny if i % 2 == 0 else None
+            fleet.replan()
+            i += 1
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        storm = fire_fleet_requests(
+            fleet, {"m0": 3.0, "m1": 1.0, "m2": 1.0}, n_requests=400,
+            n_threads=8, max_request_rows=120, verify=verify, timeout=120)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        fleet.close()
+    assert storm["errors"] == []
+    assert storm["mismatches"] == 0
+    assert storm["requests"] + storm["shed"] + storm["expired"] \
+        == storm["requests_planned"]

@@ -41,7 +41,23 @@ def main():
                     choices=["device", "host"])
     ap.add_argument("--model", default=None,
                     help="model file to serve (skips training + verify)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="run the N-model fleet smoke instead "
+                         "(tools/fleet_smoke.py pass-through); 0 = this "
+                         "single-model smoke")
     args = ap.parse_args()
+
+    if args.fleet:
+        import json as _json
+
+        from fleet_smoke import run_smoke
+        summary = run_smoke(n_models=args.fleet, requests=args.requests,
+                            threads=args.threads, features=args.features,
+                            max_request_rows=min(args.max_request_rows,
+                                                 args.max_batch_rows),
+                            max_batch_rows=args.max_batch_rows)
+        print(_json.dumps(summary, indent=1, sort_keys=True))
+        return 1 if summary["failed"] else 0
 
     import lightgbm_tpu as lgb
 
