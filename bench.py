@@ -71,6 +71,13 @@ Memory/caching: LGBM_TPU_TILE_ROWS / LGBM_TPU_HBM_BYTES steer the HBM
 budget planner (ops/planner.py; the >=10M-row stage is gated on its
 feasibility verdict and degrades to smaller row tiles instead of
 crashing — the decision is journaled as the "hbm_plan" stage);
+BENCH_SKIP_COLLECTIVE_PROBE=1 skips the per-tier collective micro-bench
+(tools/collective_probe.py: flat vs hierarchical vs voting reduction
+latency + the ops/planner.plan_collectives per-tier byte accounting over
+a simulated 2-slice hybrid ("dcn","ici") mesh — the journaled acceptance
+signal is voting's DCN bytes strictly below data-parallel's at equal
+trees; LGBM_TPU_NUM_SLICES / LGBM_TPU_HIER_REDUCE / LGBM_TPU_ICI_GBPS /
+LGBM_TPU_DCN_GBPS steer the pod-scale election itself);
 out-of-core streaming (lightgbm_tpu/data/): BENCH_SKIP_STREAM_PROBE=1
 skips the block-pump micro-bench (tools/stream_probe.py),
 BENCH_SKIP_STREAM=1 skips the graduated 100M-row streamed stage
@@ -1143,6 +1150,21 @@ def tpu_worker():
             return stream_run(rows=min(N, 2_000_000), features=F)
         run_stage("stream_probe", _stream_probe)
 
+    # per-tier collective micro-bench (tools/collective_probe.py): flat
+    # vs hierarchical vs voting reduction latency over a simulated
+    # 2-slice hybrid ("dcn","ici") mesh + the planner's per-tier byte
+    # accounting (the acceptance signal: voting's DCN bytes strictly
+    # below data-parallel's at equal trees) — cheap, banked early;
+    # errors are never journaled so a failed probe retries
+    if os.environ.get("BENCH_SKIP_COLLECTIVE_PROBE") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _coll_probe():
+            from collective_probe import run_probe as coll_run
+            return coll_run(rows=min(N, 1_000_000), features=F,
+                            max_bin=MAX_BIN, leaves=LEAVES, trees=TREES)
+        run_stage("collective_probe", _coll_probe)
+
     # whole-plane observability smoke (tools/obs_dump.py): a tiny
     # instrumented train+serve cycle dumping trace/metrics/prometheus
     # artifacts — cheap, banked before the long stages; errors are never
@@ -1360,6 +1382,10 @@ def _annotate(line, tpu_stages, cpu_result):
     if hp:
         line["hist_probe"] = {k: v for k, v in hp.items()
                               if k not in ("stage", "elapsed")}
+    cp = collect_ok(tpu_stages, "collective_probe")
+    if cp:
+        line["collective_probe"] = {k: v for k, v in cp.items()
+                                    if k not in ("stage", "elapsed")}
     planl = collect_ok(tpu_stages, "hbm_plan")
     if planl and "hbm_plan" not in line:
         line["hbm_plan"] = {k: v for k, v in planl.items()

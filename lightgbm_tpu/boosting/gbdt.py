@@ -305,18 +305,45 @@ class GBDT:
         if tl not in ("serial", "data", "feature", "voting"):
             raise ValueError(f"unknown tree_learner {tl!r}")
         self.tree_learner_type = tl
+        self._num_slices = 1
         if tl == "serial" or jax.device_count() <= 1:
             return
         from ..parallel.learners import (DATA_AXIS, FEATURE_AXIS, make_mesh,
                                          pad_rows_to)
         ndev = jax.device_count()
-        if self.config.num_machines > 1:
+        if tl == "feature" and self.config.num_machines > 1:
+            # historical num_machines device cap; the data/voting branch
+            # gets its shard count from mesh_plan's verdict instead
             ndev = min(ndev, self.config.num_machines)
         need_group = (self.objective is not None and
                       getattr(self.objective, "need_group", False))
         if tl in ("data", "voting"):
-            self._mesh = make_mesh(ndev, (DATA_AXIS,))
-            self._data_axis = DATA_AXIS
+            # hybrid ICI x DCN mesh election (pod-scale plane): the
+            # reference's num_machines / local_listen_port keys round-trip
+            # through parallel/network.mesh_plan — real multi-host
+            # topology > simulated slices (LGBM_TPU_NUM_SLICES) >
+            # num_machines-as-slice-count > flat.  On a hybrid mesh rows
+            # shard over BOTH tiers in the same linear device order as
+            # the flat mesh, so electing it never changes shard contents.
+            from ..parallel.learners import make_hybrid_mesh
+            from ..parallel.network import mesh_plan
+            mp = mesh_plan(jax.device_count(),
+                           num_machines=self.config.num_machines or None,
+                           local_listen_port=self.config.local_listen_port)
+            if mp.hybrid:
+                self._mesh = make_hybrid_mesh(mp.total_shards,
+                                              num_slices=mp.num_slices)
+                from ..parallel.learners import HYBRID_AXES
+                self._data_axis = HYBRID_AXES
+                self._num_slices = mp.num_slices
+                ndev = mp.total_shards
+            else:
+                # the plan's flat verdict also carries the shard COUNT:
+                # the historical num_machines device cap, and the
+                # shrunk-world device bound of an elastic resume
+                ndev = mp.total_shards
+                self._mesh = make_mesh(ndev, (DATA_AXIS,))
+                self._data_axis = DATA_AXIS
             if need_group:
                 # ranking: whole queries per shard (query-aligned layout)
                 self._build_query_sharding(ndev)
@@ -489,7 +516,8 @@ class GBDT:
         nmach = 1
         vote_k = 0
         if self._mesh is not None and self._data_axis is not None:
-            nmach = int(self._mesh.shape[self._data_axis])
+            from ..parallel.collectives import axis_size
+            nmach = axis_size(self._mesh, self._data_axis)
             if self.tree_learner_type == "voting":
                 vote_k = self.config.top_k
         # feature_fraction_bynode -> exact per-node sample count
@@ -698,6 +726,25 @@ class GBDT:
             # the sharded array keeps its GLOBAL shape; each device's
             # kernels see only its feature slice
             shard_feats //= max(int(self._mesh.shape[self._feature_axis]), 1)
+        # pod-scale reduction schedule (hybrid ICI x DCN mesh,
+        # parallel/collectives.py): the per-tier link model elects flat vs
+        # hierarchical — and records voting's DCN payload shrink — at
+        # trace time; pinned mode pins one tier-ordered f32 association
+        # so flat == hierarchical extends to f32 model text
+        self.collective_plan = None
+        if nmach > 1 and self._data_axis is not None:
+            from ..ops.planner import plan_collectives
+            self.collective_plan = plan_collectives(
+                features=shard_feats, num_bins=self.num_bins,
+                rows_global=self._n_pad, quant=quant_on,
+                quant_bins=cc.num_grad_quant_bins,
+                num_slices=self._num_slices,
+                devices_per_slice=nmach // max(self._num_slices, 1),
+                voting_k=vote_k)
+            self.grower_cfg = self.grower_cfg._replace(
+                num_slices=self._num_slices,
+                hier_reduce=self.collective_plan.hierarchical,
+                pinned_reduce=self.collective_plan.pinned)
         if want_fused and self.grower_cfg.hist_method == "auto":
             # dry-run the fused VMEM election (plan_histograms emits no
             # trace event and mutates nothing) so a decline can fall
@@ -743,6 +790,19 @@ class GBDT:
                     rows_global=self._n_pad,
                     quant_bins=(cc.num_grad_quant_bins if quant_on
                                 else None)))
+        if self.collective_plan is not None:
+            # the two-hop ladder's per-tier payloads (docs/OBSERVABILITY
+            # .md): what one histogram sync moves over ICI and over DCN
+            # under the elected schedule — trace files show the matching
+            # per-tier collective.reduce spans
+            _obs_registry.gauge("train_ici_payload_bytes").set(
+                int(self.collective_plan.ici_bytes))
+            _obs_registry.gauge("train_dcn_payload_bytes").set(
+                int(self.collective_plan.dcn_bytes))
+            _obs_registry.gauge("train_num_slices").set(
+                int(self.collective_plan.num_slices))
+            _obs_registry.gauge("train_hier_reduce").set(
+                int(self.collective_plan.hierarchical))
         if not self.hist_plan.feasible:
             log_warning(
                 "HBM planner: predicted peak "
@@ -880,8 +940,9 @@ class GBDT:
                     qkey = jax.random.fold_in(
                         jax.random.fold_in(rng, 0x51475442), k)
                     if axis_name is not None:
+                        from ..parallel.collectives import axis_index_flat
                         qkey = jax.random.fold_in(
-                            qkey, jax.lax.axis_index(axis_name))
+                            qkey, axis_index_flat(axis_name))
                     quant_vals = quantize_gradients(
                         grad[k], hess[k], row_mask, quant_bins, qkey,
                         stochastic=stoch_round, axis_name=axis_name)
@@ -1658,6 +1719,14 @@ class GBDT:
             "boosting_type": self.boosting_type,
             "iter": self.iter,
             "num_init_iteration": self.num_init_iteration,
+            # the row layout this state was captured under: an ELASTIC
+            # resume restores into a DIFFERENT mesh (fewer shards after a
+            # slice loss — docs/RESILIENCE.md), and restore_state re-tiles
+            # every per-row array through the original layout
+            "n_pad": int(self._n_pad),
+            "num_data": int(self.num_data),
+            "row_perm": (np.asarray(self._row_perm)
+                         if self._row_perm is not None else None),
             "models": models,
             "train_score": np.asarray(jax.device_get(self.train_score)),
             "valid_scores": [np.asarray(jax.device_get(v))
@@ -1703,7 +1772,56 @@ class GBDT:
         self.num_init_iteration = int(st["num_init_iteration"])
         self._pending = []
         self._models = [_copy.deepcopy(m) for m in st["models"]]
-        ts = st["train_score"]
+        # elastic resume (docs/RESILIENCE.md): the bundle may have been
+        # captured under a DIFFERENT row layout (more shards before a
+        # slice loss -> larger n_pad / different query permutation).
+        # Re-tile every per-row array through the ORIGINAL row order into
+        # this booster's layout; padding rows carry zeros either way, so
+        # re-tiling is exact — the resumed sums start from the same f32
+        # values the old world held
+        if "row_perm" not in st:
+            # legacy bundle (pre pod-scale): the layout keys were never
+            # captured, and the pre-elastic contract was same-world
+            # restore — assign directly, NEVER guess a re-tile (treating
+            # "absent" as "unpermuted" would scramble a query-sharded
+            # ranking resume)
+            old_np, old_perm, same_layout = self._n_pad, None, True
+        else:
+            old_np = st.get("n_pad")
+            if old_np is None:
+                old_np = int(np.asarray(st["train_score"]).shape[-1])
+            old_perm = st.get("row_perm")
+            old_perm = np.asarray(old_perm) if old_perm is not None else None
+            same_layout = (int(old_np) == self._n_pad
+                           and (old_perm is None) == (self._row_perm is None)
+                           and (old_perm is None
+                                or np.array_equal(old_perm, self._row_perm)))
+
+        def retile(a):
+            """Old padded row layout -> this booster's, trailing axis."""
+            if a is None or same_layout:
+                return a
+            a = np.asarray(a)
+            n = self.num_data
+            if old_perm is not None:
+                valid = old_perm < n
+                unpad = np.zeros(a.shape[:-1] + (n,), a.dtype)
+                unpad[..., old_perm[valid]] = a[..., np.nonzero(valid)[0]]
+            else:
+                unpad = a[..., :n]
+            if self._row_perm is not None:
+                ext = np.concatenate(
+                    [unpad, np.zeros(a.shape[:-1] + (1,), a.dtype)],
+                    axis=-1)
+                return ext[..., self._row_perm]
+            pad = self._n_pad - n
+            if pad:
+                return np.concatenate(
+                    [unpad, np.zeros(a.shape[:-1] + (pad,), a.dtype)],
+                    axis=-1)
+            return unpad
+
+        ts = retile(st["train_score"])
         if self._mesh is not None and self._data_axis is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self.train_score = jax.device_put(
@@ -1718,20 +1836,22 @@ class GBDT:
         self._rng.set_state(st["bagging_rng"])
         self._goss_rng_key = jnp.asarray(st["goss_rng_key"])
         self._feature_rng.set_state(st["feature_rng"])
-        self._cur_mask = (jnp.asarray(st["cur_mask"])
+        self._cur_mask = (jnp.asarray(retile(st["cur_mask"]))
                           if st["cur_mask"] is not None else None)
         self._history_mode = st["history_mode"]
         self.history_scale = dict(st["history_scale"])
         self.tree_history = [jax.tree_util.tree_map(jnp.asarray, t)
                              for t in st["tree_history"]]
         used0, rows0 = st["cegb_state"]
+        if np.asarray(rows0).shape != (1, 1):
+            rows0 = retile(rows0)
         rows0 = jnp.asarray(rows0)
         if rows0.shape != (1, 1) and self._mesh is not None \
                 and self._data_axis is not None:
             # lazy-mode row bitmap is row-sharded (mirrors __init__)
             from jax.sharding import NamedSharding, PartitionSpec as P
             rows0 = jax.device_put(
-                np.asarray(st["cegb_state"][1]),
+                np.asarray(rows0),
                 NamedSharding(self._mesh, P(None, self._data_axis)))
         self._cegb_state = (jnp.asarray(used0), rows0)
         qs = st.get("quant_scales")

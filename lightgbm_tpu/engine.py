@@ -155,6 +155,23 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
         from .utils.log import log_info
         log_info(f"resume: restored iteration {start_iter} from "
                  f"{ck.path or resume_from}")
+        # elastic resume (docs/RESILIENCE.md): the bundle records the
+        # mesh it trained under; a DIFFERENT mesh here means a shrunk
+        # (or regrown) world — restore_state already re-tiled the rows,
+        # and the fresh planner events carry the re-planned per-shard
+        # verdicts, so just make the transition visible
+        old_cp = (ck.manifest or {}).get("collective_plan")
+        new_cp = getattr(booster.boosting, "collective_plan", None)
+        old_shape = (old_cp or {}).get("mesh_shape")
+        new_shape = (list(new_cp.summary()["mesh_shape"])
+                     if new_cp is not None else None)
+        # only a bundle that RECORDED its mesh can evidence a transition
+        # (a legacy manifest without collective_plan is not one)
+        if old_cp is not None and old_shape != new_shape:
+            log_info(
+                f"elastic resume: bundle trained on mesh {old_shape}, "
+                f"this world is {new_shape} — rows re-tiled, planner "
+                "re-planned for the new per-shard shapes")
 
     ckpt_mgr = None
     if snapshot_freq > 0:

@@ -251,10 +251,30 @@ class GrowerConfig(NamedTuple):
                                    # pick.  Set by ops/planner.apply_plan
     fused_block_rows: int = 0      # hist_method="fused": rows per
                                    # double-buffered tile DMA; 0 = auto
+    hier_reduce: bool = False      # hybrid ("dcn","ici") mesh: reduce the
+                                   # fast ICI tier before the slow DCN
+                                   # tier (parallel/collectives.py); flat
+                                   # when off — byte-identical for
+                                   # integer payloads either way
+    pinned_reduce: bool = False    # deterministic tier-ordered f32 sums
+                                   # (all_gather + fixed-order reduce) so
+                                   # flat == hierarchical holds for f32
+                                   # model text too
+    num_slices: int = 1            # dcn-axis size (static): hierarchical
+                                   # voting elects top-k per SLICE, and
+                                   # per-voter constraints scale by this
+                                   # instead of num_machines
 
 
-def _psum(x, axis_name):
-    return lax.psum(x, axis_name) if axis_name is not None else x
+def _psum(x, axis_name, hierarchical: bool = False, pinned: bool = False):
+    """Data-axis sum under the active reduction policy.  ``axis_name``
+    may be one mesh axis or the hybrid outermost-first tuple; the default
+    single-axis flat path is exactly ``lax.psum`` (unchanged HLO)."""
+    if axis_name is None:
+        return x
+    from .parallel.collectives import psum_tiered
+    return psum_tiered(x, axis_name, hierarchical=hierarchical,
+                       pinned=pinned)
 
 
 def row_goes_left(col: jax.Array, node_thr: jax.Array, node_dl: jax.Array,
@@ -362,6 +382,15 @@ def _grow_tree_traced(
     B = cfg.num_bins
     Bg = meta.max_group_bin if meta.has_bundles else B
     hp = cfg.hp
+
+    # reduction policy over the (possibly tiered) data axis — every
+    # scalar/histogram sum below routes through one closure so the
+    # flat/hierarchical/pinned decision is made exactly once
+    hier_rd = cfg.hier_reduce
+    pinned_rd = cfg.pinned_reduce
+
+    def psum_(x):
+        return _psum(x, axis_name, hier_rd, pinned_rd)
 
     # full (unsliced) constraints for split-time bound propagation, which
     # looks up by GLOBAL feature index even when features are sharded
@@ -592,7 +621,7 @@ def _grow_tree_traced(
         rows_l = _shard_view(used_rows)
         cnt = (~rows_l).astype(jnp.float32) @ in_leaf.astype(jnp.float32)
         return (cfg.cegb_tradeoff * _shard_view(cegb_lazy_penalty)
-                * _psum(cnt, axis_name))
+                * psum_(cnt))
 
     def cegb_global_best_gain(fb, leaf_cnt_arr, used, num_leaves):
         """Scalar max penalized gain over active leaves, merged across
@@ -659,8 +688,32 @@ def _grow_tree_traced(
         local leaf count (:153-182), CopyLocalHistogram + ReduceScatter of
         elected features only (:186-245).  Here the reduce-scatter+ownership
         dance collapses to one psum of a [top_k, B, 3] gather.
+
+        Hierarchical mode (``cfg.hier_reduce`` on a ("dcn","ici") mesh):
+        the FULL per-feature histogram first psums over the fast ICI tier
+        only, each SLICE votes from its slice-level gains, and only the
+        elected features' histograms cross the slow DCN tier — PV-Tree's
+        bandwidth saver applied to exactly the expensive hop (F*B*ch
+        bytes over ICI, k*B*ch over DCN; ops/planner.py plan_collectives
+        is the accounting twin).
         """
-        ndev = max(cfg.num_machines, 1)
+        from .parallel.collectives import all_gather_tiered, axis_names
+        names_v = axis_names(axis_name)
+        hier_v = hier_rd and len(names_v) > 1
+        # the axis the vote gathers over / elected histograms psum over:
+        # the slow outermost tier under hierarchy, the whole ladder flat
+        vote_axis = names_v[0] if hier_v else axis_name
+        inner_axes = names_v[1:]
+        # one "voter" = one slice under hierarchy, one device flat; the
+        # reference's per-machine constraint scaling follows the voter
+        ndev = max(cfg.num_slices, 1) if hier_v else max(cfg.num_machines, 1)
+        if hier_v:
+            # fast-tier reduction of the FULL histogram: after this the
+            # "local" histogram is slice-level and replicated over ici
+            ghist_local = (
+                psum_quant_hist(ghist_local, inner_axes, rows_global,
+                                cfg.quant_bins) if quant
+                else _psum(ghist_local, inner_axes, pinned=pinned_rd))
         k = min(cfg.voting_top_k, F)
         hp_local = hp._replace(
             min_data_in_leaf=max(1, hp.min_data_in_leaf // ndev),
@@ -697,8 +750,8 @@ def _grow_tree_traced(
                           pf.gain * (pf.left_count + rc_loc) / mean_cnt,
                           -jnp.inf)
         top_g, top_i = lax.top_k(wgain, k)
-        all_i = lax.all_gather(top_i, axis_name).reshape(-1)
-        all_g = lax.all_gather(top_g, axis_name).reshape(-1)
+        all_i = all_gather_tiered(top_i, vote_axis).reshape(-1)
+        all_g = all_gather_tiered(top_g, vote_axis).reshape(-1)
         votes = jnp.full(F, -jnp.inf, jnp.float32).at[all_i].max(
             jnp.where(jnp.isfinite(all_g), all_g, -jnp.inf))
         _, elected = lax.top_k(votes, k)
@@ -709,12 +762,13 @@ def _grow_tree_traced(
             # voting's only O(bins) collective too
             sub_i = psum_quant_hist(
                 expand_hist_int(ghist_local, loc_i)[:, elected],
-                axis_name, rows_global, cfg.quant_bins)
+                vote_axis, rows_global, cfg.quant_bins)
             sub = split_conv(sub_i, cnt)
         else:
-            sub = lax.psum(hist_loc[:, elected], axis_name)  # [3, k, B]:
-            # the only O(bins) collective — k*B*3 words vs data-parallel's
-            # F*B*3
+            sub = _psum(hist_loc[:, elected], vote_axis,
+                        pinned=pinned_rd)             # [3, k, B]: the only
+            # O(bins) collective on this tier — k*B*3 words vs
+            # data-parallel's F*B*3
         r = best_split_for_leaf(
             sub, sg, sh, cnt, num_bin[elected], missing_type[elected],
             default_bin[elected], is_cat[elected], hp,
@@ -787,23 +841,24 @@ def _grow_tree_traced(
         hist_sync = (lambda h: h)
     elif quant:
         hist_sync = (lambda h: psum_quant_hist(h, axis_name, rows_global,
-                                               cfg.quant_bins))
+                                               cfg.quant_bins,
+                                               hierarchical=hier_rd))
     else:
-        hist_sync = (lambda h: _psum(h, axis_name))
+        hist_sync = psum_
     root_hist = hist_sync(hist_pass(row_mask))
     if quant:
         member = row_mask > 0
-        root_sg = _psum(jnp.sum(jnp.where(member, q_grad, 0).astype(
-            jnp.int32)), axis_name).astype(jnp.float32) * g_scale
-        root_sh = _psum(jnp.sum(jnp.where(member, q_hess, 0).astype(
-            jnp.int32)), axis_name).astype(jnp.float32) * h_scale
+        root_sg = psum_(jnp.sum(jnp.where(member, q_grad, 0).astype(
+            jnp.int32))).astype(jnp.float32) * g_scale
+        root_sh = psum_(jnp.sum(jnp.where(member, q_hess, 0).astype(
+            jnp.int32))).astype(jnp.float32) * h_scale
         # counts are plain member-row counts in quantized mode (the
         # reference's bagging semantics; weights live in the int values)
-        root_cnt = _psum(jnp.sum(member.astype(jnp.float32)), axis_name)
+        root_cnt = psum_(jnp.sum(member.astype(jnp.float32)))
     else:
-        root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
-        root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
-        root_cnt = _psum(jnp.sum(row_mask), axis_name)
+        root_sg = psum_(jnp.sum(grad * row_mask))
+        root_sh = psum_(jnp.sum(hess * row_mask))
+        root_cnt = psum_(jnp.sum(row_mask))
 
     tree = TreeArrays.empty(L)
     hist_cache = jnp.zeros((L, 2, G, Bg), jnp.int32).at[0].set(root_hist) \
@@ -941,8 +996,9 @@ def _grow_tree_traced(
             if voting:
                 # local -> global hist (integer psum in quantized mode)
                 h_leaf = (psum_quant_hist(h_leaf, axis_name, rows_global,
-                                          cfg.quant_bins) if quant
-                          else _psum(h_leaf, axis_name))
+                                          cfg.quant_bins,
+                                          hierarchical=hier_rd) if quant
+                          else psum_(h_leaf))
             if feature_axis_name is not None:
                 lf_raw = feat - f_offset
                 owns = (lf_raw >= 0) & (lf_raw < F)
@@ -1278,8 +1334,8 @@ def _grow_tree_traced(
         from .ops.renew import quant_train_renew_leaf
         sg_t, sh_t = quant_train_renew_leaf(out.leaf_id, grad, hess,
                                             row_mask, L)
-        sg_t = _psum(sg_t, axis_name)
-        sh_t = _psum(sh_t, axis_name)
+        sg_t = psum_(sg_t)
+        sh_t = psum_(sh_t)
         lv = leaf_output(sg_t, sh_t, hp.lambda_l1, hp.lambda_l2,
                          hp.max_delta_step)
         leaf_sh_out = sh_t

@@ -269,23 +269,30 @@ def _grow_tree_rounds_traced(
             is_categorical=sr.is_categorical, cat_bitset=sr.cat_bitset)
 
     # ---- root ----------------------------------------------------------
+    # reduction policy over the (possibly tiered, see parallel/
+    # collectives.py) data axis — one closure per grower, like grower.py
+    hier_rd, pinned_rd = cfg.hier_reduce, cfg.pinned_reduce
+
+    def psum_(x):
+        return _psum(x, axis_name, hier_rd, pinned_rd)
+
     if quant:
         member = row_mask > 0
         root_hist = psum_quant_hist(
             build_histogram_int(binned_t, q_grad, q_hess, member, Bg,
                                 method=cfg.hist_method, levels=q_levels,
                                 tile_rows=tile),
-            axis_name, rows_global, cfg.quant_bins)
-        root_sg = _psum(jnp.sum(jnp.where(member, q_grad, 0).astype(
-            jnp.int32)), axis_name).astype(jnp.float32) * g_scale
-        root_sh = _psum(jnp.sum(jnp.where(member, q_hess, 0).astype(
-            jnp.int32)), axis_name).astype(jnp.float32) * h_scale
-        root_cnt = _psum(jnp.sum(member.astype(jnp.float32)), axis_name)
+            axis_name, rows_global, cfg.quant_bins, hierarchical=hier_rd)
+        root_sg = psum_(jnp.sum(jnp.where(member, q_grad, 0).astype(
+            jnp.int32))).astype(jnp.float32) * g_scale
+        root_sh = psum_(jnp.sum(jnp.where(member, q_hess, 0).astype(
+            jnp.int32))).astype(jnp.float32) * h_scale
+        root_cnt = psum_(jnp.sum(member.astype(jnp.float32)))
     else:
-        root_hist = _psum(hist_fn(binned_t, grad, hess, row_mask), axis_name)
-        root_sg = _psum(jnp.sum(grad * row_mask), axis_name)
-        root_sh = _psum(jnp.sum(hess * row_mask), axis_name)
-        root_cnt = _psum(jnp.sum(row_mask), axis_name)
+        root_hist = psum_(hist_fn(binned_t, grad, hess, row_mask))
+        root_sg = psum_(jnp.sum(grad * row_mask))
+        root_sh = psum_(jnp.sum(hess * row_mask))
+        root_cnt = psum_(jnp.sum(row_mask))
 
     tree = TreeArrays.empty(L)
     hist_cache = jnp.zeros((L, 2, G, Bg), jnp.int32).at[0].set(root_hist) \
@@ -553,12 +560,13 @@ def _grow_tree_rounds_traced(
                 binned_t, q_grad, q_hess, row_mask, slot, KCAP, Bg, caps,
                 num_live=k, packed=packed, levels=q_levels,
                 tile_rows=tile),
-                axis_name, rows_global, cfg.quant_bins)
+                axis_name, rows_global, cfg.quant_bins,
+                hierarchical=hier_rd)
         else:
             seg = _psum(compacted_segment_histogram(
                 binned_t, grad, hess, row_mask, slot, KCAP, Bg, caps,
                 f32_vals=seg_f32, num_live=k, packed=packed,
-                tile_rows=tile), axis_name)
+                tile_rows=tile), axis_name, hier_rd, pinned_rd)
 
         # -- candidate children's best splits, BEFORE committing anything:
         # per-leaf candidates are independent, so lane i's results are
@@ -659,8 +667,8 @@ def _grow_tree_rounds_traced(
         from .ops.renew import quant_train_renew_leaf
         sg_t, sh_t = quant_train_renew_leaf(out.leaf_id, grad, hess,
                                             row_mask, L)
-        sg_t = _psum(sg_t, axis_name)
-        sh_t = _psum(sh_t, axis_name)
+        sg_t = _psum(sg_t, axis_name, hier_rd, pinned_rd)
+        sh_t = _psum(sh_t, axis_name, hier_rd, pinned_rd)
         lv = leaf_output(sg_t, sh_t, hp.lambda_l1, hp.lambda_l2,
                          hp.max_delta_step)
         leaf_sh_out = sh_t

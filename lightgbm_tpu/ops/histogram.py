@@ -1223,8 +1223,11 @@ def quantize_gradients(grad: jax.Array, hess: jax.Array, weights: jax.Array,
     gmax = jnp.max(jnp.abs(gw))
     hmax = jnp.max(jnp.abs(hw))
     if axis_name is not None:
-        gmax = lax.pmax(gmax, axis_name)
-        hmax = lax.pmax(hmax, axis_name)
+        # pmax is exact under any association, so one fused collective
+        # serves flat AND hierarchical meshes (tuple axis names OK)
+        from ..parallel.collectives import pmax_tiered
+        gmax = pmax_tiered(gmax, axis_name)
+        hmax = pmax_tiered(hmax, axis_name)
     g_scale = (jnp.maximum(gmax, 1e-30) / qg).astype(jnp.float32)
     h_scale = (jnp.maximum(hmax, 1e-30) / qh).astype(jnp.float32)
     if stochastic:
@@ -1249,18 +1252,23 @@ def quant_psum_narrow(rows_global: int, num_bins: int) -> bool:
     return rows_global * qh < (1 << 15)
 
 
-def psum_quant_hist(hist: jax.Array, axis_name: Optional[str],
-                    rows_global: int, num_bins: int) -> jax.Array:
-    """psum an integer histogram across the data axis, narrowed to int16
-    when ``quant_psum_narrow`` proves it safe.  The ICI payload is
-    2 channels x {2,4} bytes vs the f32 path's 3 x 4
+def psum_quant_hist(hist: jax.Array, axis_name,
+                    rows_global: int, num_bins: int,
+                    hierarchical: bool = False) -> jax.Array:
+    """psum an integer histogram across the data axis (a single mesh axis
+    or the hybrid ``("dcn", "ici")`` tuple), narrowed to int16 when
+    ``quant_psum_narrow`` proves it safe.  ``hierarchical`` reduces the
+    fast tier first (parallel/collectives.py); the narrowing bound covers
+    every partial sum, so each stage rides the same narrowed payload.
+    The ICI payload is 2 channels x {2,4} bytes vs the f32 path's 3 x 4
     (``hist_payload_bytes`` is the accounting twin used by
     tools/hist_probe.py and the bench stage)."""
     if axis_name is None:
         return hist
-    if quant_psum_narrow(rows_global, num_bins):
-        return lax.psum(hist.astype(jnp.int16), axis_name).astype(hist.dtype)
-    return lax.psum(hist, axis_name)
+    from ..parallel.collectives import psum_int_tiered
+    narrow = jnp.int16 if quant_psum_narrow(rows_global, num_bins) else None
+    return psum_int_tiered(hist, axis_name, hierarchical=hierarchical,
+                           narrow=narrow)
 
 
 def hist_payload_bytes(num_features: int, num_bins: int,

@@ -503,6 +503,184 @@ def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None,
 
 
 # ======================================================================
+# Per-tier collective link model: the hybrid ("dcn", "ici") mesh's
+# reduction-schedule election (parallel/collectives.py).
+#
+# A TPU pod moves histogram payloads over TWO transports with a ~10-50x
+# bandwidth gap: the intra-slice ICI torus and the cross-host DCN
+# (PAPER.md §2.6).  ``plan_collectives`` models one histogram reduction
+# under each schedule — flat (one psum over every data axis; the full
+# payload effectively crosses the slow tier once per PARTICIPATING
+# DEVICE, un-preaggregated), hierarchical (psum over ICI first, so DCN
+# runs between num_slices pre-reduced participants), and voting
+# (PV-Tree: only the top-k elected features' columns ever cross DCN) —
+# and elects the cheapest.  Deliberately simple, like every model in
+# this module: the right ORDER for the schedule verdict, not an XLA
+# collective simulator.
+# ======================================================================
+
+# per-tier link bandwidths the election runs against (GB/s); order-of-
+# magnitude figures for a v5e-class slice (ICI torus per-chip) vs a
+# 50 Gbps-class host NIC.  LGBM_TPU_ICI_GBPS / LGBM_TPU_DCN_GBPS override
+# (tests plan against fakes; operators against their fabric)
+DEFAULT_ICI_GBPS = 100.0
+DEFAULT_DCN_GBPS = 6.25
+
+
+def _env_gbps(name: str, default: float) -> float:
+    v = os.environ.get(name, "").strip()
+    if v:
+        try:
+            return max(float(v), 1e-6)
+        except ValueError:
+            pass
+    return default
+
+
+def _hier_override():
+    """LGBM_TPU_HIER_REDUCE: None = planner-elected, True/False forced."""
+    v = os.environ.get("LGBM_TPU_HIER_REDUCE", "").strip().lower()
+    if v in ("1", "on", "true", "yes", "force"):
+        return True
+    if v in ("0", "off", "false", "no"):
+        return False
+    return None
+
+
+def pinned_reduce_env() -> bool:
+    """LGBM_TPU_PINNED_REDUCE=1: deterministic tier-ordered f32 sums
+    (parallel/collectives.py pinned mode) — the determinism knob behind
+    the f32 flat==hierarchical model-text parity claim."""
+    return os.environ.get("LGBM_TPU_PINNED_REDUCE", "").strip().lower() \
+        in ("1", "on", "true", "yes")
+
+
+class CollectivePlan(NamedTuple):
+    """Reduction-schedule verdict for one histogram psum (see section
+    docstring).  Byte fields are PER REDUCTION: what one [ch, F, B]
+    histogram sync moves across each tier."""
+
+    num_slices: int             # DCN participants (1 = single tier)
+    devices_per_slice: int      # ICI participants per slice
+    total_shards: int
+    hierarchical: bool          # ICI-first tiered schedule elected
+    pinned: bool                # deterministic tier-ordered f32 sums
+    voting_k: int               # >0: only k elected features cross DCN
+    payload_bytes: int          # one full-histogram psum payload
+    ici_bytes: int              # bytes crossing the fast tier / device
+    dcn_bytes: int              # bytes crossing the slow tier / slice
+    flat_dcn_bytes: int         # what the FLAT schedule would move there
+    est_flat_us: float          # modeled reduction time per schedule
+    est_hier_us: float
+    ici_gbps: float
+    dcn_gbps: float
+    elected: str                # "single" | "flat" | "hierarchical"
+    #                             | "hierarchical+voting"
+
+    def summary(self) -> dict:
+        """JSON-friendly form for bench journals / checkpoint manifests
+        (the MULTICHIP journal's {mesh_shape, ici_bytes, dcn_bytes,
+        hierarchy_elected, voting_k} fields read from here)."""
+        return {
+            "mesh_shape": [self.num_slices, self.devices_per_slice],
+            "num_slices": self.num_slices,
+            "total_shards": self.total_shards,
+            "hierarchy_elected": self.hierarchical,
+            "pinned": self.pinned,
+            "voting_k": self.voting_k,
+            "payload_bytes": self.payload_bytes,
+            "ici_bytes": self.ici_bytes,
+            "dcn_bytes": self.dcn_bytes,
+            "flat_dcn_bytes": self.flat_dcn_bytes,
+            "est_flat_us": round(self.est_flat_us, 3),
+            "est_hier_us": round(self.est_hier_us, 3),
+            "ici_gbps": self.ici_gbps,
+            "dcn_gbps": self.dcn_gbps,
+            "elected": self.elected,
+        }
+
+
+def plan_collectives(
+    features: int,
+    num_bins: int,
+    rows_global: int,
+    quant: bool = False,
+    quant_bins: int = 4,
+    num_slices: int = 1,
+    devices_per_slice: int = 1,
+    voting_k: int = 0,
+    pinned: Optional[bool] = None,
+    ici_gbps: Optional[float] = None,     # tests: fake link model
+    dcn_gbps: Optional[float] = None,
+) -> CollectivePlan:
+    """Elect the reduction schedule for a (possibly hybrid) data mesh.
+
+    ``features == 0`` plans shape-free (a nominal unit payload): the
+    standalone learners elect a schedule before the traced shapes are
+    known, and only the byte ACCOUNTING needs the real feature count.
+    ``voting_k`` caps at ``features`` when both are known.  The verdict
+    is journaled as a ``planner.plan_collectives`` trace instant, the
+    twin of ``planner.plan`` (docs/OBSERVABILITY.md).
+    """
+    from .histogram import hist_payload_bytes
+
+    s = max(int(num_slices), 1)
+    d = max(int(devices_per_slice), 1)
+    F = max(int(features), 0)
+    k = min(int(voting_k), F) if (voting_k and F) else int(voting_k or 0)
+    ici_bw = ici_gbps if ici_gbps is not None else _env_gbps(
+        "LGBM_TPU_ICI_GBPS", DEFAULT_ICI_GBPS)
+    dcn_bw = dcn_gbps if dcn_gbps is not None else _env_gbps(
+        "LGBM_TPU_DCN_GBPS", DEFAULT_DCN_GBPS)
+    payload = hist_payload_bytes(
+        F or 1, max(int(num_bins), 2), rows_global=rows_global,
+        quant_bins=(quant_bins if quant else None))
+    # what crosses the slow tier per reduction: pre-aggregated full
+    # payload (hierarchical data-parallel), the elected columns only
+    # (voting), or the payload from every device of a slice (flat — no
+    # pre-aggregation before the slow hop)
+    # unknown feature count (shape-free planning) models NO voting
+    # saving — a conservative ratio of 1.0 keeps the election and the
+    # journaled DCN bytes honest until the real F is known
+    vote_ratio = (k / F) if (k and F) else 1.0
+    dcn_hier = int(payload * (vote_ratio if k else 1.0))
+    if k:
+        # the vote itself: [k] gains f32 + [k] indices i32, gathered
+        # across slices — tiny next to histogram columns, but accounted
+        dcn_hier += 8 * max(k, 1) * s
+    flat_dcn = payload * d if s > 1 else 0
+    us = 1e6 / 1e9   # bytes/GBps -> microseconds
+    est_flat = (flat_dcn / dcn_bw + payload / ici_bw) * us if s > 1 \
+        else (payload / ici_bw) * us
+    est_hier = (payload / ici_bw + dcn_hier / dcn_bw) * us
+    forced = _hier_override()
+    if s <= 1:
+        hier = False
+        elected = "single" if d <= 1 else "flat"
+    elif forced is not None:
+        hier = forced
+        elected = ("hierarchical+voting" if (hier and k) else
+                   "hierarchical" if hier else "flat")
+    else:
+        hier = est_hier <= est_flat
+        elected = ("hierarchical+voting" if (hier and k) else
+                   "hierarchical" if hier else "flat")
+    pin = pinned_reduce_env() if pinned is None else bool(pinned)
+    plan = CollectivePlan(
+        num_slices=s, devices_per_slice=d, total_shards=s * d,
+        hierarchical=hier, pinned=pin, voting_k=k,
+        payload_bytes=int(payload),
+        ici_bytes=int(payload) if s * d > 1 else 0,
+        dcn_bytes=int(dcn_hier if hier else flat_dcn) if s > 1 else 0,
+        flat_dcn_bytes=int(flat_dcn),
+        est_flat_us=float(est_flat), est_hier_us=float(est_hier),
+        ici_gbps=float(ici_bw), dcn_gbps=float(dcn_bw), elected=elected)
+    from ..obs.trace import instant
+    instant("planner.plan_collectives", features=F, **plan.summary())
+    return plan
+
+
+# ======================================================================
 # Two-level (device HBM + host RSS) budget: out-of-core streaming verdict
 #
 # PR 5's plan above made the *transients* O(tile); the binned matrix

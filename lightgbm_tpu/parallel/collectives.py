@@ -1,0 +1,172 @@
+"""Tiered (ICI x DCN) collective primitives for pod-scale meshes.
+
+The reference's ``Network`` layer (src/network/) moves histogram payloads
+over ONE transport; a TPU pod has TWO with a ~10-50x bandwidth gap
+between them: the intra-slice ICI torus and the cross-host DCN
+(PAPER.md §2.6).  Every reduction in the sharded growers routes through
+this module so one policy decides how a payload crosses the ladder:
+
+- **flat** — one ``lax.psum`` over every data axis at once (the XLA
+  runtime picks the schedule).  Correct everywhere; on a multi-slice
+  mesh the full payload effectively crosses the slow tier.
+- **hierarchical** — reduce the FAST tier first (psum over ``"ici"``),
+  then the slow one (psum over ``"dcn"``): the DCN hop runs between
+  num_slices participants instead of num_devices, and voting-parallel
+  can elect features per SLICE so only elected columns ever cross DCN
+  (grower.py ``leaf_best_voting``).
+- **pinned** — determinism mode for f32 parity testing: each tier is
+  reduced as ``all_gather`` + a fixed-order sum over the gathered axis,
+  innermost (fast) tier first.  Under ``pinned`` the flat and
+  hierarchical arms share one canonical tier-ordered association, so
+  their models are text-identical — that IS the pinned reduction order.
+  Integer (quantized) payloads never need pinning: integer addition is
+  associative, so flat == hierarchical is byte-identical for free.
+
+Axis names here may be a single mesh axis (``"data"``, the historical
+single-tier layout) or an outermost-first tuple (``("dcn", "ici")``,
+the hybrid mesh of ``parallel.learners.make_mesh``).  All helpers accept
+``None`` (unsharded) and degrade to identity.
+
+Trace: each tier reduction is wrapped in a ``collective.reduce`` span at
+trace time (one span per tier per call site, tagged with the tier name
+and payload bytes), so a trace file shows the two-hop ladder the same
+way ``trace.grow_tree`` shows program construction
+(docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..obs.trace import span as _span
+
+# hybrid mesh axis names (outermost-first: slices over DCN, devices of a
+# slice over ICI) — parallel.learners.make_mesh builds this layout
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+HYBRID_AXES: Tuple[str, str] = (DCN_AXIS, ICI_AXIS)
+
+AxisName = Union[None, str, Tuple[str, ...]]
+
+
+def axis_names(axis_name: AxisName) -> Tuple[str, ...]:
+    """Normalize ``None | str | tuple`` to an outermost-first tuple."""
+    if axis_name is None:
+        return ()
+    if isinstance(axis_name, str):
+        return (axis_name,)
+    return tuple(axis_name)
+
+
+def axis_size(mesh, axis_name: AxisName) -> int:
+    """Total shard count of ``axis_name`` over ``mesh`` (product over a
+    tuple of axes; 1 for None)."""
+    out = 1
+    for ax in axis_names(axis_name):
+        out *= int(mesh.shape[ax])
+    return out
+
+
+def axis_index_flat(axis_name: AxisName):
+    """Linearized rank along (possibly tuple) ``axis_name`` — the
+    outermost axis is most significant, matching the device order of the
+    hybrid mesh and of a flat ``all_gather`` over the same tuple."""
+    names = axis_names(axis_name)
+    if not names:
+        return jnp.int32(0)
+    idx = lax.axis_index(names[0])
+    for ax in names[1:]:
+        idx = idx * lax.psum(1, ax) + lax.axis_index(ax)
+    return idx
+
+
+def _nbytes(x) -> int:
+    try:
+        return int(x.size) * int(jnp.dtype(x.dtype).itemsize)
+    except Exception:  # noqa: BLE001 — tracing corner; accounting only
+        return 0
+
+
+def _pinned_tier_sum(x, ax: str):
+    """Deterministic one-tier reduction: gather the tier in rank order
+    and reduce over the gathered axis with one fixed-shape XLA reduce.
+    Both the flat and hierarchical pinned arms run THIS code per tier,
+    so their sums share one association and match bitwise."""
+    return lax.all_gather(x, ax).sum(axis=0)
+
+
+def psum_tiered(x, axis_name: AxisName, *, hierarchical: bool = False,
+                pinned: bool = False):
+    """Sum ``x`` across the data axes under the active reduction policy.
+
+    - single axis, default policy: exactly ``lax.psum(x, axis)`` — the
+      historical single-tier path, bit-for-bit unchanged;
+    - ``hierarchical``: innermost (fast) tier first, one psum per tier;
+    - ``pinned``: canonical tier-ordered deterministic sums (see module
+      docstring); implies the hierarchical order.
+    """
+    names = axis_names(axis_name)
+    if not names:
+        return x
+    if pinned:
+        for ax in reversed(names):
+            with _span("collective.reduce", tier=ax, bytes=_nbytes(x),
+                       pinned=True):
+                x = _pinned_tier_sum(x, ax)
+        return x
+    if hierarchical and len(names) > 1:
+        for ax in reversed(names):
+            with _span("collective.reduce", tier=ax, bytes=_nbytes(x)):
+                x = lax.psum(x, ax)
+        return x
+    with _span("collective.reduce", tier="+".join(names), bytes=_nbytes(x)):
+        return lax.psum(x, names if len(names) > 1 else names[0])
+
+
+def psum_int_tiered(x, axis_name: AxisName, *, hierarchical: bool = False,
+                    narrow: Optional[object] = None):
+    """Integer twin of ``psum_tiered`` (quantized histograms): no pinning
+    needed — integer addition is exact — but the int16 narrowing of
+    ``ops.histogram.quant_psum_narrow`` must apply per tier.  ``narrow``
+    is the dtype to move on the wire (e.g. ``jnp.int16``) or None.
+
+    The narrowing bound is computed against the GLOBAL row count, and
+    every partial (per-tier) sum of per-row contributions is bounded by
+    the same rows x max-level product, so a bound that admits the flat
+    psum admits each hierarchical stage too.
+    """
+    names = axis_names(axis_name)
+    if not names:
+        return x
+    dtype = x.dtype
+    wire = x.astype(narrow) if narrow is not None else x
+    if hierarchical and len(names) > 1:
+        for ax in reversed(names):
+            with _span("collective.reduce", tier=ax, bytes=_nbytes(wire)):
+                wire = lax.psum(wire, ax)
+        return wire.astype(dtype) if narrow is not None else wire
+    with _span("collective.reduce", tier="+".join(names),
+               bytes=_nbytes(wire)):
+        wire = lax.psum(wire, names if len(names) > 1 else names[0])
+    return wire.astype(dtype) if narrow is not None else wire
+
+
+def pmax_tiered(x, axis_name: AxisName):
+    """Max across the data axes (max is associative and commutative, so
+    one fused pmax is always exact — no policy needed)."""
+    names = axis_names(axis_name)
+    if not names:
+        return x
+    return lax.pmax(x, names if len(names) > 1 else names[0])
+
+
+def all_gather_tiered(x, axis_name: AxisName):
+    """Gather across every data axis, outermost-major order — the same
+    linear rank order as ``axis_index_flat``."""
+    names = axis_names(axis_name)
+    if not names:
+        return x[None] if hasattr(x, "ndim") else jnp.asarray(x)[None]
+    return lax.all_gather(x, names if len(names) > 1 else names[0])

@@ -29,7 +29,8 @@ The factory mirrors CreateTreeLearner (src/treelearner/tree_learner.cpp:13).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+import os
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -38,9 +39,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..dataset import FeatureMeta
 from ..grower import GrowerConfig, TreeArrays, grow_tree
+from .collectives import (DCN_AXIS, HYBRID_AXES, ICI_AXIS,  # noqa: F401
+                          axis_size)
 
 DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
+
+# a data axis may be ONE mesh axis ("data", the historical single-tier
+# layout) or the hybrid outermost-first tuple ("dcn", "ici") of
+# make_hybrid_mesh — every helper below accepts both
+DataAxis = Union[str, Tuple[str, ...]]
 
 
 def shard_map_compat(f=None, *, mesh, in_specs, out_specs, check_vma=False):
@@ -86,7 +94,7 @@ def make_sharded_grower(
     mesh: Mesh,
     meta: FeatureMeta,
     cfg: GrowerConfig,
-    data_axis: Optional[str] = DATA_AXIS,
+    data_axis: Optional[DataAxis] = DATA_AXIS,
     feature_axis: Optional[str] = None,
     auto_plan: bool = True,
 ):
@@ -158,12 +166,16 @@ def make_sharded_grower(
 
 
 def shard_dataset(mesh: Mesh, binned: np.ndarray, *row_arrays,
-                  data_axis: str = DATA_AXIS):
+                  data_axis: DataAxis = DATA_AXIS):
     """Pad rows to the data-axis size and place arrays on the mesh.
 
     ``binned`` is the HOST row-major [n, F] matrix; the device copy is
-    feature-major [F, n_pad] (ops/histogram.py LAYOUT DOCTRINE)."""
-    ndev = mesh.shape[data_axis]
+    feature-major [F, n_pad] (ops/histogram.py LAYOUT DOCTRINE).
+    ``data_axis`` may be the hybrid ``("dcn", "ici")`` tuple: rows then
+    shard over BOTH tiers in the mesh's row-major device order — an
+    elastic re-tile after a slice loss is just this call over the
+    re-planned smaller mesh (docs/RESILIENCE.md)."""
+    ndev = axis_size(mesh, data_axis)
     n = binned.shape[0]
     n_pad = pad_rows_to(n, ndev)
     out = []
@@ -175,7 +187,8 @@ def shard_dataset(mesh: Mesh, binned: np.ndarray, *row_arrays,
     return out, n_pad
 
 
-def put_stacked_rows(mesh: Mesh, data_axis: str, stacked: jax.Array) -> jax.Array:
+def put_stacked_rows(mesh: Mesh, data_axis: DataAxis,
+                     stacked: jax.Array) -> jax.Array:
     """Place a ``[c, n_pad]`` stack of per-iteration row arrays (bagging /
     GOSS masks for a fused macro-step chunk, boosting/macro.py) with the
     ROW axis sharded like every other per-row array, so the chunk scan's
@@ -195,14 +208,105 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, axes)
 
 
+def simulated_slices() -> int:
+    """``LGBM_TPU_NUM_SLICES``: simulated DCN slice count for
+    single-process runs (the whole hybrid plane then exercises under
+    ``--xla_force_host_platform_device_count=N`` on CPU); 0/unset = no
+    simulation."""
+    v = os.environ.get("LGBM_TPU_NUM_SLICES", "").strip()
+    try:
+        return max(int(v), 0) if v else 0
+    except ValueError:
+        return 0
+
+
+def make_hybrid_mesh(n_devices: Optional[int] = None,
+                     num_slices: Optional[int] = None) -> Mesh:
+    """Two-axis ``("dcn", "ici")`` mesh: slices over the slow cross-host
+    tier, each slice's devices over the fast ICI tier.
+
+    Real multi-host (``jax.distributed`` initialized): one slice per
+    process, its local devices on the ICI axis — the physical topology.
+    Single-process: ``num_slices`` (or LGBM_TPU_NUM_SLICES) PARTITIONS
+    the local devices into simulated slices; the collectives then
+    exercise the exact tiered reduction schedule the pod would run.
+    Device order is row-major over (slice, device-in-slice) — the same
+    linear order as the flat single-axis mesh, so flat and hybrid
+    shardings place identical row blocks on identical devices (the
+    bit-parity tests lean on this).
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    nd = len(devs)
+    if num_slices is None:
+        num_slices = (jax.process_count() if jax.process_count() > 1
+                      else simulated_slices()) or 1
+    s = max(int(num_slices), 1)
+    if nd % s != 0:
+        raise ValueError(
+            f"cannot partition {nd} devices into {s} slices; "
+            f"num_slices must divide the device count")
+    arr = np.asarray(devs).reshape(s, nd // s)
+    return Mesh(arr, HYBRID_AXES)
+
+
+def data_axis_of(mesh: Mesh) -> DataAxis:
+    """The row-sharding axis spec for ``mesh``: the hybrid tuple when the
+    mesh carries the ("dcn", "ici") axes, else the flat "data" axis."""
+    if DCN_AXIS in mesh.axis_names and ICI_AXIS in mesh.axis_names:
+        return HYBRID_AXES
+    return DATA_AXIS
+
+
+def _hybrid_cfg(cfg: GrowerConfig, mesh: Mesh,
+                data_axis: DataAxis) -> GrowerConfig:
+    """Thread the hybrid mesh's shape + the planner's reduction election
+    into the grower config (no-op on a flat mesh)."""
+    if data_axis != HYBRID_AXES:
+        return cfg
+    total = axis_size(mesh, data_axis)
+    slices = int(mesh.shape[DCN_AXIS])
+    if cfg.num_machines <= 1 or cfg.num_machines != total:
+        cfg = cfg._replace(num_machines=total)
+    from ..ops.planner import plan_collectives
+    plan = plan_collectives(
+        features=0, num_bins=cfg.num_bins, rows_global=0,
+        quant=cfg.quant, quant_bins=cfg.quant_bins,
+        num_slices=slices, devices_per_slice=total // slices,
+        voting_k=cfg.voting_top_k)
+    return cfg._replace(num_slices=slices,
+                        hier_reduce=plan.hierarchical,
+                        pinned_reduce=plan.pinned)
+
+
 def create_parallel_grower(tree_learner: str, mesh: Mesh, meta: FeatureMeta,
                            cfg: GrowerConfig):
     """Factory mirroring CreateTreeLearner (tree_learner.cpp:13-36).
 
     tree_learner: serial | data | feature | voting | data_feature (2-D).
+    A hybrid ``make_hybrid_mesh`` mesh routes rows over BOTH tiers and
+    threads the tiered-reduction election (ops/planner.plan_collectives)
+    into the grower config; when the config carries a ``num_machines``
+    that disagrees with the mesh's actual shard count, the mesh wins —
+    LOUDLY (the reference would deadlock on such a mismatch; here it
+    would silently mis-scale voting's local constraints).
     """
+    data_axis = data_axis_of(mesh)
+    if tree_learner in ("data", "voting", "data_parallel",
+                        "voting_parallel", "data_feature", "2d"):
+        shards = axis_size(mesh, data_axis)
+        if cfg.num_machines > 1 and cfg.num_machines != shards:
+            from ..utils.log import log_warning
+            log_warning(
+                f"num_machines={cfg.num_machines} disagrees with the "
+                f"mesh's actual data-shard count ({shards}); using the "
+                "mesh — fix num_machines (or the machine list) so the "
+                "configured world matches the devices actually present")
+            cfg = cfg._replace(num_machines=shards)
     if tree_learner in ("data", "data_parallel"):
-        return make_sharded_grower(mesh, meta, cfg, data_axis=DATA_AXIS,
+        cfg = _hybrid_cfg(cfg, mesh, data_axis)
+        return make_sharded_grower(mesh, meta, cfg, data_axis=data_axis,
                                    feature_axis=None)
     if tree_learner in ("feature", "feature_parallel"):
         return make_sharded_grower(mesh, meta, cfg, data_axis=None,
@@ -215,8 +319,9 @@ def create_parallel_grower(tree_learner: str, mesh: Mesh, meta: FeatureMeta,
         if cfg.voting_top_k <= 0:
             cfg = cfg._replace(voting_top_k=20)
         if cfg.num_machines <= 1:
-            cfg = cfg._replace(num_machines=int(mesh.shape[DATA_AXIS]))
-        return make_sharded_grower(mesh, meta, cfg, data_axis=DATA_AXIS,
+            cfg = cfg._replace(num_machines=axis_size(mesh, data_axis))
+        cfg = _hybrid_cfg(cfg, mesh, data_axis)
+        return make_sharded_grower(mesh, meta, cfg, data_axis=data_axis,
                                    feature_axis=None)
     if tree_learner in ("data_feature", "2d"):
         return make_sharded_grower(mesh, meta, cfg, data_axis=DATA_AXIS,

@@ -26,9 +26,112 @@ from __future__ import annotations
 
 import os
 import socket
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 from ..utils.log import log_info, log_warning
+
+# the last REAL (non-dry-run) init_network call: its num_machines /
+# local_listen_port round-trip into mesh_plan so the reference's config
+# surface actually steers the hybrid mesh construction instead of being
+# parsed and dropped
+_LAST_INIT: Optional[dict] = None
+
+
+class MeshPlan(NamedTuple):
+    """How the data-parallel mesh partitions into DCN slices.
+
+    ``num_slices > 1`` elects the hybrid ``("dcn", "ici")`` mesh
+    (parallel/learners.make_hybrid_mesh); 1 keeps the flat single-axis
+    layout.  ``source`` records which signal decided (real process
+    topology > simulated slices env > num_machines config > flat)."""
+
+    num_slices: int
+    devices_per_slice: int
+    total_shards: int
+    source: str                 # "distributed" | "env" | "num_machines"
+    #                             | "flat"
+
+    @property
+    def hybrid(self) -> bool:
+        return self.num_slices > 1
+
+
+def last_network_init() -> Optional[dict]:
+    """The recorded (non-dry-run) ``init_network`` call, or None."""
+    return _LAST_INIT
+
+
+def mesh_plan(n_devices: int,
+              num_machines: Optional[int] = None,
+              local_listen_port: Optional[int] = None) -> MeshPlan:
+    """Partition ``n_devices`` data shards into DCN slices.
+
+    Priority:
+    1. a real multi-host runtime (``jax.distributed`` initialized, >1
+       process): one slice per process — the physical topology; a
+       configured ``num_machines`` that DISAGREES with it warns loudly
+       (the reference would deadlock waiting for the missing machines;
+       here the silent failure mode is mis-scaled voting constraints);
+    2. ``LGBM_TPU_NUM_SLICES``: simulated slices for single-process runs;
+    3. ``num_machines`` (or the last ``init_network``'s): num_machines
+       slices when it divides the device count — the reference's
+       machine-count key steering the DCN tier directly;
+    4. flat single-tier mesh.
+    """
+    from .learners import simulated_slices
+    nd = max(int(n_devices), 1)
+    if num_machines is None and _LAST_INIT is not None:
+        num_machines = _LAST_INIT.get("num_machines")
+        if local_listen_port is None:
+            local_listen_port = _LAST_INIT.get("local_listen_port")
+    nm = int(num_machines or 0)
+
+    def warn_mismatch(actual: int, what: str):
+        if nm > 1 and nm != actual:
+            log_warning(
+                f"num_machines={nm} disagrees with {what} ({actual}); "
+                "using the actual topology — fix num_machines / the "
+                "machine list so the configured world matches the "
+                "devices actually present"
+                + (f" (local_listen_port={local_listen_port})"
+                   if local_listen_port else ""))
+
+    try:
+        import jax
+        procs = jax.process_count()
+    except Exception:   # noqa: BLE001 — planning must work pre-backend
+        procs = 1
+    if procs > 1:
+        warn_mismatch(procs, "the live process count")
+        s = procs if nd % procs == 0 else 1
+        return MeshPlan(s, nd // s, nd, "distributed")
+    sim = simulated_slices()
+    per_env = os.environ.get("LGBM_TPU_SLICE_DEVICES", "").strip()
+    try:
+        per = max(int(per_env), 1) if per_env else 0
+    except ValueError:
+        per = 0
+    if sim >= 1 and (sim > 1 or per):
+        # simulated slice topology (single-process): LGBM_TPU_NUM_SLICES
+        # partitions the devices; LGBM_TPU_SLICE_DEVICES additionally
+        # bounds the per-slice device count — how an elastic shrink
+        # (resilience/elastic.py) expresses the survivors' smaller world
+        # without a real re-launch
+        per_c = per or (nd // sim if nd % sim == 0 else 0)
+        if per_c and sim * per_c <= nd:
+            warn_mismatch(sim, "LGBM_TPU_NUM_SLICES")
+            return MeshPlan(sim, per_c, sim * per_c, "env")
+    if nm > 1:
+        if nd % nm == 0 and nd // nm > 1:
+            # num_machines "machines", each owning an equal slice of the
+            # local devices — the reference's machine-count key steering
+            # the DCN tier directly (a single-device-per-machine split
+            # has no fast tier to reduce first, so it stays flat below)
+            return MeshPlan(nm, nd // nm, nd, "num_machines")
+        total = min(nd, nm)
+        warn_mismatch(total, "the flat shard count this device set allows")
+        return MeshPlan(1, total, total, "flat")
+    return MeshPlan(1, nd, nd, "flat")
 
 
 def parse_machine_list(machines: Optional[str] = None,
@@ -144,6 +247,13 @@ def init_network(machines: Optional[str] = None,
     coordinator = f"{host0}:{port0}"
     if dry_run:
         return coordinator, n, rank
+    # round-trip the reference config surface into the mesh plan: the
+    # num_machines/local_listen_port this process was wired with are what
+    # mesh_plan consults when the GBDT layer builds the hybrid mesh
+    global _LAST_INIT
+    _LAST_INIT = {"num_machines": n, "rank": rank,
+                  "local_listen_port": local_listen_port,
+                  "coordinator": coordinator}
     import jax
     if getattr(jax.distributed, "is_initialized", lambda: False)():
         log_warning("init_network: jax.distributed already initialized")
@@ -162,6 +272,8 @@ def init_network(machines: Optional[str] = None,
 
 def free_network() -> None:
     """reference: Network::Dispose / LGBM_NetworkFree."""
+    global _LAST_INIT
+    _LAST_INIT = None
     import jax
     try:
         if getattr(jax.distributed, "is_initialized", lambda: False)():

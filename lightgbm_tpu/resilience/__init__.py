@@ -6,12 +6,16 @@
   and pluggable-file-system seams.
 - ``retry``: ``resilient_allgather`` — CRC framing, deadline + backoff,
   rank-consistent verdict round, consistent abort.
+- ``elastic``: shrink-rejoin after a preempted slice — rank-consistent
+  membership probe, shrunk-world re-plan, resume-on-a-smaller-mesh.
 """
 
 from .checkpoint import (Checkpoint, CheckpointCorruptError, CheckpointError,
                          CheckpointManager, CheckpointNotFoundError,
                          load_checkpoint, resolve_resume_point,
                          restore_booster, save_checkpoint)
+from .elastic import (SliceLostError, apply_world, membership_probe,
+                      plan_shrunk_world, shrink_and_resume)
 from .faults import ChaosRegistry, FaultSpec, parse_schedule
 from .retry import (CollectiveError, ResilienceConfig, make_resilient,
                     resilient_allgather)
@@ -23,4 +27,6 @@ __all__ = [
     "ChaosRegistry", "FaultSpec", "parse_schedule",
     "CollectiveError", "ResilienceConfig", "make_resilient",
     "resilient_allgather",
+    "SliceLostError", "apply_world", "membership_probe",
+    "plan_shrunk_world", "shrink_and_resume",
 ]

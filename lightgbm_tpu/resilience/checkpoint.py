@@ -119,12 +119,20 @@ def build_bundle_bytes(booster, iteration: int,
             stream_prov["store_path"] = sctx.store.path
             stream_prov["store_block_rows"] = int(sctx.store.block_rows)
             stream_prov["store_num_blocks"] = int(sctx.store.num_blocks)
+    # pod-scale provenance (parallel/collectives.py): the mesh shape and
+    # the elected reduction schedule this bundle trained under.  Never
+    # validated on restore — hierarchical == flat is bit-invariant for
+    # quantized payloads and pinned f32, and an ELASTIC resume (slice
+    # loss, docs/RESILIENCE.md) restores into a re-planned SMALLER mesh
+    # on purpose; recorded so a shrink post-mortem can see both worlds
+    cplan = getattr(booster.boosting, "collective_plan", None)
     manifest = {
         "format": FORMAT,
         "iteration": int(iteration),
         "chunk_cap": chunk_cap(),
         "hist_plan": plan.summary() if plan is not None else None,
         "stream_plan": stream_prov,
+        "collective_plan": cplan.summary() if cplan is not None else None,
         "members": {
             "model.txt": {"sha256": _sha256(model_txt),
                           "size": len(model_txt)},

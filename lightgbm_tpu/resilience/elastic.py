@@ -1,0 +1,144 @@
+"""Elastic shrink-rejoin: preemptible-capacity training, first class.
+
+A pod trained on preemptible capacity loses slices mid-run.  The
+reference's socket `Network` would simply wedge; this module closes the
+loop the PR 2 resilience subsystem opened (docs/RESILIENCE.md):
+
+1. **detect** — the training side's one cross-host dependency is the
+   collective plane.  A lost slice surfaces as ``resilient_allgather``'s
+   rank-consistent ``CollectiveError``: every SURVIVING rank aborts the
+   round together, within the deadline, instead of hanging
+   (resilience/retry.py).
+2. **agree** — ``membership_probe`` runs a liveness allgather (8-byte
+   rank stamps through the same CRC/verdict machinery) over a candidate
+   world.  A committed round IS the agreement: every listed rank saw
+   every other rank's stamp and voted ok.  A consistent failure means
+   the candidate world still contains a dead member — shrink further.
+3. **re-plan** — ``plan_shrunk_world`` re-partitions the surviving
+   devices into slices (``parallel/network.MeshPlan``), and
+   ``apply_world`` expresses it through the mesh-plan seam the GBDT
+   layer already consults (LGBM_TPU_NUM_SLICES / LGBM_TPU_SLICE_DEVICES
+   for the single-process simulation; a real pod re-launch sets the
+   process topology instead).
+4. **resume** — ``lgb.train(..., resume_from=<ckpt dir>)`` over the
+   re-planned mesh restores the latest VERIFIED bundle
+   (``CheckpointManager.latest_verified`` skips a torn newest), and
+   ``GBDT.restore_state`` re-tiles every per-row array from the old
+   world's row layout into the new one — ``shard_dataset``'s padding
+   over the smaller mesh.  Eval history and early-stopping patience ride
+   the bundle's callback states, so the shrunk run continues the same
+   learning curve.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, List, Optional
+
+from ..utils.log import log_info, log_warning
+from .retry import CollectiveError, ResilienceConfig, resilient_allgather
+
+_STAMP = struct.Struct("<4sI")
+_MAGIC = b"LGEL"
+
+
+class SliceLostError(RuntimeError):
+    """The candidate world cannot commit a membership round: at least one
+    member is gone.  ``world`` carries the candidate that failed."""
+
+    def __init__(self, world: int, reason: str):
+        super().__init__(
+            f"membership probe failed for world={world}: {reason}; "
+            "shrink the world and re-probe (docs/RESILIENCE.md)")
+        self.world = world
+
+
+def membership_probe(allgather_bytes: Callable[[bytes], List[bytes]],
+                     *, world: int, rank: int,
+                     config: Optional[ResilienceConfig] = None,
+                     metrics=None) -> List[int]:
+    """Rank-consistent liveness round over a candidate ``world``.
+
+    Every rank allgathers an 8-byte stamp through
+    ``resilient_allgather`` (CRC framing + verdict round).  On commit,
+    returns the sorted member ranks — every one of them observed the
+    full set and voted ok, so the membership IS agreed.  On a consistent
+    abort raises ``SliceLostError``: some candidate member is gone (or
+    the transport to it is), and the caller should shrink and re-probe
+    with a fresh transport for the smaller world.
+    """
+    cfg = config or ResilienceConfig(deadline_s=10.0, max_retries=2)
+    try:
+        parts = resilient_allgather(
+            _STAMP.pack(_MAGIC, rank), allgather_bytes,
+            world=world, rank=rank, config=cfg,
+            label="membership_probe", metrics=metrics)
+    except CollectiveError as e:
+        raise SliceLostError(world, str(e)) from e
+    members = []
+    for p in parts:
+        if len(p) != _STAMP.size or p[:4] != _MAGIC:
+            raise SliceLostError(world, f"malformed member stamp {p!r}")
+        members.append(int(_STAMP.unpack(p)[1]))
+    return sorted(members)
+
+
+def plan_shrunk_world(num_slices: int, devices_per_slice: int,
+                      lost_slices: int):
+    """Re-partition after ``lost_slices`` preempted slices: the survivors
+    keep their per-slice device count (their ICI topology is physical),
+    only the DCN tier shrinks.  Returns a ``parallel.network.MeshPlan``;
+    raises when nothing survives."""
+    from ..parallel.network import MeshPlan
+    s = max(int(num_slices), 1) - max(int(lost_slices), 0)
+    if s < 1:
+        raise SliceLostError(
+            int(num_slices), f"all {num_slices} slices lost")
+    d = max(int(devices_per_slice), 1)
+    return MeshPlan(s, d, s * d, "elastic")
+
+
+def apply_world(plan) -> None:
+    """Express a (shrunk) world through the mesh-plan seam
+    (``parallel/network.mesh_plan``) so the next booster construction
+    builds the re-planned mesh and ``restore_state`` re-tiles into it.
+
+    Single-process simulation: sets LGBM_TPU_NUM_SLICES /
+    LGBM_TPU_SLICE_DEVICES.  On a real pod the orchestration layer
+    relaunches ``jax.distributed`` with the surviving hosts instead —
+    the mesh plan's priority order then reads the live topology and
+    these env values are ignored.
+    """
+    import os
+    os.environ["LGBM_TPU_NUM_SLICES"] = str(int(plan.num_slices))
+    os.environ["LGBM_TPU_SLICE_DEVICES"] = str(int(plan.devices_per_slice))
+    log_info(
+        f"elastic: world re-planned to {plan.num_slices} slice(s) x "
+        f"{plan.devices_per_slice} device(s) = {plan.total_shards} shards "
+        f"(source={plan.source})")
+
+
+def shrink_and_resume(params: dict, train_set, ckpt_dir: str,
+                      *, num_slices: int, devices_per_slice: int,
+                      lost_slices: int = 1, num_boost_round: int = 100,
+                      **train_kw):
+    """One-call shrink-rejoin for the surviving process: re-plan the
+    world, then resume from the newest VERIFIED bundle in ``ckpt_dir``
+    over the smaller mesh.  Returns the resumed Booster.
+
+    The caller reaches here after ``membership_probe`` (or training's
+    own ``CollectiveError``) established the loss; ``lost_slices`` is
+    how many DCN participants are gone.  Keyword args pass through to
+    ``lgb.train`` (callbacks, valid sets, snapshot_freq for continued
+    checkpointing, ...).
+    """
+    plan = plan_shrunk_world(num_slices, devices_per_slice, lost_slices)
+    log_warning(
+        f"elastic: {lost_slices} slice(s) lost from a "
+        f"{num_slices}x{devices_per_slice} world; resuming from the "
+        f"latest verified bundle in {ckpt_dir!r} on the shrunk "
+        f"{plan.num_slices}x{plan.devices_per_slice} mesh")
+    apply_world(plan)
+    from ..engine import train as _train
+    return _train(params, train_set, num_boost_round=num_boost_round,
+                  resume_from=ckpt_dir, **train_kw)

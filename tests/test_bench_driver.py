@@ -84,6 +84,28 @@ def test_bench_journal_resume_after_crash(tmp_path):
     assert set(d["stages"]) == {"kernel_probe", "hist_probe"}
 
 
+def test_bench_collective_probe_stage(tmp_path):
+    """The pod-scale collective micro-bench rides the stage journal like
+    every probe: BENCH_ONLY selects it, the journaled result carries the
+    per-tier byte fields, and the acceptance signal (voting DCN bytes
+    strictly below data-parallel at equal trees) holds."""
+    journal = str(tmp_path / "journal.json")
+    stages = _run_worker({"BENCH_JOURNAL": journal,
+                          "BENCH_ONLY": "collective_probe"})
+    cp = [s for s in stages
+          if s["stage"] == "collective_probe" and "error" not in s]
+    assert cp, stages
+    out = cp[0]
+    assert {"mesh_shape", "ici_bytes", "dcn_bytes", "hierarchy_elected",
+            "voting_k", "measured_ms"} <= out.keys(), sorted(out)
+    for payload in ("f32", "quant"):
+        assert out[payload]["voting_dcn_below_data"], out[payload]
+        assert out[payload]["voting_parallel"]["dcn_bytes"] \
+            < out[payload]["data_parallel"]["dcn_bytes"]
+    d = json.load(open(journal))
+    assert "collective_probe" in d["stages"]
+
+
 def test_bench_journal_fingerprint_invalidation(tmp_path, monkeypatch):
     """A journal written under a different workload shape must not be
     replayed (stale telemetry masquerading as current is worse than a

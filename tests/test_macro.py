@@ -104,6 +104,27 @@ def test_chunked_equals_per_iteration_tiled(case, monkeypatch):
 
 
 @pytest.mark.parametrize("case", ["gbdt", "quant"])
+def test_chunked_equals_per_iteration_hierarchical(case, monkeypatch):
+    """Hybrid ("dcn","ici") mesh with hierarchical tiered reduction
+    (pod-scale plane, parallel/collectives.py): chunked == per-iteration
+    must hold unchanged, and the hierarchical models must equal the
+    flat-schedule ones byte-for-byte — integer payloads are associative;
+    the f32 row rides the pinned tier-ordered reduction."""
+    params, y = PARITY_CASES[case]
+    params = dict(params, tree_learner="data")
+    monkeypatch.setenv("LGBM_TPU_NUM_SLICES", "2")
+    if case == "gbdt":
+        monkeypatch.setenv("LGBM_TPU_PINNED_REDUCE", "1")
+    monkeypatch.setenv("LGBM_TPU_HIER_REDUCE", "0")
+    flat = _train(params, y, [1] * 12)
+    monkeypatch.setenv("LGBM_TPU_HIER_REDUCE", "1")
+    per_iter = _train(params, y, [1] * 12)
+    chunked = _train(params, y, [8, 4])
+    assert chunked == per_iter, f"{case}: hierarchical chunk != per-iter"
+    assert per_iter == flat, f"{case}: hierarchical != flat schedule"
+
+
+@pytest.mark.parametrize("case", ["gbdt", "quant"])
 def test_streamed_equals_resident_chunk_matrix(case, monkeypatch):
     """Out-of-core streamed training (lightgbm_tpu/data/) joins the
     chunked==per-iteration matrix: the streamed executor must reproduce
