@@ -53,8 +53,13 @@ BENCH_FORCE_CPU=1 (skip TPU entirely), BENCH_PROFILE=1 (jax.profiler trace
 to ./bench_trace), BENCH_TOTAL_BUDGET (s, default 6600),
 BENCH_CPU_ROWS / BENCH_CPU_TREES, BENCH_SMOKE_ROWS / BENCH_SMOKE_TREES,
 BENCH_SKIP_SMOKE=1, BENCH_SKIP_KERNEL_PROBE=1, BENCH_SKIP_HIST_PROBE=1,
-BENCH_SKIP_OBS=1 (skip the obs_dump stage AND the measured per-variant
-MFU table — lightgbm_tpu/obs/devprof.py cost_analysis numbers that
+BENCH_SKIP_OBS=1 (skip the obs_dump + obs_doctor stages AND the measured
+per-variant MFU table; obs_doctor — tools/obs_doctor.py over
+lightgbm_tpu/obs/diagnose.py — runs LAST and journals ranked bottleneck
+verdicts ("dcn-bound", "compile-bound", "input-bound", "straggler",
+"kernel-underutilized") derived from the banked stages, so every bench
+round self-reports its bottleneck; the measured MFU table is the
+lightgbm_tpu/obs/devprof.py cost_analysis numbers that
 otherwise ride in the full/fallback run_bench results as "mfu_measured",
 banked under their own journal key so retries replay them; the table
 now includes the */fused rows — the Pallas histogram→split megakernel,
@@ -642,6 +647,15 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
                         rows=mfu_rows, features=F,
                         num_bins=max_bin + 1,
                         reps=2 if in_worker else 1)
+                # best measured MFU as a gauge: the obs_doctor stage and
+                # pod telemetry vectors read it (docs/OBSERVABILITY.md)
+                best_mfu = max(
+                    (v.get("mfu", 0.0)
+                     for v in result["mfu_measured"].values()
+                     if isinstance(v, dict)), default=0.0)
+                if best_mfu:
+                    obs_registry.gauge("mfu_measured_best").set(
+                        round(best_mfu, 6))
                 # bank only a table with at least one real measurement —
                 # an all-error table must retry next run (the journal's
                 # errors-never-banked rule)
@@ -1259,6 +1273,20 @@ def tpu_worker():
     # save/load cost + resume bit-parity on the live backend
     if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
         run_stage("resilience", run_resilience_bench, budget_floor=240)
+
+    # automated bottleneck diagnosis (lightgbm_tpu/obs/diagnose.py):
+    # joins THIS run's banked stages (mfu_measured, compile_cache,
+    # stream_probe, collective_probe) + live registry gauges into ranked
+    # verdicts, journaled LAST so every bench round self-reports its
+    # bottleneck next to the numbers; errors are never journaled
+    # (run_stage) so a failed diagnosis retries
+    if os.environ.get("BENCH_SKIP_OBS") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _doctor():
+            from obs_doctor import run_doctor
+            return run_doctor(stages=journal_stages())
+        run_stage("obs_doctor", _doctor)
     return 0
 
 

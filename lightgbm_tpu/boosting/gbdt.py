@@ -803,6 +803,14 @@ class GBDT:
                 int(self.collective_plan.num_slices))
             _obs_registry.gauge("train_hier_reduce").set(
                 int(self.collective_plan.hierarchical))
+        # planner plan summaries ride every forensic bundle's fingerprint
+        # (obs/flight.py) — the ring may have rolled past the planner
+        # instants by the time a long run dies
+        from ..obs.flight import global_flight as _flight
+        _flight.set_context(
+            hist_plan=self.hist_plan.summary(),
+            collective_plan=(self.collective_plan.summary()
+                             if self.collective_plan is not None else None))
         if not self.hist_plan.feasible:
             log_warning(
                 "HBM planner: predicted peak "

@@ -50,6 +50,7 @@ from ..grower import GrowerConfig, TreeArrays, _LeafBest, row_goes_left
 from ..grower_rounds import _pad_scatter
 from ..obs.metrics import global_registry as _obs_registry
 from ..obs.trace import instant as _instant, span as _span
+from ..obs.watchdog import beat as _beat
 from ..ops.histogram import (build_histogram, build_histogram_int,
                              quant_levels, segment_histogram,
                              segment_histogram_int, take_from_table)
@@ -119,6 +120,7 @@ class BlockPump:
         if not self.prefetch:
             for i in range(nb):
                 _obs_registry.counter("stream_blocks_total").inc()
+                _beat("stream.pump", count=i + 1)
                 yield self._load(i)
             return
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -149,6 +151,9 @@ class BlockPump:
                     raise item
                 gauge.set(q.qsize() + 1)
                 _obs_registry.counter("stream_blocks_total").inc()
+                # pump heartbeat: a wedged spill store / reader thread
+                # goes stale here and the watchdog names the stall
+                _beat("stream.pump", count=item[0] + 1)
                 yield item
         finally:
             stop.set()

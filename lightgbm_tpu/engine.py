@@ -17,8 +17,10 @@ from . import callback as callback_mod
 from .basic import Booster
 from .config import Config
 from .dataset import Dataset
+from .obs.flight import global_flight as _flight
 from .obs.metrics import global_registry as _obs_registry
 from .obs.trace import span as _span
+from .obs.watchdog import global_watchdog as _watchdog
 
 
 def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
@@ -51,6 +53,13 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     """
     from .utils.platform import enable_compile_cache
     enable_compile_cache()
+    # active observability (docs/OBSERVABILITY.md): the env-gated SLO
+    # sentry + metrics HTTP endpoint, and run context for any forensic
+    # bundle this training might have to dump
+    from .obs.http import maybe_start_from_env as _http_from_env
+    from .obs.watchdog import maybe_start_from_env as _wd_from_env
+    _wd_from_env()
+    _http_from_env()
     params = dict(params)
     cfg = Config.from_params(params)
     if "num_iterations" in {Config.canonical_key(k) for k in params}:
@@ -229,6 +238,15 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     evaluation_result_list = []
     i = start_iter
     t_loop0 = time.perf_counter()
+    K_per_iter = booster.boosting.num_tree_per_iteration
+    _flight.set_context(
+        phase="train", num_boost_round=num_boost_round,
+        start_iter=start_iter, objective=cfg.objective,
+        num_leaves=cfg.num_leaves, rows=train_set.num_data)
+    # the engine-loop heartbeat is stale-watched only WHILE the loop
+    # runs (watchdog.py: a finished loop must never breach)
+    _watchdog.watch_heartbeat(
+        "engine.step", floor=_watchdog.config.trees_per_sec_floor)
     train_root = _span("engine.train", start_iter=start_iter,
                        num_boost_round=num_boost_round)
     train_root.__enter__()
@@ -242,6 +260,7 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
                 if ckpt_mgr is not None:
                     d = min(d, snapshot_freq - (i % snapshot_freq))
                 c = pow2_chunk(d, cap)
+            t_step0 = time.perf_counter()
             if c > 1:
                 lrs = ([_lr_at(j) for j in range(i, i + c)] if lr_cbs else None)
                 with _span("engine.step", i=i, c=c):
@@ -259,6 +278,19 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
                 with _span("engine.step", i=i, c=1):
                     finished = booster.update(fobj=fobj)
                 i += 1
+            # step boundary: flight ring + live-rate gauges + heartbeat
+            # (cheap host-side accounting — no device work, no numerics)
+            step_s = time.perf_counter() - t_step0
+            _flight.note("engine.step", i=i - c, c=c,
+                         dur_us=step_s * 1e6)
+            _flight.sample_metrics()
+            _obs_registry.gauge("train_iter_seconds").set(
+                round(step_s / max(c, 1), 6))
+            live = (i - start_iter) * K_per_iter / max(
+                time.perf_counter() - t_loop0, 1e-9)
+            _obs_registry.gauge("train_trees_per_sec_live").set(
+                round(live, 3))
+            _watchdog.beat("engine.step", count=i * K_per_iter)
             j = i - 1        # last iteration trained this turn
             evaluation_result_list = []
             if eval_possible and (j + 1) % mf == 0:
@@ -266,6 +298,10 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
                     if cfg.is_provide_training_metric or train_in_valid:
                         evaluation_result_list.extend(booster.eval_train(feval))
                     evaluation_result_list.extend(booster.eval_valid(feval))
+                # pod telemetry at the eval boundary (obs/aggregate.py):
+                # a no-op unless a pod transport is registered
+                from .obs.aggregate import maybe_gather_at_eval
+                maybe_gather_at_eval()
             early_stopped = False
             try:
                 for cb in cbs_after:
@@ -288,9 +324,13 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
                 break
     except BaseException as e:
         train_root.set(error=type(e).__name__)
+        # unhandled engine-loop failure: dump the forensic bundle (ring
+        # + metrics + fingerprint) BEFORE the raise unwinds the process
+        _flight.on_exception("engine.train", e)
         raise
     finally:
         train_root.__exit__(None, None, None)
+        _watchdog.unwatch("engine.step")
     # training-loop instruments on the unified process registry
     # (docs/OBSERVABILITY.md): cheap host-side gauges, no device work
     wall = time.perf_counter() - t_loop0

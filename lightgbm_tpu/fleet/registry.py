@@ -45,6 +45,7 @@ import numpy as np
 
 from ..obs.metrics import global_registry as _obs_registry
 from ..obs.trace import instant as _instant
+from ..obs.watchdog import beat as _beat
 from ..ops.planner import FleetModelShape, FleetPlan, plan_fleet
 from ..serving.errors import ModelNotFound, QueueFull, ServerClosed
 from ..serving.metrics import MetricsRegistry
@@ -262,6 +263,7 @@ class Fleet:
             deadline_ms = self._class_deadline(entry)
         m = self.metrics
         m.counter("fleet_requests_total", labels={"model": name}).inc()
+        _beat("fleet.submit")
         t0 = time.monotonic()
         fut = entry.server.submit(X, deadline_ms=deadline_ms)
         hist = m.histogram("request_latency_ms", labels={"model": name})
@@ -358,6 +360,11 @@ class Fleet:
         m.gauge("fleet_budget_bytes").set(plan.budget_bytes)
         m.gauge("fleet_evicted_models").set(len(plan.evicted))
         _instant("fleet.plan", **plan.summary())
+        # the instant above also feeds the flight ring (trace.py tee);
+        # the fingerprint additionally carries the CURRENT plan so a
+        # bundle shows residency state even after the ring rolled over
+        from ..obs.flight import global_flight
+        global_flight.set_context(fleet_plan=plan.summary())
         return plan
 
     @property

@@ -14,7 +14,21 @@ Three pillars, shared by training, serving, resilience and the bench:
   process-wide instrument registry (``global_registry``), with JSON
   snapshots and Prometheus text exposition.
 
-``trace``/``metrics`` are stdlib-only; ``devprof`` imports jax lazily.
+The ACTIVE layer on top (docs/OBSERVABILITY.md):
+
+- ``obs.flight`` — always-on bounded ring-buffer flight recorder
+  dumping atomic forensic bundles on failure triggers;
+- ``obs.watchdog`` — heartbeat/SLO sentry (stalls, trees/sec floor,
+  serving-p99 ceiling) breaching into ``slo_breach_total`` + flight
+  dumps;
+- ``obs.aggregate`` — pod-level telemetry vectors gathered through the
+  resilient collective plane (straggler skew, per-tier byte sums);
+- ``obs.diagnose`` — ranked bottleneck verdicts joining measured vs
+  planner-predicted signals (``tools/obs_doctor.py`` CLI);
+- ``obs.http`` — opt-in stdlib HTTP exposition of the process registry.
+
+``trace``/``metrics``/``flight``/``watchdog``/``http`` are stdlib-only;
+``devprof`` imports jax lazily.
 """
 
 from .metrics import (LATENCY_BUCKETS_MS, RATIO_BUCKETS, Counter, Gauge,
@@ -22,6 +36,9 @@ from .metrics import (LATENCY_BUCKETS_MS, RATIO_BUCKETS, Counter, Gauge,
                       global_registry)
 from .trace import (Tracer, global_tracer, instant, span, span_coverage,
                     trace_enabled, trace_path)
+# importing flight installs the tracer's ring tee (set_flight_sink)
+from .flight import FlightRecorder, global_flight
+from .watchdog import SLOConfig, Watchdog, global_watchdog
 
 __all__ = [
     "span", "instant", "trace_enabled", "trace_path", "span_coverage",
@@ -29,4 +46,6 @@ __all__ = [
     "MetricsRegistry", "global_registry", "get_registry",
     "Counter", "Gauge", "Histogram",
     "LATENCY_BUCKETS_MS", "RATIO_BUCKETS",
+    "FlightRecorder", "global_flight",
+    "Watchdog", "SLOConfig", "global_watchdog",
 ]

@@ -39,6 +39,31 @@ import time
 from typing import List, Optional
 
 _TRACE_ENV = "LIGHTGBM_TPU_TRACE"
+_MAX_EVENTS_ENV = "LIGHTGBM_TPU_TRACE_MAX_EVENTS"
+# generous default: ~1M events is hundreds of MB of JSON before a long
+# pod run would ever hit it, but it IS a bound — the in-process span
+# list can no longer grow without limit (drops are counted, never silent)
+_DEFAULT_MAX_EVENTS = 1_000_000
+
+# the flight recorder's ring sink (obs/flight.py installs itself via
+# set_flight_sink at import).  Kept as a module global so trace.py never
+# imports flight.py (no cycle); None = no recorder armed.
+_flight_sink = None
+
+
+def set_flight_sink(sink) -> None:
+    """Install (or clear, with None) the flight-recorder ring that tees
+    recorded span/instant events.  Called by obs/flight.py."""
+    global _flight_sink
+    _flight_sink = sink
+
+
+def _max_events_env() -> int:
+    try:
+        v = int(os.environ.get(_MAX_EVENTS_ENV, _DEFAULT_MAX_EVENTS))
+    except ValueError:
+        return _DEFAULT_MAX_EVENTS
+    return v if v > 0 else _DEFAULT_MAX_EVENTS
 
 
 class _NullSpan:
@@ -92,15 +117,25 @@ class _Span:
 class Tracer:
     """Thread-safe span/instant recorder with Chrome-trace export."""
 
-    def __init__(self, enabled: Optional[bool] = None):
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_events: Optional[int] = None):
         if enabled is None:
             v = os.environ.get(_TRACE_ENV, "")
             enabled = bool(v) and v != "0"
         self.enabled = enabled
+        # bounded in-process event list (LIGHTGBM_TPU_TRACE_MAX_EVENTS):
+        # beyond the cap new events are DROPPED and counted, so a long
+        # pod run cannot grow the span list without bound
+        self.max_events = (int(max_events) if max_events is not None
+                           else _max_events_env())
+        self.dropped = 0
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self._pid = os.getpid()
         self._epoch = time.perf_counter()
+        # only the process tracer tees into the flight ring (scratch
+        # tracers in tests must not pollute the process forensics)
+        self._flight_tee = False
 
     # ------------------------------------------------------------- control
 
@@ -113,6 +148,7 @@ class Tracer:
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
+            self.dropped = 0
 
     # ----------------------------------------------------------- recording
 
@@ -132,8 +168,7 @@ class Tracer:
               "ts": (time.perf_counter() - self._epoch) * 1e6}
         if args:
             ev["args"] = args
-        with self._lock:
-            self._events.append(ev)
+        self._append(ev)
 
     def _record(self, name: str, t0: float, t1: float, args: dict) -> None:
         ev = {"name": name, "ph": "X", "pid": self._pid,
@@ -142,8 +177,26 @@ class Tracer:
               "dur": (t1 - t0) * 1e6}
         if args:
             ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: dict) -> None:
+        dropped_now = None
         with self._lock:
-            self._events.append(ev)
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                dropped_now = self.dropped
+            else:
+                self._events.append(ev)
+        sink = _flight_sink
+        if sink is not None and self._flight_tee:
+            # the flight ring is bounded by construction, so it still
+            # sees events the capped span list dropped
+            sink.feed(ev)
+        if dropped_now is not None:
+            # visible both process-wide (gauge) and in the trace dump
+            # (an instant is appended at export, see to_chrome_trace)
+            from .metrics import global_registry
+            global_registry.gauge("trace_events_dropped").set(dropped_now)
 
     # -------------------------------------------------------------- export
 
@@ -160,6 +213,13 @@ class Tracer:
         meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
                  "tid": 0, "ts": 0.0,
                  "args": {"name": "lightgbm-tpu"}}]
+        if self.dropped:
+            evs = evs + [{
+                "name": "trace_events_dropped", "ph": "i", "s": "p",
+                "pid": self._pid, "tid": 0,
+                "ts": (evs[-1]["ts"] if evs else 0.0),
+                "args": {"dropped": self.dropped,
+                         "max_events": self.max_events}}]
         return {"traceEvents": meta + evs, "displayTimeUnit": "ms"}
 
     def dump(self, path: str, events: Optional[List[dict]] = None) -> str:
@@ -180,6 +240,7 @@ class Tracer:
 
 
 global_tracer = Tracer()
+global_tracer._flight_tee = True
 
 
 def span(name: str, **args):
@@ -192,6 +253,11 @@ def span(name: str, **args):
 
 def instant(name: str, **args) -> None:
     global_tracer.instant(name, **args)
+    if not global_tracer.enabled and _flight_sink is not None:
+        # instants are rare (planner verdicts, HBM peaks, admissions) and
+        # exactly the point-in-time facts a forensic bundle needs — keep
+        # feeding the always-on flight ring with tracing off
+        _flight_sink.note_instant(name, args)
 
 
 def trace_enabled() -> bool:

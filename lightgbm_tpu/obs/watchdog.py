@@ -1,0 +1,300 @@
+"""SLO watchdog: a heartbeat-based stall/SLO sentry over the long-lived
+loops (engine iterations, the streaming block pump, collective attempts,
+the serving batcher).
+
+The passive plane (spans, metrics) records what happened; this module
+WATCHES it happen and raises the alarm when it stops or degrades:
+
+- **heartbeats** — instrumented loops call ``beat(name[, count])`` (one
+  dict store, always cheap).  A heartbeat registered for watching
+  (``watch_heartbeat``) that goes stale past its threshold is a
+  ``stall:<name>`` breach — the 3am "training wedged silently" case.
+  Registration is scoped to the activity: the engine registers its beat
+  on loop entry and unregisters on exit, so a heartbeat that stopped
+  because training FINISHED never breaches.
+- **rate floors** — a counted heartbeat (``beat(name, count=...)``)
+  checked against a floor (trees/sec SLO): the watchdog differentiates
+  the count between checks, so a loop that still beats but crawls
+  breaches ``slo:<name>``.
+- **latency ceilings** — ``watch_histogram_p99`` holds a latency
+  histogram's estimated p99 (from its cumulative buckets) to a ceiling:
+  the serving-p99 SLO.
+
+Every breach increments ``slo_breach_total{slo=...}`` on the process
+registry, logs loudly, and — on the rising edge only, so a persistent
+breach cannot dump-storm — triggers a flight-recorder forensic bundle
+(obs/flight.py).
+
+The sentry thread is OPT-IN (``start()``, or env
+``LIGHTGBM_TPU_WATCHDOG=1`` / any ``LIGHTGBM_TPU_SLO_*`` knob via
+``maybe_start_from_env``, checked at engine/server init); ``check_once``
+runs one synchronous sweep for tests and CLIs.  Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+_WATCHDOG_ENV = "LIGHTGBM_TPU_WATCHDOG"
+_SLO_TPS_ENV = "LIGHTGBM_TPU_SLO_TREES_PER_SEC"
+_SLO_P99_ENV = "LIGHTGBM_TPU_SLO_SERVING_P99_MS"
+_SLO_STALE_ENV = "LIGHTGBM_TPU_SLO_HEARTBEAT_S"
+_INTERVAL_ENV = "LIGHTGBM_TPU_WATCHDOG_INTERVAL_S"
+
+
+def _env_float(name: str) -> Optional[float]:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+@dataclass
+class SLOConfig:
+    """The service-level objectives the sentry enforces.  ``None``
+    disables that check; the heartbeat staleness default is deliberately
+    generous — a compile can legitimately take minutes."""
+
+    heartbeat_stale_s: float = 300.0
+    trees_per_sec_floor: Optional[float] = None
+    serving_p99_ms: Optional[float] = None
+    check_interval_s: float = 5.0
+
+    @classmethod
+    def from_env(cls) -> "SLOConfig":
+        cfg = cls()
+        v = _env_float(_SLO_STALE_ENV)
+        if v is not None:
+            cfg.heartbeat_stale_s = v
+        cfg.trees_per_sec_floor = _env_float(_SLO_TPS_ENV)
+        cfg.serving_p99_ms = _env_float(_SLO_P99_ENV)
+        v = _env_float(_INTERVAL_ENV)
+        if v is not None and v > 0:
+            cfg.check_interval_s = v
+        return cfg
+
+
+def histogram_p99_ms(hist) -> Optional[float]:
+    """Upper-bound p99 estimate from a metrics Histogram's cumulative
+    buckets (the smallest bound covering >= 99% of observations; the
+    histogram max when that bound is +inf).  None with no samples."""
+    cum, _total, count = hist.cumulative()
+    if count == 0:
+        return None
+    target = 0.99 * count
+    for bound, c in cum:
+        if c >= target:
+            if math.isinf(bound):
+                snap = hist.snapshot()
+                return float(snap.get("max", 0.0))
+            return float(bound)
+    return None
+
+
+class Watchdog:
+    """Heartbeat registry + SLO sentry; one instance per process
+    (``global_watchdog``), scratch instances for tests."""
+
+    def __init__(self, config: Optional[SLOConfig] = None,
+                 registry=None, flight=None):
+        self.config = config or SLOConfig()
+        self._registry = registry
+        self._flight = flight
+        self._beats: dict = {}        # name -> (monotonic ts, count|None)
+        self._watched: dict = {}      # name -> stale threshold seconds
+        self._floors: dict = {}       # name -> rate floor (units/sec)
+        self._rate_state: dict = {}   # name -> (ts, count) at last check
+        self._hists: dict = {}        # name -> (Histogram, ceiling_ms)
+        self._breached: set = set()   # active breaches (edge detection)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _reg(self):
+        if self._registry is None:
+            from .metrics import global_registry
+            self._registry = global_registry
+        return self._registry
+
+    def _fl(self):
+        if self._flight is None:
+            from .flight import global_flight
+            self._flight = global_flight
+        return self._flight
+
+    # ----------------------------------------------------------- heartbeats
+
+    def beat(self, name: str, count: Optional[float] = None) -> None:
+        """Record liveness (and optionally progress) of ``name``.  One
+        dict store — safe on any hot loop, watched or not."""
+        self._beats[name] = (time.monotonic(), count)
+
+    def watch_heartbeat(self, name: str, stale_s: Optional[float] = None,
+                        floor: Optional[float] = None) -> None:
+        """Arm staleness (and optionally rate-floor) checking for
+        ``name``.  Call on activity START; ``unwatch`` on clean exit."""
+        with self._lock:
+            self._watched[name] = (stale_s if stale_s is not None
+                                   else self.config.heartbeat_stale_s)
+            if floor is not None:
+                self._floors[name] = floor
+            self._rate_state.pop(name, None)
+        self.beat(name)       # arming is itself proof of life
+
+    def unwatch(self, name: str) -> None:
+        with self._lock:
+            self._watched.pop(name, None)
+            self._floors.pop(name, None)
+            self._rate_state.pop(name, None)
+            self._breached = {b for b in self._breached
+                              if not b.endswith(":" + name)}
+
+    def watch_histogram_p99(self, name: str, hist,
+                            ceiling_ms: Optional[float] = None) -> None:
+        """Hold ``hist``'s estimated p99 to ``ceiling_ms`` (defaults to
+        the config's serving_p99_ms; never breaches while both are
+        None)."""
+        with self._lock:
+            self._hists[name] = (hist, ceiling_ms)
+
+    def unwatch_histogram(self, name: str) -> None:
+        with self._lock:
+            self._hists.pop(name, None)
+            # a re-registered same-name watch must get a fresh rising
+            # edge (its dump would otherwise be suppressed forever)
+            self._breached.discard(f"slo:{name}")
+
+    # -------------------------------------------------------------- checks
+
+    def _breach(self, slo: str, evidence: dict) -> None:
+        rising = slo not in self._breached
+        self._breached.add(slo)
+        try:
+            self._reg().counter("slo_breach_total",
+                                labels={"slo": slo}).inc()
+        except Exception:  # noqa: BLE001
+            pass
+        from ..utils.log import log_warning
+        log_warning(f"watchdog: SLO breach [{slo}] {evidence}")
+        if rising:
+            # rising edge only: a persistent breach must not dump-storm
+            self._fl().dump(f"watchdog:{slo}", extra=evidence)
+
+    def _clear(self, slo: str) -> None:
+        self._breached.discard(slo)
+
+    def check_once(self, now: Optional[float] = None) -> list:
+        """One synchronous sweep; returns the list of (slo, evidence)
+        breaches found THIS sweep (tests drive this without the thread)."""
+        now = time.monotonic() if now is None else now
+        breaches = []
+        with self._lock:
+            watched = dict(self._watched)
+            floors = dict(self._floors)
+            hists = dict(self._hists)
+        for name, stale_s in watched.items():
+            ts_count = self._beats.get(name)
+            if ts_count is None:
+                continue
+            age = now - ts_count[0]
+            if age > stale_s:
+                breaches.append((f"stall:{name}", {
+                    "heartbeat_age_s": round(age, 3),
+                    "stale_threshold_s": stale_s}))
+            else:
+                self._clear(f"stall:{name}")
+        for name, floor in floors.items():
+            ts_count = self._beats.get(name)
+            if ts_count is None or ts_count[1] is None:
+                continue
+            ts, count = ts_count
+            prev = self._rate_state.get(name)
+            self._rate_state[name] = (ts, count)
+            if prev is None or ts <= prev[0]:
+                continue
+            rate = (count - prev[1]) / (ts - prev[0])
+            self._reg().gauge(f"watchdog_rate_{name}").set(round(rate, 4))
+            if rate < floor:
+                breaches.append((f"slo:{name}", {
+                    "rate": round(rate, 4), "floor": floor}))
+            else:
+                self._clear(f"slo:{name}")
+        for name, (hist, ceiling) in hists.items():
+            if ceiling is None:
+                ceiling = self.config.serving_p99_ms
+            if ceiling is None:
+                continue
+            p99 = histogram_p99_ms(hist)
+            if p99 is None:
+                continue
+            self._reg().gauge(f"watchdog_p99_{name}").set(p99)
+            if p99 > ceiling:
+                breaches.append((f"slo:{name}", {
+                    "p99_ms": p99, "ceiling_ms": ceiling}))
+            else:
+                self._clear(f"slo:{name}")
+        for slo, evidence in breaches:
+            self._breach(slo, evidence)
+        return breaches
+
+    # -------------------------------------------------------------- sentry
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.config.check_interval_s):
+                try:
+                    self.check_once()
+                except Exception:  # noqa: BLE001 — the sentry never dies
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="lgbt-slo-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+
+global_watchdog = Watchdog()
+
+
+def beat(name: str, count: Optional[float] = None) -> None:
+    """Module-level heartbeat against the process watchdog."""
+    global_watchdog._beats[name] = (time.monotonic(), count)
+
+
+def maybe_start_from_env() -> bool:
+    """Start the process watchdog when env opts in
+    (``LIGHTGBM_TPU_WATCHDOG=1`` or any ``LIGHTGBM_TPU_SLO_*`` set);
+    idempotent.  Returns whether the sentry is running."""
+    if global_watchdog.running:
+        return True
+    opted = os.environ.get(_WATCHDOG_ENV, "") not in ("", "0")
+    cfg = SLOConfig.from_env()
+    if not opted and cfg.trees_per_sec_floor is None \
+            and cfg.serving_p99_ms is None \
+            and _env_float(_SLO_STALE_ENV) is None:
+        return False
+    global_watchdog.config = cfg
+    global_watchdog.start()
+    return True

@@ -41,6 +41,7 @@ from typing import Optional, Tuple, Union
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.flight import global_flight as _flight
 from ..obs.trace import span as _span
 
 # hybrid mesh axis names (outermost-first: slices over DCN, devices of a
@@ -111,6 +112,12 @@ def psum_tiered(x, axis_name: AxisName, *, hierarchical: bool = False,
     names = axis_names(axis_name)
     if not names:
         return x
+    # trace-time only (once per compile): the flight ring records which
+    # reduction route this program was built with — a forensic bundle
+    # from a pod failure shows the elected ladder without a trace file
+    _flight.note("collective.route", tiers=list(names),
+                 hierarchical=bool(hierarchical and len(names) > 1),
+                 pinned=bool(pinned), bytes=_nbytes(x))
     if pinned:
         for ax in reversed(names):
             with _span("collective.reduce", tier=ax, bytes=_nbytes(x),

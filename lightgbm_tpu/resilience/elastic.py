@@ -68,17 +68,27 @@ def membership_probe(allgather_bytes: Callable[[bytes], List[bytes]],
     with a fresh transport for the smaller world.
     """
     cfg = config or ResilienceConfig(deadline_s=10.0, max_retries=2)
+    from ..obs.flight import global_flight
     try:
+        # flight_dump=False: the SliceLostError bundle below is the
+        # specific forensic record — one event must not dump twice
         parts = resilient_allgather(
             _STAMP.pack(_MAGIC, rank), allgather_bytes,
             world=world, rank=rank, config=cfg,
-            label="membership_probe", metrics=metrics)
+            label="membership_probe", metrics=metrics,
+            flight_dump=False)
     except CollectiveError as e:
-        raise SliceLostError(world, str(e)) from e
+        err = SliceLostError(world, str(e))
+        # a lost slice is exactly the 3am event the flight recorder
+        # exists for: bundle the ring + mesh fingerprint before raising
+        global_flight.on_exception("elastic.membership", err)
+        raise err from e
     members = []
     for p in parts:
         if len(p) != _STAMP.size or p[:4] != _MAGIC:
-            raise SliceLostError(world, f"malformed member stamp {p!r}")
+            err = SliceLostError(world, f"malformed member stamp {p!r}")
+            global_flight.on_exception("elastic.membership", err)
+            raise err
         members.append(int(_STAMP.unpack(p)[1]))
     return sorted(members)
 

@@ -140,7 +140,8 @@ def resilient_allgather(payload: bytes,
                         *, world: int, rank: int,
                         config: Optional[ResilienceConfig] = None,
                         label: str = "allgather",
-                        metrics=None) -> List[bytes]:
+                        metrics=None,
+                        flight_dump: bool = True) -> List[bytes]:
     """Allgather ``payload`` across ``world`` ranks, surviving transient
     transport faults; returns the unframed per-rank payloads.
 
@@ -157,7 +158,9 @@ def resilient_allgather(payload: bytes,
     if metrics is None:
         from ..obs.metrics import global_registry
         metrics = global_registry
+    from ..obs.flight import global_flight
     from ..obs.trace import span as _span
+    from ..obs.watchdog import beat as _beat
     deadline = time.monotonic() + cfg.deadline_s
     rng = np.random.RandomState(
         (int(cfg.jitter_seed) * 1000003 + rank * 7919) % (2 ** 31))
@@ -172,10 +175,19 @@ def resilient_allgather(payload: bytes,
         remaining = deadline - time.monotonic()
         if remaining <= 0 or attempt > cfg.max_retries:
             bump("collective_aborts")
-            raise CollectiveError(
+            err = CollectiveError(
                 f"{label}: rank {rank} aborting after {attempt} attempt(s) "
                 f"({'deadline exceeded' if remaining <= 0 else 'retries exhausted'}); "
                 f"last failure: {last_reason}")
+            # consistent abort = the forensic moment: every rank dumps
+            # its own bundle (ring shows this rank's retry ladder).
+            # flight_dump=False spares the bounded dump budget for
+            # callers whose failure is benign (pod telemetry) or who
+            # dump a more specific bundle themselves (membership probe)
+            if flight_dump:
+                global_flight.on_exception("collective", err)
+            raise err
+        _beat("collective.allgather", count=attempt)
         att_span = _span("allgather.attempt", label=label, rank=rank,
                          attempt=attempt)
         with att_span:
@@ -219,6 +231,11 @@ def resilient_allgather(payload: bytes,
                     reason = reason or f"verdict round failed: {e!r}"
             att_span.set(ok=ok, committed=committed,
                          reason=(reason or "")[:120])
+        # the flight ring sees every attempt outcome even with tracing
+        # off — a CollectiveError bundle must show the retry ladder
+        global_flight.note("allgather.attempt", label=label, rank=rank,
+                           attempt=attempt, ok=ok, committed=committed,
+                           reason=(reason or "")[:120])
         if committed:
             if attempt > 0:
                 log_warning(f"{label}: rank {rank} recovered after "

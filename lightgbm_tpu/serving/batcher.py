@@ -34,7 +34,9 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..obs.flight import global_flight as _flight
 from ..obs.trace import span as _span
+from ..obs.watchdog import beat as _beat
 from .errors import DeadlineExceeded, ServerClosed
 
 
@@ -213,6 +215,10 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         while True:
+            # liveness heartbeat every scheduler turn (idle turns wake at
+            # the pop timeout): a dead batcher thread goes stale within
+            # ~0.1s of real time, whatever the queue holds (watchdog.py)
+            _beat("serving.batcher")
             item = self._pop(timeout=0.1)
             if item is None:
                 with self._lock:
@@ -269,6 +275,10 @@ class MicroBatcher:
     def _record_batch(self, batch: Batch) -> None:
         m = self.metrics
         m.counter("batches_total").inc()
+        # the flight ring sees every dispatched batch even with tracing
+        # off (forensics for a wedged/quarantined serving process)
+        _flight.note("serving.batch", rows=batch.rows,
+                     bucket=batch.bucket, items=len(batch.items))
         m.histogram("batch_rows", buckets=tuple(
             float(b) for b in self.ladder.buckets)).observe(batch.rows)
         from .metrics import RATIO_BUCKETS

@@ -326,14 +326,24 @@ class ModelRegistry:
             raise
         except Exception as e:  # noqa: BLE001 — any probe failure quarantines
             self.metrics.counter("swap_quarantines").inc()
-            raise SwapQuarantined(
+            raise self._quarantine(SwapQuarantined(
                 f"hot-swap candidate {model.digest} failed its probe batch "
-                f"({rows} rows): {e!r}; swap rolled back") from e
+                f"({rows} rows): {e!r}; swap rolled back"),
+                digest=model.digest) from e
         if not np.isfinite(raw).all():
             self.metrics.counter("swap_quarantines").inc()
-            raise SwapQuarantined(
+            raise self._quarantine(SwapQuarantined(
                 f"hot-swap candidate {model.digest} produced non-finite "
-                f"probe output; swap rolled back")
+                f"probe output; swap rolled back"), digest=model.digest)
+
+    def _quarantine(self, err: SwapQuarantined, **extra) -> SwapQuarantined:
+        """Flight-dump the quarantine (the serving pointer never flipped
+        — this bundle is the postmortem of WHY) and hand back the error
+        for the caller to raise.  Dumping never raises (flight.py)."""
+        from ..obs.flight import global_flight
+        global_flight.dump(f"serving.swap:{type(err).__name__}", exc=err,
+                           extra=extra or None)
+        return err
 
     def _probe_rows(self, model: CompiledModel) -> np.ndarray:
         """Probe rows for the low-precision accuracy measurement: the
@@ -361,10 +371,12 @@ class ModelRegistry:
         if self.accuracy_budget is not None and delta > self.accuracy_budget:
             self.metrics.counter("swap_quarantines").inc()
             self.metrics.counter("lowprec_quarantines").inc()
-            raise LowPrecisionQuarantined(
+            raise self._quarantine(LowPrecisionQuarantined(
                 f"{model.precision} candidate {model.digest} measured "
                 f"probe accuracy delta {delta:.3e} over the declared "
-                f"budget {self.accuracy_budget:.3e}; not promoted")
+                f"budget {self.accuracy_budget:.3e}; not promoted"),
+                digest=model.digest, precision=model.precision,
+                accuracy_delta=delta)
 
     def swap(self, booster, warm: bool = True, block: bool = True,
              num_iteration: Optional[int] = None,

@@ -170,6 +170,17 @@ class Server:
         # live server as a named component; detached at close()
         self._obs_component = _obs_registry.attach_child(
             "serving", self.metrics)
+        # active observability: hold this server's request p99 to the
+        # configured SLO ceiling (watchdog.py; never breaches unless a
+        # ceiling is set), and start the env-gated metrics endpoint
+        from ..obs.http import maybe_start_from_env as _http_from_env
+        from ..obs.watchdog import (global_watchdog,
+                                    maybe_start_from_env as _wd_from_env)
+        self._wd_hist = f"serving_p99:{self._obs_component}"
+        global_watchdog.watch_histogram_p99(
+            self._wd_hist, self.metrics.histogram("request_latency_ms"))
+        _wd_from_env()
+        _http_from_env()
 
     @staticmethod
     def _resolve_aot(aot_dir):
@@ -393,6 +404,8 @@ class Server:
         self._closed = True
         self._batcher.close(drain=drain, timeout=timeout)
         _obs_registry.detach_child(self._obs_component)
+        from ..obs.watchdog import global_watchdog
+        global_watchdog.unwatch_histogram(self._wd_hist)
 
     def __enter__(self) -> "Server":
         return self

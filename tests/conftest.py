@@ -15,8 +15,17 @@ persistent XLA compilation cache so repeated runs skip recompiles).
 """
 import os
 import sys
+import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the always-on flight recorder (obs/flight.py) dumps forensic bundles
+# on quarantine/chaos triggers many tests exercise on purpose; keep the
+# bundles out of the repo checkout (tests that assert on them point the
+# recorder at their own tmp_path)
+if "LIGHTGBM_TPU_FLIGHT_DIR" not in os.environ:
+    os.environ["LIGHTGBM_TPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="lgbt-flight-test-")
 
 from lightgbm_tpu.utils.platform import force_cpu_inprocess  # noqa: E402
 

@@ -106,6 +106,96 @@ def test_bench_collective_probe_stage(tmp_path):
     assert "collective_probe" in d["stages"]
 
 
+def test_bench_diff_gate(tmp_path):
+    """tools/bench_diff.py is the perf gate: an unchanged journal passes
+    (exit 0), a synthetic 2x sec_per_tree regression is flagged by name
+    with a nonzero exit, and the last stdout line is one JSON verdict."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = {"fingerprint": "fp", "stages": {
+        "full@200000": {"sec_per_tree": 0.5, "value": 25.0,
+                        "holdout_auc": 0.965, "iters_per_sec": 2.0,
+                        "compile_seconds": 8.0,
+                        "compile_cache": {"entries_after": 4}},
+        "serving": {"p99_ms": 12.0, "qps": 900.0}}}
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(base))
+
+    def run(old, new, *extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "bench_diff.py"),
+             str(old), str(new), *extra],
+            capture_output=True, text=True, timeout=60)
+
+    proc = run(a, b)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True and verdict["regressions"] == []
+    assert verdict["stages_compared"] == 2
+
+    worse = json.loads(json.dumps(base))
+    worse["stages"]["full@200000"]["sec_per_tree"] = 1.0     # 2x slower
+    c = tmp_path / "regressed.json"
+    c.write_text(json.dumps(worse))
+    proc = run(a, c)
+    assert proc.returncode == 1, proc.stdout
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is False
+    regressed = {r["metric"] for r in verdict["regressions"]}
+    assert regressed == {"sec_per_tree"}
+    assert "REGRESSION" in proc.stdout
+
+    # per-metric threshold override loosens the gate
+    proc = run(a, c, "--threshold", "sec_per_tree=2.5")
+    assert proc.returncode == 0, proc.stdout
+
+    # a higher-is-better metric collapsing to ZERO must not slip through
+    # the sub-noise-floor branch (qps=0 IS the regression)
+    dead = json.loads(json.dumps(base))
+    dead["stages"]["serving"]["qps"] = 0.0
+    e = tmp_path / "collapsed.json"
+    e.write_text(json.dumps(dead))
+    proc = run(a, e)
+    assert proc.returncode == 1, proc.stdout
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert {r["metric"] for r in verdict["regressions"]} == {"qps"}
+
+    # a BENCH_r*.json driver file compares as stage "full" — but only
+    # against a side that HAS a full stage; here: driver file vs itself
+    d = tmp_path / "driver.json"
+    d.write_text(json.dumps(
+        {"n": 1, "rc": 0, "parsed": {"sec_per_tree": 0.7, "value": 35.0}}))
+    proc = run(d, d)
+    assert proc.returncode == 0, proc.stdout
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["stages_compared"] == 1
+
+    # unreadable input is a distinct exit code, still one JSON line
+    proc = run(a, tmp_path / "missing.json")
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["ok"] is False
+
+
+def test_bench_obs_doctor_stage(tmp_path):
+    """The journaled obs_doctor stage (BENCH_SKIP_OBS honored, errors
+    never journaled): runs last, emits ranked verdicts next to the
+    banked telemetry, and banks under its own key."""
+    journal = str(tmp_path / "journal.json")
+    stages = _run_worker({"BENCH_JOURNAL": journal,
+                          "BENCH_ONLY": "obs_doctor"})
+    doc = [s for s in stages
+           if s["stage"] == "obs_doctor" and "error" not in s]
+    assert doc, stages
+    out = doc[0]
+    assert "top_verdict" in out and "verdicts" in out
+    assert isinstance(out["verdicts"], list) and out["verdicts"]
+    for v in out["verdicts"]:
+        assert {"name", "score", "summary", "evidence"} <= set(v)
+    d = json.load(open(journal))
+    assert "obs_doctor" in d["stages"]
+
+
 def test_bench_journal_fingerprint_invalidation(tmp_path, monkeypatch):
     """A journal written under a different workload shape must not be
     replayed (stale telemetry masquerading as current is worse than a

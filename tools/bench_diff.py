@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""bench_diff: stage-by-stage comparison of two bench journals — the
+perf gate future PRs run before claiming "no regression".
+
+Compares every stage the two journals share, metric by metric, against
+per-metric regression thresholds with known polarity (sec_per_tree UP is
+a regression, iters_per_sec DOWN is, holdout_auc has an absolute-delta
+budget).  Metrics without a registered polarity are reported as info,
+never gated — a new field can land without breaking the gate.
+
+Inputs (either side): a bench journal (``bench_journal.json``:
+``{"fingerprint", "stages": {...}}``; the fingerprint is informational
+here — cross-shape comparisons print a warning, thresholds still apply),
+a driver result file (``BENCH_r*.json``: the ``parsed`` record becomes
+stage "full"), or a bare ``{stage: result}`` map.
+
+Output: a human table (stage / metric / old / new / ratio / verdict) and
+a LAST-LINE single JSON verdict; exit 0 = no regression, 1 = regression,
+2 = unreadable input.
+
+Usage:
+    python tools/bench_diff.py OLD NEW \
+        [--threshold sec_per_tree=1.10] [--stage full] [--json-only]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# metric -> (polarity, default threshold).  Polarities:
+#   lower      — lower is better; regression when new/old > threshold
+#   higher     — higher is better; regression when old/new > threshold
+#   higher_abs — higher is better; regression when old - new > threshold
+#                (absolute delta budget: quality metrics near 1.0)
+THRESHOLDS = {
+    "sec_per_tree": ("lower", 1.25),
+    "sec_per_tree_train": ("lower", 1.25),
+    "sec_per_tree_total": ("lower", 1.30),
+    "sec_per_tree_chunked": ("lower", 1.25),
+    "value": ("lower", 1.25),
+    "elapsed": ("lower", 1.50),
+    "compile_seconds": ("lower", 1.50),
+    "bin_seconds": ("lower", 1.50),
+    "iters_per_sec": ("higher", 1.25),
+    "iters_per_sec_chunked": ("higher", 1.25),
+    "trees_per_sec": ("higher", 1.25),
+    "qps": ("higher", 1.25),
+    "rows_per_sec": ("higher", 1.25),
+    "blocks_per_sec": ("higher", 1.30),
+    "overlap_efficiency": ("higher", 1.20),
+    "p50_ms": ("lower", 1.50),
+    "p90_ms": ("lower", 1.50),
+    "p99_ms": ("lower", 1.50),
+    "holdout_auc": ("higher_abs", 0.005),
+    "auc": ("higher_abs", 0.005),
+    "ndcg10": ("higher_abs", 0.005),
+    "mfu_histogram_lower_bound": ("higher", 2.0),
+}
+# a tiny absolute floor below which timing ratios are noise, not signal
+ABS_FLOOR = {"compile_seconds": 0.5, "bin_seconds": 0.5, "elapsed": 1.0}
+
+
+def load_stages(path):
+    """Normalize any supported file shape to (fingerprint|None,
+    {stage: result-dict})."""
+    with open(path) as fh:
+        d = json.load(fh)
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if isinstance(d.get("stages"), dict):
+        return d.get("fingerprint"), {
+            k: v for k, v in d["stages"].items() if isinstance(v, dict)}
+    if isinstance(d.get("parsed"), dict):        # BENCH_r*.json driver file
+        return None, {"full": d["parsed"]}
+    if all(isinstance(v, dict) for v in d.values()) and d:
+        return None, d
+    # single bare stage result
+    return None, {"full": d}
+
+
+def _flat_metrics(stage_result, prefix=""):
+    """Numeric leaves one level deep (``compile_cache.entries_after``
+    style nested dicts flatten with a dotted key)."""
+    out = {}
+    for k, v in stage_result.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict) and not prefix:       # one level only
+            out.update(_flat_metrics(v, prefix=f"{k}."))
+    return out
+
+
+def _rule_for(metric, overrides):
+    """(polarity, threshold) for a metric key; dotted keys match their
+    leaf name (``client.p99_ms`` -> ``p99_ms``)."""
+    leaf = metric.rsplit(".", 1)[-1]
+    if metric in overrides:
+        pol = THRESHOLDS.get(metric, THRESHOLDS.get(leaf, ("lower", 0)))[0]
+        return pol, overrides[metric]
+    if leaf in overrides:
+        pol = THRESHOLDS.get(leaf, ("lower", 0))[0]
+        return pol, overrides[leaf]
+    if metric in THRESHOLDS:
+        return THRESHOLDS[metric]
+    if leaf in THRESHOLDS:
+        return THRESHOLDS[leaf]
+    return None, None
+
+
+def compare(old_stages, new_stages, overrides=None, only_stage=None):
+    """Row-per-metric comparison across shared stages.  Returns (rows,
+    verdict-dict)."""
+    overrides = overrides or {}
+    rows, regressions = [], []
+    shared = sorted(set(old_stages) & set(new_stages))
+    if only_stage:
+        shared = [s for s in shared if s == only_stage
+                  or s.startswith(f"{only_stage}@")]
+    for stage in shared:
+        a = _flat_metrics(old_stages[stage])
+        b = _flat_metrics(new_stages[stage])
+        for metric in sorted(set(a) & set(b)):
+            old, new = a[metric], b[metric]
+            pol, thr = _rule_for(metric, overrides)
+            row = {"stage": stage, "metric": metric,
+                   "old": old, "new": new,
+                   "ratio": round(new / old, 4) if old else None}
+            if pol is None:
+                row["status"] = "info"
+            elif pol == "higher_abs":
+                delta = old - new
+                row["status"] = ("regression" if delta > thr else
+                                 "improved" if -delta > thr else "ok")
+                row["threshold"] = thr
+            else:
+                leaf = metric.rsplit(".", 1)[-1]
+                floor = ABS_FLOOR.get(leaf, 0.0)
+                if pol == "higher" and new <= 0 < old:
+                    # a good-metric collapse to zero must never pass as
+                    # "sub-noise-floor ok" (qps=0 IS the regression)
+                    row["status"] = "regression"
+                    row["threshold"] = thr
+                elif max(abs(old), abs(new)) <= floor or old <= 0 or new <= 0:
+                    row["status"] = "ok"        # sub-noise-floor values
+                else:
+                    worse = (new / old) if pol == "lower" else (old / new)
+                    row["status"] = ("regression" if worse > thr else
+                                     "improved" if worse < 1.0 / thr
+                                     else "ok")
+                    row["threshold"] = thr
+            if row["status"] == "regression":
+                regressions.append({k: row[k] for k in
+                                    ("stage", "metric", "old", "new",
+                                     "ratio", "threshold")})
+            rows.append(row)
+    verdict = {
+        "ok": not regressions,
+        "regressions": regressions,
+        "stages_compared": len(shared),
+        "metrics_compared": sum(1 for r in rows if r["status"] != "info"),
+        "improvements": sum(1 for r in rows if r["status"] == "improved"),
+    }
+    return rows, verdict
+
+
+def format_table(rows):
+    if not rows:
+        return "bench_diff: no shared stages/metrics to compare"
+    w_stage = max(len(r["stage"]) for r in rows)
+    w_metric = max(len(r["metric"]) for r in rows)
+    lines = [f"{'stage':<{w_stage}}  {'metric':<{w_metric}}  "
+             f"{'old':>12}  {'new':>12}  {'ratio':>7}  verdict"]
+    for r in rows:
+        if r["status"] == "info":
+            continue
+        ratio = f"{r['ratio']:.3f}" if r["ratio"] is not None else "-"
+        mark = {"regression": "REGRESSION", "improved": "improved",
+                "ok": "ok"}[r["status"]]
+        lines.append(
+            f"{r['stage']:<{w_stage}}  {r['metric']:<{w_metric}}  "
+            f"{r['old']:>12.4f}  {r['new']:>12.4f}  {ratio:>7}  {mark}")
+    return "\n".join(lines)
+
+
+def parse_overrides(pairs):
+    out = {}
+    for p in pairs or []:
+        k, _, v = p.partition("=")
+        if not k or not v:
+            raise ValueError(f"bad --threshold {p!r} (want metric=value)")
+        out[k] = float(v)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("old", help="baseline journal / BENCH_r*.json")
+    ap.add_argument("new", help="candidate journal / BENCH_r*.json")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="METRIC=RATIO",
+                    help="override a per-metric threshold")
+    ap.add_argument("--stage", default=None,
+                    help="restrict the comparison to one stage")
+    ap.add_argument("--json-only", action="store_true")
+    args = ap.parse_args()
+    try:
+        fp_a, old_stages = load_stages(args.old)
+        fp_b, new_stages = load_stages(args.new)
+        overrides = parse_overrides(args.threshold)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 2
+    if fp_a and fp_b and fp_a != fp_b and not args.json_only:
+        print(f"bench_diff: WARNING — workload fingerprints differ "
+              f"({fp_a!r} vs {fp_b!r}); comparing anyway", file=sys.stderr)
+    rows, verdict = compare(old_stages, new_stages, overrides,
+                            only_stage=args.stage)
+    if not args.json_only:
+        print(format_table(rows))
+        print()
+    print(json.dumps(verdict, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""obs_doctor: automated bottleneck diagnosis over the banked bench
+journal + a metrics snapshot (lightgbm_tpu/obs/diagnose.py,
+docs/OBSERVABILITY.md verdict taxonomy).
+
+Joins measured signals (devprof MFU tables, compile-cache warmth,
+stream-probe overlap efficiency, straggler skew) with
+planner-predicted ones (per-tier ICI/DCN payload bytes, link models)
+and prints RANKED verdicts — "DCN-bound", "compile-bound",
+"input-bound", "straggler slice k", "kernel-underutilized" — each with
+the evidence behind it.  The LAST stdout line is one JSON summary (the
+shape the bench journals as the ``obs_doctor`` stage).
+
+Usage:
+    python tools/obs_doctor.py \
+        [--journal bench_journal.json]   # banked bench stages
+        [--metrics bench_obs_metrics.json]  # registry snapshot file
+        [--json-only]                    # machine consumers
+Exit codes: 0 = diagnosed (whatever the verdict), 2 = input unreadable.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_journal_stages(path):
+    """Banked stages from a bench journal ({} when absent); tolerant of
+    both the fingerprint-wrapped layout and a bare stage map."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        d = json.load(fh)
+    if isinstance(d, dict) and isinstance(d.get("stages"), dict):
+        return d["stages"]
+    return d if isinstance(d, dict) else {}
+
+
+def load_metrics_snapshot(path):
+    """A dumped registry snapshot re-wrapped so ``collect_signals`` can
+    read it like a live registry (duck-typed: only ``to_dict`` is
+    consulted)."""
+    if not path or not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        snap = json.load(fh)
+
+    class _Snap:
+        def to_dict(self):
+            return snap
+
+    return _Snap()
+
+
+def run_doctor(stages=None, registry=None):
+    """collect -> diagnose -> summary (the bench ``obs_doctor`` stage
+    entry point; falls back to the live process registry)."""
+    from lightgbm_tpu.obs.diagnose import run_doctor as _run
+    return _run(registry=registry, stages=stages)
+
+
+def format_human(report):
+    lines = [f"obs_doctor: top verdict = {report['top_verdict']}", ""]
+    for i, v in enumerate(report["verdicts"], 1):
+        lines.append(f"{i}. [{v['name']}] score={v['score']:.2f}")
+        lines.append(f"   {v['summary']}")
+        if v["evidence"]:
+            ev = ", ".join(f"{k}={v['evidence'][k]}"
+                           for k in sorted(v["evidence"]))
+            lines.append(f"   evidence: {ev}")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--journal",
+                    default=os.environ.get(
+                        "BENCH_JOURNAL",
+                        os.path.join(REPO, "bench_journal.json")))
+    ap.add_argument("--metrics",
+                    default=os.path.join(REPO, "bench_obs_metrics.json"))
+    ap.add_argument("--json-only", action="store_true")
+    args = ap.parse_args()
+    try:
+        stages = load_journal_stages(args.journal)
+        registry = load_metrics_snapshot(args.metrics)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"error": f"unreadable input: {e}"}))
+        return 2
+    report = run_doctor(stages=stages, registry=registry)
+    if not args.json_only:
+        print(format_human(report))
+    print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
