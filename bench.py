@@ -1179,6 +1179,26 @@ def tpu_worker():
                             max_bin=MAX_BIN, leaves=LEAVES, trees=TREES)
         run_stage("collective_probe", _coll_probe)
 
+    # tpulint (tools/lint.py, docs/LINTING.md): the static-analysis
+    # suite runs as a journaled stage so every bench round records that
+    # the tree it measured was invariant-clean; violations raise, and
+    # errors are never journaled (run_stage), so a dirty tree re-lints
+    # on the next round instead of banking a stale verdict
+    if os.environ.get("BENCH_SKIP_LINT") != "1":
+        def _lint():
+            if REPO not in sys.path:
+                sys.path.insert(0, REPO)
+            from tools.lint import load_project, run_lint
+            project = load_project(root=REPO)
+            violations = run_lint(project)
+            if violations:
+                raise RuntimeError(
+                    f"tpulint: {len(violations)} violation(s), first: "
+                    + violations[0].render())
+            return {"ok": True, "files": len(project.files),
+                    "violations": 0}
+        run_stage("lint", _lint)
+
     # whole-plane observability smoke (tools/obs_dump.py): a tiny
     # instrumented train+serve cycle dumping trace/metrics/prometheus
     # artifacts — cheap, banked before the long stages; errors are never

@@ -175,7 +175,8 @@ class Application:
             pred_contrib=str(p.get("predict_contrib", "false")).lower() == "true",
         )
         pred = np.atleast_1d(pred)
-        with open(output_result, "w") as fh:
+        from .utils.file_io import open_atomic
+        with open_atomic(output_result, "w") as fh:
             if pred.ndim == 1:
                 for v in pred:
                     fh.write(f"{v:.18g}\n")
@@ -192,8 +193,8 @@ class Application:
         input_model = p.get("input_model", "LightGBM_model.txt")
         out = p.get("convert_model", "gbdt_prediction.cpp")
         booster = Booster(model_file=_resolve(input_model, p))
-        with open(out, "w") as fh:
-            fh.write(model_to_if_else(booster))
+        from .utils.file_io import write_atomic
+        write_atomic(out, model_to_if_else(booster))
         log_info(f"Finished converting model; saved to {out}")
 
 

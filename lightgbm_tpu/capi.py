@@ -765,8 +765,8 @@ def LGBM_BoosterPredictForFile(booster_handle: int, data_filename: str,
                              {"header": bool(data_has_header)})
     pred = _predict_with_type(bst, X, predict_type, num_iteration)
     pred = np.asarray(pred)
-    from .utils.file_io import open_file
-    with open_file(str(result_filename), "w") as fh:
+    from .utils.file_io import open_atomic
+    with open_atomic(str(result_filename), "w") as fh:
         for row in (pred if pred.ndim > 1 else pred[:, None]):
             fh.write("\t".join(repr(float(v)) for v in row) + "\n")
     return 0

@@ -994,9 +994,11 @@ class Dataset:
         feature names, then one line of per-feature BIN values per row.
         Not loadable back; for debugging parity only."""
         self.construct()
-        from .utils.file_io import open_file
+        from .utils.file_io import open_atomic
         F = len(self.used_features)
-        with open_file(filename, "w") as fh:
+        # streamed row-by-row (num_data lines): open_atomic keeps the
+        # per-row write with O(1) extra memory and still lands atomically
+        with open_atomic(filename, "w") as fh:
             fh.write(f"num_features: {F}\n")
             fh.write(f"num_total_features: {self.num_total_features}\n")
             fh.write(f"num_groups: {self.num_groups}\n")
@@ -1172,15 +1174,20 @@ class Dataset:
             "has_group": self.metadata.query_boundaries is not None,
             "has_init_score": self.metadata.init_score is not None,
         }
-        from .utils.file_io import open_file
-        with open_file(filename, "wb") as fh:
+        # a binary cache is reloaded by later runs: a crash mid-write must
+        # not leave a truncated file that load_binary trusts — stream
+        # through the atomic seam (the binned matrix can be GBs; no
+        # second resident copy)
+        from .utils.file_io import open_atomic
+        with open_atomic(filename, "wb") as fh:
             fh.write(_BINARY_MAGIC)
             hdr = json.dumps(meta).encode()
             fh.write(len(hdr).to_bytes(8, "little"))
             fh.write(hdr)
             fh.write(np.ascontiguousarray(self.binned).tobytes())
             for arr in (self.metadata.label, self.metadata.weight,
-                        self.metadata.query_boundaries, self.metadata.init_score):
+                        self.metadata.query_boundaries,
+                        self.metadata.init_score):
                 if arr is not None:
                     fh.write(np.ascontiguousarray(arr).tobytes())
         return self

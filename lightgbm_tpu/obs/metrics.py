@@ -279,8 +279,10 @@ class MetricsRegistry:
     def dump_json(self, path: Optional[str] = None, indent: int = 1) -> str:
         s = json.dumps(self.to_dict(), indent=indent, sort_keys=True)
         if path is not None:
-            with open(path, "w") as f:
-                f.write(s)
+            # bench stages and operators read these snapshots back; the
+            # atomic seam means a scrape never sees a half-written one
+            from ..utils.file_io import write_atomic
+            write_atomic(path, s)
         return s
 
     def to_prometheus(self, prefix: str = "lgbt") -> str:

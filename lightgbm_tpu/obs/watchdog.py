@@ -111,9 +111,9 @@ class Watchdog:
         self._beats: dict = {}        # name -> (monotonic ts, count|None)
         self._watched: dict = {}      # name -> stale threshold seconds
         self._floors: dict = {}       # name -> rate floor (units/sec)
-        self._rate_state: dict = {}   # name -> (ts, count) at last check
+        self._rate_state: dict = {}   # guarded-by: _lock (ts, count)/name
         self._hists: dict = {}        # name -> (Histogram, ceiling_ms)
-        self._breached: set = set()   # active breaches (edge detection)
+        self._breached: set = set()   # guarded-by: _lock (edge detection)
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -175,8 +175,19 @@ class Watchdog:
     # -------------------------------------------------------------- checks
 
     def _breach(self, slo: str, evidence: dict) -> None:
-        rising = slo not in self._breached
-        self._breached.add(slo)
+        # the sentry thread and a caller's unwatch() both touch the
+        # breach set; the rising-edge read must pair with the add, and a
+        # breach computed from a pre-unwatch snapshot must not re-enter
+        # the set after unwatch cleared it (that would both alarm for an
+        # activity that exited cleanly and suppress the NEXT watch's
+        # rising-edge dump)
+        name = slo.split(":", 1)[-1]
+        with self._lock:
+            if name not in self._watched and name not in self._floors \
+                    and name not in self._hists:
+                return
+            rising = slo not in self._breached
+            self._breached.add(slo)
         try:
             self._reg().counter("slo_breach_total",
                                 labels={"slo": slo}).inc()
@@ -189,7 +200,8 @@ class Watchdog:
             self._fl().dump(f"watchdog:{slo}", extra=evidence)
 
     def _clear(self, slo: str) -> None:
-        self._breached.discard(slo)
+        with self._lock:
+            self._breached.discard(slo)
 
     def check_once(self, now: Optional[float] = None) -> list:
         """One synchronous sweep; returns the list of (slo, evidence)
@@ -216,8 +228,9 @@ class Watchdog:
             if ts_count is None or ts_count[1] is None:
                 continue
             ts, count = ts_count
-            prev = self._rate_state.get(name)
-            self._rate_state[name] = (ts, count)
+            with self._lock:    # watch/unwatch reset this concurrently
+                prev = self._rate_state.get(name)
+                self._rate_state[name] = (ts, count)
             if prev is None or ts <= prev[0]:
                 continue
             rate = (count - prev[1]) / (ts - prev[0])

@@ -131,9 +131,9 @@ class MicroBatcher:
         self.metrics = metrics
         self.batch_window_s = max(batch_window_ms, 0.0) / 1e3
         self.max_queue_rows = max_queue_rows
-        self._q = collections.deque()
-        self._carry: Optional[WorkItem] = None
-        self._queued_rows = 0
+        self._q = collections.deque()           # guarded-by: _lock
+        self._carry: Optional[WorkItem] = None  # guarded-by: _lock
+        self._queued_rows = 0                   # guarded-by: _lock
         self._lock = threading.Lock()
         self._work_ready = threading.Condition(self._lock)
         self._closed = False

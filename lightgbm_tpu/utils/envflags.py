@@ -1,0 +1,222 @@
+"""Central registry of every environment flag the project reads.
+
+The codebase is steered by ``LGBM_TPU_*`` / ``LIGHTGBM_TPU_*`` (library
+behavior) and ``BENCH_*`` (bench driver) env gates.  Before this module
+they lived as string literals scattered over ~20 files with no single
+place answering "what knobs exist, what do they default to, and where
+are they documented".  Every flag must be declared here — ``tpulint``'s
+``env-flag-registry`` rule (tools/lint/) fails any matching string
+literal in the tree that this registry does not know, any registry
+entry whose name is absent from its declared doc file, and any stale
+entry no code reads anymore.
+
+This module is declarative and import-cheap (stdlib only, no jax): the
+reading call sites keep their existing ``os.environ.get(...)`` idiom —
+rewiring ~70 call sites through one accessor would churn every module
+for zero behavioral gain — but new flags MUST be registered here first
+or lint fails the PR by name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvFlag:
+    """One environment knob: its default (textual, '' = unset), the
+    module that reads it, a one-line doc, and the docs/ file that must
+    mention it by name (the lint docs anchor)."""
+
+    name: str
+    default: str
+    consumer: str
+    doc: str
+    docfile: str
+
+
+def _f(name: str, default: str, consumer: str, doc: str,
+       docfile: str) -> EnvFlag:
+    return EnvFlag(name, default, consumer, doc, docfile)
+
+
+_PERF = "docs/PERF.md"
+_PERFORMANCE = "docs/PERFORMANCE.md"
+_OBS = "docs/OBSERVABILITY.md"
+
+FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
+    # ------------------------------------------------ kernel/planner gates
+    _f("LGBM_TPU_FUSED", "1", "ops/fused.py",
+       "fused histogram->split megakernel eligibility ('0' disables)", _PERF),
+    _f("LGBM_TPU_SEGHIST", "", "ops/histogram.py",
+       "force a histogram kernel family, bypassing the planner", _PERF),
+    _f("LGBM_TPU_TABLE_MATMUL", "", "ops/histogram.py",
+       "'0' demotes take_from_table's matmul gather to plain gather",
+       _PERFORMANCE),
+    _f("LGBM_TPU_SMALL_ROUNDS", "1", "ops/histogram.py",
+       "small-frontier rounds kernel election ('0' disables)", _PERFORMANCE),
+    _f("LGBM_TPU_PACK", "1", "grower_rounds.py",
+       "packed per-level rounds program ('0' disables)", _PERFORMANCE),
+    _f("LGBM_TPU_ROUTER", "1", "grower_rounds.py",
+       "in-program row router ('0' disables)", _PERFORMANCE),
+    _f("LGBM_TPU_HBM_BYTES", "", "ops/planner.py",
+       "override detected device HBM capacity (bytes)", _PERF),
+    _f("LGBM_TPU_VMEM_BYTES", "", "ops/planner.py",
+       "override the VMEM budget the fused-kernel model plans against",
+       _PERF),
+    _f("LGBM_TPU_HOST_BYTES", "", "ops/planner.py",
+       "override the host-RSS budget for the streaming planner", _PERF),
+    _f("LGBM_TPU_TILE_ROWS", "", "ops/planner.py",
+       "force the histogram row-tile size ('0' = untiled)", _PERF),
+    _f("LGBM_TPU_ICI_GBPS", "", "ops/planner.py",
+       "per-link ICI bandwidth (GB/s) for the collective link model",
+       _PERF),
+    _f("LGBM_TPU_DCN_GBPS", "", "ops/planner.py",
+       "DCN bandwidth (GB/s) for the collective link model", _PERF),
+    _f("LGBM_TPU_HIER_REDUCE", "", "ops/planner.py",
+       "force ('1') / forbid ('0') tiered ICIxDCN reductions", _PERF),
+    _f("LGBM_TPU_PINNED_REDUCE", "", "ops/planner.py",
+       "pin the tiered-reduction variant the planner would elect", _PERF),
+    # ------------------------------------------------------ data plane
+    _f("LGBM_TPU_STREAM", "", "ops/planner.py",
+       "force ('1') / forbid ('0') out-of-core row-block streaming", _PERF),
+    _f("LGBM_TPU_STREAM_BLOCK_ROWS", "", "ops/planner.py",
+       "force the streaming row-block size", _PERF),
+    _f("LGBM_TPU_STREAM_DIR", "", "data/stream.py",
+       "directory for the spill blockstore (default: a tmpdir)", _PERF),
+    _f("LGBM_TPU_FREE_BINNED", "", "boosting/gbdt.py",
+       "'1' frees the host binned matrix after device upload", _PERF),
+    _f("LGBM_TPU_CHUNK", "", "boosting/macro.py",
+       "macro-chunk size override ('0'/'off' disables chunking)", _PERF),
+    _f("LGBM_TPU_COMPILE_CACHE", "", "utils/platform.py, fleet/aot.py",
+       "persistent XLA compile-cache + AOT-export directory", _PERF),
+    _f("LGBT_DEFER_HOST_TREES", "", "boosting/gbdt.py",
+       "'1' defers host tree fetch to training end (legacy prefix)", _PERF),
+    # ------------------------------------------------------ parallel plane
+    _f("LGBM_TPU_NUM_SLICES", "", "parallel/learners.py",
+       "slice count for the simulated/hybrid multi-host mesh", _PERF),
+    _f("LGBM_TPU_SLICE_DEVICES", "", "parallel/network.py",
+       "devices per slice for the hybrid mesh plan", _PERF),
+    # ------------------------------------------------------ observability
+    _f("LIGHTGBM_TPU_TIMETAG", "", "utils/timer.py",
+       "'1' timer table at exit; 'json'/'json:<path>' machine form", _OBS),
+    _f("LIGHTGBM_TPU_TRACE", "", "obs/trace.py",
+       "'1' record spans; any other value also dumps Chrome JSON there",
+       _OBS),
+    _f("LIGHTGBM_TPU_TRACE_MAX_EVENTS", "1000000", "obs/trace.py",
+       "cap on the in-process span list", _OBS),
+    _f("LIGHTGBM_TPU_FLIGHT", "1", "obs/flight.py",
+       "flight recorder armed (default on); '0' disarms", _OBS),
+    _f("LIGHTGBM_TPU_FLIGHT_EVENTS", "2048", "obs/flight.py",
+       "flight ring capacity", _OBS),
+    _f("LIGHTGBM_TPU_FLIGHT_DIR", "", "obs/flight.py",
+       "flight bundle directory (default cwd)", _OBS),
+    _f("LIGHTGBM_TPU_FLIGHT_MAX_DUMPS", "8", "obs/flight.py",
+       "per-process flight dump budget", _OBS),
+    _f("LIGHTGBM_TPU_WATCHDOG", "", "obs/watchdog.py",
+       "'1' starts the SLO sentry thread at engine/server init", _OBS),
+    _f("LIGHTGBM_TPU_WATCHDOG_INTERVAL_S", "5", "obs/watchdog.py",
+       "sentry check interval (seconds)", _OBS),
+    _f("LIGHTGBM_TPU_SLO_TREES_PER_SEC", "", "obs/watchdog.py",
+       "training throughput floor (trees/sec) the sentry enforces", _OBS),
+    _f("LIGHTGBM_TPU_SLO_SERVING_P99_MS", "", "obs/watchdog.py",
+       "serving p99 latency ceiling (ms)", _OBS),
+    _f("LIGHTGBM_TPU_SLO_HEARTBEAT_S", "300", "obs/watchdog.py",
+       "heartbeat staleness threshold (seconds)", _OBS),
+    _f("LIGHTGBM_TPU_METRICS_PORT", "", "obs/http.py",
+       "opt-in HTTP metrics port ('0' = ephemeral)", _OBS),
+    _f("LIGHTGBM_TPU_METRICS_HOST", "127.0.0.1", "obs/http.py",
+       "bind host for the HTTP metrics endpoint", _OBS),
+    # ------------------------------------------------------ bench workload
+    _f("BENCH_ROWS", "11000000", "bench.py",
+       "full-stage training rows", _PERF),
+    _f("BENCH_TREES", "500", "bench.py", "full-stage tree count", _PERF),
+    _f("BENCH_LEAVES", "255", "bench.py", "num_leaves for bench stages",
+       _PERF),
+    _f("BENCH_BIN", "63", "bench.py", "max_bin for bench stages", _PERF),
+    _f("BENCH_CPU_ROWS", "200000", "bench.py",
+       "CPU-fallback stage rows", _PERF),
+    _f("BENCH_CPU_TREES", "50", "bench.py",
+       "CPU-fallback stage tree count", _PERF),
+    _f("BENCH_SMOKE_ROWS", "500000", "bench.py", "smoke-stage rows", _PERF),
+    _f("BENCH_SMOKE_TREES", "3", "bench.py",
+       "smoke-stage tree count", _PERF),
+    _f("BENCH_RANK_QUERIES", "12000", "bench.py",
+       "ranking-stage query count", _PERF),
+    _f("BENCH_RANK_DOCS", "100", "bench.py",
+       "ranking-stage docs per query", _PERF),
+    _f("BENCH_RANK_TREES", "100", "bench.py",
+       "ranking-stage tree count", _PERF),
+    _f("BENCH_STREAM_ROWS", "100000000", "bench.py",
+       "out-of-core streaming stage rows", _PERF),
+    _f("BENCH_STREAM_TREES", "3", "bench.py",
+       "out-of-core streaming stage tree count", _PERF),
+    _f("BENCH_TOTAL_BUDGET", "6600", "bench.py",
+       "wall-clock budget (seconds) the stage gates spend against", _PERF),
+    _f("BENCH_STALL_TIMEOUT", "2400", "bench.py",
+       "driver-side worker stall kill timer (seconds)", _PERF),
+    _f("BENCH_EXTRA_PARAMS", "", "bench.py",
+       "JSON dict merged into every bench stage's train params", _PERF),
+    # ------------------------------------------------------ bench plumbing
+    _f("BENCH_STAGE", "", "bench.py",
+       "internal: which worker the re-exec'd child runs", _PERF),
+    _f("BENCH_JOURNAL", "", "bench.py",
+       "journal path ('0' disables; default ./bench_journal.json)", _PERF),
+    _f("BENCH_ONLY", "", "bench.py",
+       "comma list of worker stages to run exclusively", _PERF),
+    _f("BENCH_WORKER_ROWS", "", "bench.py",
+       "internal: row count handed to the TPU worker's full stage", _PERF),
+    _f("BENCH_WORKER_ALLOW_CPU", "", "bench.py",
+       "'1' lets the TPU worker run on a CPU backend", _PERF),
+    _f("BENCH_FORCE_CPU", "", "bench.py",
+       "'1' runs only the CPU-fallback stage", _PERF),
+    _f("BENCH_PROFILE", "", "bench.py",
+       "'1' captures a jax.profiler trace around the train loop", _OBS),
+    # ------------------------------------------------------ bench skips
+    _f("BENCH_SKIP_KERNEL_PROBE", "", "bench.py",
+       "'1' skips the kernel bit-exactness probe", _PERF),
+    _f("BENCH_SKIP_DISPATCH_PROBE", "", "bench.py",
+       "'1' skips the dispatch-latency probe", _PERF),
+    _f("BENCH_SKIP_HIST_PROBE", "", "bench.py",
+       "'1' skips the histogram-variant probe", _PERF),
+    _f("BENCH_SKIP_STREAM_PROBE", "", "bench.py",
+       "'1' skips the streaming-plane probe", _PERF),
+    _f("BENCH_SKIP_COLLECTIVE_PROBE", "", "bench.py",
+       "'1' skips the collective-plane probe", _PERF),
+    _f("BENCH_SKIP_SMOKE", "", "bench.py", "'1' skips the smoke stage",
+       _PERF),
+    _f("BENCH_SKIP_STREAM", "", "bench.py",
+       "'1' skips the out-of-core streaming stage", _PERF),
+    _f("BENCH_SKIP_RANKING", "", "bench.py",
+       "'1' skips the ranking stage", _PERF),
+    _f("BENCH_SKIP_SERVING", "", "bench.py",
+       "'1' skips the serving stage", _PERF),
+    _f("BENCH_SKIP_FLEET", "", "bench.py", "'1' skips the fleet stage",
+       _PERF),
+    _f("BENCH_SKIP_RESILIENCE", "", "bench.py",
+       "'1' skips the resilience stage", _PERF),
+    _f("BENCH_SKIP_OBS", "", "bench.py",
+       "'1' skips obs_dump/obs_doctor stages + the measured-MFU table",
+       _OBS),
+    _f("BENCH_SKIP_LINT", "", "bench.py",
+       "'1' skips the journaled tpulint stage", _PERF),
+]}
+
+
+def lookup(name: str) -> Optional[EnvFlag]:
+    """The registry entry for ``name``, or None for unknown flags."""
+    return FLAGS.get(name)
+
+
+def all_flags() -> Iterable[EnvFlag]:
+    return FLAGS.values()
+
+
+def get(name: str) -> str:
+    """Read ``name`` from the environment with its REGISTERED default.
+    Raises KeyError for unregistered names — the programmatic analogue
+    of the lint rule, for new call sites that want registry-backed
+    defaults instead of inline literals."""
+    return os.environ.get(name, FLAGS[name].default)

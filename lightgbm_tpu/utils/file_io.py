@@ -57,25 +57,48 @@ def open_file(path, mode: str = "r"):
     return open(path, mode)
 
 
-def write_atomic(path, data) -> None:
-    """Crash-safe write of ``data`` (str or bytes) to ``path``.
+import contextlib
 
-    Local paths: parent directories are created, the payload goes to a
-    temp sibling in the SAME directory (same filesystem, so the final
-    rename cannot cross devices), is fsync'd, and lands via ``os.replace``
-    — a reader never observes a truncated file, no matter when the writer
-    dies.  ``scheme://`` paths route through the ``open_file`` seam; their
-    atomicity is the backend's contract (object stores commit on close),
-    and the checksummed checkpoint manifest catches the ones that lie.
-    """
+
+@contextlib.contextmanager
+def open_atomic(path, mode: str = "w"):
+    """Streaming sibling of ``write_atomic``: yields a writable handle
+    backed by a temp sibling; a clean exit fsyncs and lands it via
+    ``os.replace``, any exception removes the temp.  For payloads too
+    large to assemble in memory (binary dataset caches, per-row
+    prediction output) — O(1) extra RAM, same crash-safety contract.
+    ``scheme://`` paths pass through ``open_file`` (atomicity is the
+    backend's contract, as in ``write_atomic``).
+
+    Only ``w``/``wb`` modes: ``x`` would advertise exclusive-create
+    semantics the final ``os.replace`` cannot honor, and appends have
+    no atomic equivalent.  Non-regular destinations (FIFOs, character
+    devices like ``/dev/stdout``) stream through with their NATIVE
+    semantics — a FIFO write blocks until a reader attaches, exactly as
+    ``> fifo`` would; replacing a user's pipe with a regular file is
+    not this seam's call.  Symlinks write atomically THROUGH to the
+    resolved target (the link survives; a link to a directory raises)."""
     path = str(path)
-    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    if "w" not in mode:
+        raise ValueError(
+            f"open_atomic supports only 'w'/'wb' modes, got {mode!r}")
     if "://" in path:
         with open_file(path, mode) as fh:
-            fh.write(data)
+            yield fh
         return
     import os
     import uuid
+    # symlinked destinations ("latest" model/checkpoint links): write
+    # atomically THROUGH the link — temp sibling + replace of the
+    # resolved target, so the link survives and its readers still never
+    # see a torn file.  Genuinely non-regular targets (/dev/stdout,
+    # FIFOs, character devices) cannot be renamed into and get plain
+    # write-through semantics instead.
+    path = os.path.realpath(path)
+    if os.path.exists(path) and not os.path.isfile(path):
+        with open(path, mode) as fh:
+            yield fh
+        return
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     # O_EXCL + mode 0o666: unique temp sibling whose final permissions are
@@ -87,7 +110,7 @@ def write_atomic(path, data) -> None:
     fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
     try:
         with os.fdopen(fd, mode) as fh:
-            fh.write(data)
+            yield fh
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, path)
@@ -97,6 +120,23 @@ def write_atomic(path, data) -> None:
         except OSError:
             pass
         raise
+
+
+def write_atomic(path, data) -> None:
+    """Crash-safe write of ``data`` (str or bytes) to ``path``.
+
+    Local paths: parent directories are created, the payload goes to a
+    temp sibling in the SAME directory (same filesystem, so the final
+    rename cannot cross devices), is fsync'd, and lands via ``os.replace``
+    — a reader never observes a truncated file, no matter when the writer
+    dies.  ``scheme://`` paths route through the ``open_file`` seam; their
+    atomicity is the backend's contract (object stores commit on close),
+    and the checksummed checkpoint manifest catches the ones that lie.
+    Payloads too large to hold in memory stream through ``open_atomic``.
+    """
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    with open_atomic(path, mode) as fh:
+        fh.write(data)
 
 
 def remove(path) -> bool:

@@ -94,8 +94,8 @@ class Timer:
         import json
         s = json.dumps({"timers": self.to_dict()}, indent=1, sort_keys=True)
         if path:
-            with open(path, "w") as f:
-                f.write(s)
+            from .file_io import write_atomic
+            write_atomic(path, s)
         return s
 
     def publish(self, registry=None) -> dict:

@@ -1,0 +1,64 @@
+"""Seeded lock-discipline violations: unannotated + unguarded shared state."""
+import threading
+
+
+class UnannotatedPump:
+    """Shared attr mutated by thread and caller with no guarded-by."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                if self._pending:
+                    self._pending.pop()  # SEED lock-discipline
+
+    def submit(self, item):
+        with self._lock:
+            self._pending.append(item)  # (reported at first mutation)
+
+
+class UnguardedCounter:
+    """Annotated, but one caller-side mutation skips the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0          # guarded-by: _lock
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._lock:
+            self._count += 1
+
+    def bump(self):
+        self._count += 1  # SEED lock-discipline
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+
+class DisciplinedQueue:
+    """Negative case: annotated, every mutation under the lock (the
+    Condition aliases it), helper declares its caller-held lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self._items = []         # guarded-by: _lock
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._ready:
+            self._items.pop()
+            self._drop_unlocked()
+
+    def _drop_unlocked(self):  # guarded-by-caller: _lock
+        self._items.clear()
+
+    def put(self, item):
+        with self._lock:
+            self._items.append(item)

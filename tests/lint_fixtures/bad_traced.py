@@ -1,0 +1,52 @@
+"""Seeded traced-purity violations: host effects inside jit code."""
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_kernel(x, y):
+    t = time.time()  # SEED traced-purity
+    r = np.random.rand()  # SEED traced-purity
+    e = os.environ.get("SOME_PLAIN_VAR")  # SEED traced-purity
+    s = x.sum().item()  # SEED traced-purity
+    h = float(y)  # SEED traced-purity
+    a = np.asarray(x)  # SEED traced-purity
+    if y > 0:  # SEED traced-purity
+        x = x + 1
+    return x + t + r + s + h + a.shape[0] + (0 if e else 1)
+
+
+def build_step():
+    def step(state, grad):
+        now = time.perf_counter()  # SEED traced-purity
+        if grad:  # SEED traced-purity
+            state = state + grad
+        return state + now
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def build_partial():
+    def fold(hist, rows, num_bins):
+        # num_bins is partial-bound -> static: this branch is fine
+        if num_bins > 16:
+            hist = hist * 2
+        return hist + rows
+
+    return jax.jit(functools.partial(fold, num_bins=32))
+
+
+@functools.partial(jax.jit, static_argnames=("training",))
+def static_ok(x, training):
+    # negative cases: static param branch, shape branch, is-comparison
+    if training:
+        x = x * 2
+    if x is None:
+        return x
+    total = jnp.sum(x)
+    return total

@@ -1,0 +1,27 @@
+"""Seeded atomic-write violations: raw writes off the atomic seam."""
+import json
+
+from lightgbm_tpu.utils.file_io import open_file, write_atomic
+
+
+def save_manifest(path, manifest):
+    with open(path, "w") as fh:  # SEED atomic-write
+        json.dump(manifest, fh)
+
+
+def save_blob(path, blob):
+    fh = open_file(path, mode="wb")  # SEED atomic-write
+    fh.write(blob)
+    fh.close()
+
+
+def append_journal(path, line):
+    with open(path, "a") as fh:  # SEED atomic-write
+        fh.write(line)
+
+
+def save_ok(path, manifest):
+    # negative cases: the blessed seam, and reads
+    write_atomic(path, json.dumps(manifest))
+    with open(path) as fh:
+        return fh.read()

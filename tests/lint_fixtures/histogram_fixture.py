@@ -1,0 +1,14 @@
+"""Seeded parity-hazard fold violations (basename matches 'histogram')."""
+import jax.numpy as jnp
+
+
+def naive_fold(block_hists):
+    return jnp.sum(block_hists, axis=0)  # SEED parity-hazard
+
+
+def blessed_fold(block_hists, init):
+    # negative case: inside a carry-in kernel the row-axis fold is the
+    # accumulation seam itself
+    acc = init + jnp.sum(block_hists, axis=0)
+    total = jnp.sum(acc)          # scalar reduction: never flagged
+    return acc, total

@@ -1,0 +1,10 @@
+"""Seeded docs-sync violations (fixture lives under obs/)."""
+
+
+def publish(registry, span):
+    registry.counter("fixture_metric_never_documented").inc()  # SEED docs-sync
+    registry.gauge("fixture_gauge_never_documented").set(1)  # SEED docs-sync
+    with span("fixture.span_never_documented"):  # SEED docs-sync
+        pass
+    # negative case: a documented name passes
+    registry.gauge("pod_mfu").set(0.5)

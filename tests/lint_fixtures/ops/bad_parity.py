@@ -1,0 +1,17 @@
+"""Seeded parity-hazard violations (fixture lives under ops/)."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def sloppy_dots(a, b, onehot):
+    h1 = jnp.dot(a, onehot)  # SEED parity-hazard
+    h2 = lax.dot_general(a, b, (((1,), (0,)), ((), ())))  # SEED parity-hazard
+    h3 = a @ b  # SEED parity-hazard
+    return h1 + h2 + h3
+
+
+def pinned_dots(a, b, onehot):
+    # negative cases: both blessed spellings
+    h1 = lax.dot(a, onehot, preferred_element_type=jnp.int32)
+    h2 = jnp.matmul(a, b, precision=lax.Precision.HIGHEST)
+    return h1 + h2

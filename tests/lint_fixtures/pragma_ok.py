@@ -1,0 +1,25 @@
+"""The bad_env/bad_write violations again, silenced by pragmas — this
+file must lint completely clean (tests assert it)."""
+# tpulint: disable-file=traced-purity
+import os
+import time
+
+import jax
+
+
+def read_unknown_flag():
+    # justification: fixture exercising the line pragma
+    return os.environ.get(
+        "LGBM_TPU_FIXTURE_UNKNOWN")  # tpulint: disable=env-flag-registry
+
+
+def raw_write(path, text):
+    # justification: fixture exercising multi-rule line pragma
+    with open(path, "w") as fh:  # tpulint: disable=atomic-write,env-flag-registry
+        fh.write(text)
+
+
+@jax.jit
+def impure_but_filed(x):
+    # silenced by the file-level pragma at the top
+    return x + time.time()

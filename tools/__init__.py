@@ -1,0 +1,3 @@
+# Makes tools/ importable so `tools.lint` (the tpulint package) and
+# `tools.gen_parameters_doc` resolve from the repo root.  The scripts in
+# this directory remain directly runnable (`python tools/<script>.py`).

@@ -1,114 +1,41 @@
 """Generate docs/Parameters.rst from the Config dataclass + alias table.
 
-reference: helpers/parameter_generator.py generates config_auto.cpp AND
-docs/Parameters.rst from structured comments in config.h so the alias map
-and the user docs can never drift from the source of truth.  Here the
-source of truth is the ``Config`` dataclass and ``_ALIASES`` dict in
-``lightgbm_tpu/config.py``; this script derives the docs (and the
-section structure from the ``# section`` comments) from them.
+Thin shim: the implementation lives in ``tools/lint/params_doc.py`` so
+tpulint's ``docs-sync`` rule and this standalone entrypoint share ONE
+generator/checker (the reference analogue is
+helpers/parameter_generator.py generating Parameters.rst from config.h).
+CLI contract unchanged:
 
 Run:  python tools/gen_parameters_doc.py          # rewrite docs/Parameters.rst
       python tools/gen_parameters_doc.py --check  # exit 1 if docs are stale
                                                   # (tests/test_api_surface.py
                                                   # runs this in CI)
 """
-import dataclasses
-import io
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from lightgbm_tpu.config import _ALIASES, Config  # noqa: E402
-
-OUT = os.path.join(REPO, "docs", "Parameters.rst")
-
-
-def _sections():
-    """(field name -> section title) from the explicit ``# section: <name>``
-    sentinels that structure the dataclass body — explicit, so an ordinary
-    short comment can never silently spawn a garbage doc section."""
-    src = open(os.path.join(REPO, "lightgbm_tpu", "config.py")).read()
-    body = src.split("class Config:", 1)[1]
-    section = "Core Parameters"
-    out = {}
-    for line in body.splitlines():
-        m = re.match(r"\s*#\s*section:\s*(.+?)\s*$", line)
-        if m:
-            section = m.group(1).strip().title() + " Parameters"
-            continue
-        f = re.match(r"\s{4}(\w+)\s*:\s*\w", line)
-        if f:
-            out[f.group(1)] = section
-    return out
-
-
-def generate() -> str:
-    fields = dataclasses.fields(Config)
-    sec_of = _sections()
-    aliases_of = {}
-    for alias, canon in _ALIASES.items():
-        if alias != canon:
-            aliases_of.setdefault(canon, []).append(alias)
-
-    buf = io.StringIO()
-    w = buf.write
-    w("Parameters\n==========\n\n")
-    w("Generated from ``lightgbm_tpu/config.py`` by "
-      "``tools/gen_parameters_doc.py`` — do not edit by hand.\n"
-      "The reference analogue is ``docs/Parameters.rst`` generated from "
-      "``config.h`` by ``helpers/parameter_generator.py``.\n\n")
-    current = None
-    for f in fields:
-        sec = sec_of.get(f.name, "Other Parameters")
-        if sec != current:
-            w(f"\n{sec}\n{'-' * len(sec)}\n\n")
-            current = sec
-        default = f.default
-        if default is dataclasses.MISSING:
-            default = (f.default_factory()
-                       if f.default_factory is not dataclasses.MISSING
-                       else "")
-        typename = getattr(f.type, "__name__", str(f.type))
-        w(f"- ``{f.name}``: {typename}, default ``{default!r}``")
-        al = aliases_of.get(f.name)
-        if al:
-            w(f", aliases: {', '.join('``%s``' % a for a in sorted(al))}")
-        w("\n")
-    return buf.getvalue()
+from tools.lint import params_doc  # noqa: E402
 
 
 def main():
-    out_path = OUT
+    out_path = params_doc.OUT
     if "--out" in sys.argv:
         i = sys.argv.index("--out")
         if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
             print("--out requires a path argument", file=sys.stderr)
             return 2
         out_path = sys.argv[i + 1]
-    text = generate()
     if "--check" in sys.argv:
-        on_disk = open(out_path).read() if os.path.exists(out_path) else ""
-        # name the missing fields FIRST: "stale" alone sends people
-        # diffing; a missing config key (the usual drift: a field added
-        # without regenerating) should fail by name
-        missing = [f.name for f in dataclasses.fields(Config)
-                   if f"``{f.name}``" not in on_disk]
-        if missing:
-            print(f"{out_path} is missing Config fields: "
-                  f"{', '.join(missing)}; regenerate with "
-                  "python tools/gen_parameters_doc.py", file=sys.stderr)
-            return 1
-        if on_disk != text:
-            print(f"{out_path} is stale: regenerate with "
-                  "python tools/gen_parameters_doc.py", file=sys.stderr)
-            return 1
-        print(f"{out_path} is current")
-        return 0
-    with open(out_path, "w") as fh:
-        fh.write(text)
+        code, messages = params_doc.check(out_path)
+        for m in messages:
+            print(m, file=sys.stderr if code else sys.stdout)
+        return code
+    text = params_doc.generate()
+    from lightgbm_tpu.utils.file_io import write_atomic
+    write_atomic(out_path, text)
     print(f"wrote {out_path} ({len(text.splitlines())} lines)")
     return 0
 

@@ -1,0 +1,17 @@
+"""tpulint CLI shim — the implementation lives in ``tools/lint/cli.py``
+(this file is shadowed by the ``tools.lint`` package for imports, so it
+stays a pure filesystem entry point; ``python -m tools.lint`` is the
+import-world spelling of the same command).  Usage, output contract and
+exit codes: ``python tools/lint.py --help`` / docs/LINTING.md.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

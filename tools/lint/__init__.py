@@ -1,0 +1,9 @@
+"""tpulint — the project-native static-analysis suite (docs/LINTING.md).
+
+Checkers live in ``rules_*.py``; ``tools/lint.py`` is the CLI and
+``tests/test_lint.py`` runs the suite over the real tree in tier-1.
+"""
+
+from .core import (DEFAULT_ROOTS, Project, Rule, SourceFile,  # noqa: F401
+                   Violation, all_rules, load_project, run_lint,
+                   select_rules)

@@ -1,0 +1,7 @@
+"""``python -m tools.lint`` — same CLI as ``python tools/lint.py``."""
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,306 @@
+"""lock-discipline: shared mutable state is declared and verified.
+
+The serving batcher, the stream ``BlockPump``, the watchdog sentry and
+the fleet replan tick all share instance attributes between a
+``threading.Thread`` target and ordinary caller-side methods.  The
+convention this rule enforces:
+
+- an attribute mutated BOTH from thread-side code (a ``Thread`` target
+  method or nested function, plus everything it reaches through
+  ``self.m()`` calls) AND from caller-side methods must carry a
+  ``# guarded-by: <lock>`` annotation on its ``__init__`` assignment::
+
+      self._q = collections.deque()   # guarded-by: _lock
+
+- every mutation of an annotated attribute (outside ``__init__``) must
+  sit lexically inside ``with self.<lock>:`` — where ``<lock>`` is the
+  annotated lock, or a ``threading.Condition(self.<lock>)`` alias
+  created in ``__init__`` (holding the condition holds the lock);
+- a helper whose CALLERS hold the lock declares it on its ``def`` line
+  with ``# guarded-by-caller: <lock>``.
+
+Mutations counted: attribute rebinds (``self.x = ...``, ``+=``), item
+stores (``self.x[k] = ...``), and calls of known container mutators
+(``self.x.append(...)``, ``popleft``, ``update``, ...).  Reads are not
+tracked — the rule targets lost updates, the failure mode that actually
+shipped races here (see fleet/registry.py's ``_admissions`` comment).
+Deliberately lock-free single-store designs (GIL-atomic dict stores)
+are allowlisted per line with a pragma + justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Project, Rule, Violation, dotted_name
+
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop",
+             "popleft", "remove", "clear", "update", "setdefault",
+             "add", "discard", "__setitem__"}
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_,| ]+)")
+_CALLER_RE = re.compile(r"#\s*guarded-by-caller:\s*([A-Za-z0-9_,| ]+)")
+_INIT_NAMES = {"__init__", "__post_init__"}
+
+
+def _locks_from(match) -> Set[str]:
+    return {s.strip() for s in re.split(r"[,|]", match.group(1))
+            if s.strip()}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for ``self.x``; None otherwise."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _mutations(fn: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(attr, node) pairs for every self-attribute mutation in ``fn``."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for el in ast.walk(t):
+                    attr = _self_attr(el)
+                    if attr is not None:
+                        out.append((attr, node))
+                    elif isinstance(el, ast.Subscript):
+                        attr = _self_attr(el.value)
+                        if attr is not None:
+                            out.append((attr, node))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                out.append((attr, node))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None and isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                if attr is not None:
+                    out.append((attr, node))
+    return out
+
+
+def _thread_targets(scope: ast.AST) -> Tuple[Set[str], List[ast.AST]]:
+    """(self-method names, nested function defs) passed as
+    ``target=`` to a Thread(...) constructor inside ``scope``."""
+    methods: Set[str] = set()
+    nested_names: Set[str] = set()
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        if not callee.endswith("Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            attr = _self_attr(kw.value)
+            if attr is not None:
+                methods.add(attr)
+            elif isinstance(kw.value, ast.Name):
+                nested_names.add(kw.value.id)
+    nested_defs = [n for n in ast.walk(scope)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name in nested_names]
+    return methods, nested_defs
+
+
+def _self_calls(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            attr = _self_attr(node.func)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+class _ClassInfo:
+    def __init__(self, src_lines: List[str], cls: ast.ClassDef):
+        self.cls = cls
+        self.lines = src_lines
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        # Condition/alias map: self.A = threading.Condition(self.B)
+        # in __init__ means holding A holds B
+        self.cond_alias: Dict[str, str] = {}
+        # guarded-by annotations: attr -> (locks, lineno of declaration)
+        self.guarded: Dict[str, Tuple[Set[str], int]] = {}
+        for name in _INIT_NAMES:
+            init = self.methods.get(name)
+            if init is None:
+                continue
+            for node in ast.walk(init):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    targets = [node.target]
+                else:
+                    continue
+                attr = None
+                for t in targets:
+                    attr = attr or _self_attr(t)
+                if attr is None or node.value is None:
+                    continue
+                if isinstance(node.value, ast.Call) \
+                        and (dotted_name(node.value.func) or "").endswith(
+                            "Condition") and node.value.args:
+                    base = _self_attr(node.value.args[0])
+                    if base is not None:
+                        self.cond_alias[attr] = base
+                line = self.lines[node.lineno - 1] \
+                    if node.lineno - 1 < len(self.lines) else ""
+                m = _GUARDED_RE.search(line)
+                if m:
+                    self.guarded[attr] = (_locks_from(m), node.lineno)
+
+    def holds(self, held: Set[str], want: Set[str]) -> bool:
+        """Does holding the locks in ``held`` satisfy one of ``want``?
+        A Condition alias counts as its underlying lock."""
+        expanded = set(held)
+        for h in held:
+            if h in self.cond_alias:
+                expanded.add(self.cond_alias[h])
+        for w in want:
+            if w in expanded:
+                return True
+            # annotation may name the condition; holding its lock or
+            # any sibling alias of the same lock also satisfies it
+            if w in self.cond_alias and self.cond_alias[w] in expanded:
+                return True
+        return False
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    doc = ("attributes mutated from both a Thread target and caller "
+           "methods need '# guarded-by: <lock>' on their __init__ "
+           "assignment, and every mutation must sit under "
+           "'with self.<lock>:'")
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for f in project.files:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.ClassDef):
+                    out.extend(self._check_class(f, node))
+        return out
+
+    def _check_class(self, f, cls: ast.ClassDef) -> List[Violation]:
+        info = _ClassInfo(f.lines, cls)
+        entry_methods, nested_defs = _thread_targets(cls)
+        if not entry_methods and not nested_defs:
+            return []
+
+        # thread-reachable methods: closure over self.m() calls from the
+        # entries (simple name-based reachability; cycles fine)
+        reach: Set[str] = set()
+        frontier = set(entry_methods)
+        for nd in nested_defs:
+            frontier |= _self_calls(nd)
+        while frontier:
+            m = frontier.pop()
+            if m in reach or m not in info.methods:
+                continue
+            reach.add(m)
+            frontier |= _self_calls(info.methods[m])
+
+        thread_muts: Dict[str, List[ast.AST]] = {}
+        for nd in nested_defs:
+            for attr, node in _mutations(nd):
+                thread_muts.setdefault(attr, []).append(node)
+        for m in reach:
+            for attr, node in _mutations(info.methods[m]):
+                thread_muts.setdefault(attr, []).append(node)
+
+        caller_muts: Dict[str, List[ast.AST]] = {}
+        for name, fn in info.methods.items():
+            if name in reach or name in _INIT_NAMES:
+                continue
+            # skip the thread code nested inside caller methods — those
+            # mutations were already collected on the thread side
+            skip = set()
+            for nd in nested_defs:
+                for sub in ast.walk(nd):
+                    skip.add(id(sub))
+            for attr, node in _mutations(fn):
+                if id(node) not in skip:
+                    caller_muts.setdefault(attr, []).append(node)
+
+        shared = set(thread_muts) & set(caller_muts)
+        out: List[Violation] = []
+        for attr in sorted(shared):
+            if attr not in info.guarded:
+                line = min(n.lineno
+                           for n in thread_muts[attr] + caller_muts[attr])
+                out.append(Violation(
+                    self.name, f.rel, line,
+                    f"{cls.name}.{attr} is mutated from both a Thread "
+                    "target and caller methods but its __init__ "
+                    "assignment has no '# guarded-by: <lock>' "
+                    "annotation"))
+                continue
+            want, _decl = info.guarded[attr]
+            for name, fn in info.methods.items():
+                if name in _INIT_NAMES:
+                    continue
+                out.extend(self._check_fn(f, cls, info, fn, attr, want))
+            for nd in nested_defs:
+                out.extend(self._check_fn(f, cls, info, nd, attr, want))
+        return out
+
+    def _check_fn(self, f, cls, info: _ClassInfo, fn: ast.AST,
+                  attr: str, want: Set[str]) -> List[Violation]:
+        base_held: Set[str] = set()
+        def_line = f.lines[fn.lineno - 1] \
+            if fn.lineno - 1 < len(f.lines) else ""
+        m = _CALLER_RE.search(def_line)
+        if m:
+            base_held |= _locks_from(m)
+        out: List[Violation] = []
+
+        def visit(node: ast.AST, held: Set[str]):
+            if isinstance(node, ast.With):
+                got = set(held)
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a is not None:
+                        got.add(a)
+                for sub in node.body:
+                    visit(sub, got)
+                return
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                # nested defs are visited as their own _check_fn pass
+                # when they are thread targets; otherwise they inherit
+                # the current held set (closures run where called — be
+                # conservative and reset to base)
+                for sub in ast.iter_child_nodes(node):
+                    visit(sub, set(base_held))
+                return
+            hits = [(a, n) for a, n in _mutations(node)
+                    if a == attr and n is node]
+            for _a, n in hits:
+                if not info.holds(held, want):
+                    out.append(Violation(
+                        self.name, f.rel, n.lineno,
+                        f"{cls.name}.{attr} is guarded by "
+                        f"{'/'.join(sorted(want))} but this mutation is "
+                        "not under 'with self.<lock>:'"))
+            for sub in ast.iter_child_nodes(node):
+                visit(sub, held)
+
+        for stmt in (fn.body if hasattr(fn, "body") else []):
+            visit(stmt, set(base_held))
+        return out

@@ -1,0 +1,125 @@
+"""parity-hazard: the MXU numeric conventions byte-identity rests on.
+
+Three checks, all scoped to the parity-critical modules:
+
+1. every dot/matmul call in ``ops/`` must pin its accumulation type:
+   integer one-hot matmuls pass ``preferred_element_type`` (i32
+   accumulation, no bf16 mantissa loss — the quantized parity
+   invariant) and f32 dots pass ``precision=HIGHEST`` (no TF32-style
+   reassociation, see arXiv 1706.08359 / 1806.11248 for the GPU
+   histogram-precision lineage).  A bare ``jnp.dot(a, b)`` inherits
+   backend defaults that differ between CPU and TPU — exactly the
+   silent divergence the parity tests exist to catch;
+2. the ``@`` matmul operator is banned in ``ops/`` outright — it cannot
+   carry either kwarg;
+3. row-axis histogram folds (``jnp.sum(..., axis=0)``) in the
+   histogram/fused/stream modules must live inside the blessed carry-in
+   kernels (functions taking an ``init``/``carry`` accumulator
+   parameter): the streamed==resident invariant holds only when block
+   folds continue the SAME f32 accumulation sequence, which is what the
+   carry-in seam guarantees.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from .core import Project, Rule, Violation, dotted_name
+
+_DOT_CALLS = {"lax.dot", "lax.dot_general", "jax.lax.dot",
+              "jax.lax.dot_general", "jnp.matmul", "jnp.dot",
+              "jnp.einsum", "jnp.tensordot", "jax.numpy.matmul",
+              "jax.numpy.dot"}
+_PIN_KWARGS = {"preferred_element_type", "precision"}
+_FOLD_BASENAMES = ("histogram", "fused", "stream")
+_CARRY_PARAMS = {"init", "carry"}
+
+
+def _in_ops(rel: str) -> bool:
+    parts = rel.replace(os.sep, "/").split("/")
+    return "ops" in parts
+
+
+def _is_fold_module(rel: str) -> bool:
+    base = os.path.basename(rel)
+    return any(k in base for k in _FOLD_BASENAMES)
+
+
+def _sum_axis(call: ast.Call) -> Optional[int]:
+    axis = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        axis = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "axis" and isinstance(kw.value, ast.Constant):
+            axis = kw.value.value
+    return axis if isinstance(axis, int) else None
+
+
+class ParityHazardRule(Rule):
+    name = "parity-hazard"
+    doc = ("ops/ dot/matmul calls must pin preferred_element_type or "
+           "precision; '@' is banned in ops/; row-axis histogram folds "
+           "belong inside carry-in kernels")
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for f in project.files:
+            in_ops = _in_ops(f.rel)
+            fold_mod = _is_fold_module(f.rel)
+            if not (in_ops or fold_mod):
+                continue
+            # function stack so the sum check knows its enclosing defs
+            out.extend(self._walk(f.rel, f.tree, in_ops, fold_mod, []))
+        return out
+
+    def _walk(self, rel: str, node: ast.AST, in_ops: bool,
+              fold_mod: bool, fn_stack: List[ast.FunctionDef]):
+        out: List[Violation] = []
+        for child in ast.iter_child_nodes(node):
+            push = isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+            if push:
+                fn_stack.append(child)
+            out.extend(self._visit(rel, child, in_ops, fold_mod,
+                                   fn_stack))
+            out.extend(self._walk(rel, child, in_ops, fold_mod,
+                                  fn_stack))
+            if push:
+                fn_stack.pop()
+        return out
+
+    def _visit(self, rel, node, in_ops, fold_mod, fn_stack):
+        out: List[Violation] = []
+        if in_ops and isinstance(node, ast.BinOp) \
+                and isinstance(node.op, ast.MatMult):
+            out.append(Violation(
+                self.name, rel, node.lineno,
+                "'@' matmul cannot pin preferred_element_type/"
+                "precision; use lax.dot with explicit accumulation"))
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if in_ops and callee in _DOT_CALLS:
+                kwargs = {kw.arg for kw in node.keywords}
+                if not (kwargs & _PIN_KWARGS):
+                    out.append(Violation(
+                        self.name, rel, node.lineno,
+                        f"{callee}(...) without preferred_element_type/"
+                        "precision: backend-default accumulation breaks "
+                        "cross-platform bit parity (int matmuls need "
+                        "preferred_element_type, f32 dots "
+                        "precision=HIGHEST)"))
+            elif fold_mod and callee in ("jnp.sum", "jax.numpy.sum") \
+                    and _sum_axis(node) == 0:
+                in_carry = any(
+                    {a.arg for a in fn.args.args} & _CARRY_PARAMS
+                    for fn in fn_stack)
+                if not in_carry:
+                    out.append(Violation(
+                        self.name, rel, node.lineno,
+                        "row-axis jnp.sum(..., axis=0) outside a "
+                        "carry-in kernel (no enclosing function takes "
+                        "init/carry): streamed==resident parity needs "
+                        "folds to ride the blessed accumulation seam"))
+        return out

@@ -1,0 +1,288 @@
+"""traced-purity: no host effects inside jit-traced code.
+
+Byte-identical parity (quantized fused==staged, streamed==resident,
+hierarchical==flat) depends on traced programs being PURE functions of
+their inputs.  A ``time.time()``, ``np.random`` draw, ``os.environ``
+read, host sync (``.item()`` / ``float(param)`` / ``np.asarray``), or a
+Python ``if`` on a traced value inside a jitted function either fails at
+trace time in the best case or — worse — bakes a trace-time host value
+into the compiled program so reruns silently diverge.
+
+Traced code is found three ways (all AST-local, no imports):
+
+- functions decorated with ``jax.jit`` / ``jit`` / ``pjit`` (bare,
+  called, or via ``partial(jax.jit, ...)``);
+- local functions passed to a ``jax.jit(...)`` / ``pjit(...)`` call,
+  directly or through ``functools.partial(fn, ...)`` (the dominant
+  idiom here: ``self._step = jax.jit(step)``);
+- kernel functions passed to ``pl.pallas_call``.
+
+Parameters bound via ``static_argnums`` / ``static_argnames`` or by
+``functools.partial`` are static at trace time and never flagged.
+Lambdas passed to jit are skipped (no body scope to resolve).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Project, Rule, Violation, dotted_name
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "functools.pjit"}
+_PALLAS_NAMES = {"pl.pallas_call", "pallas_call", "jax.experimental."
+                 "pallas.pallas_call"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.time_ns", "time.perf_counter_ns"}
+_ENV_NAMES = {"os.environ", "os.getenv"}
+_HOST_ARRAY_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array"}
+_CAST_CALLS = {"float", "int", "bool"}
+
+
+def _param_names(fn: ast.FunctionDef):
+    """Positional parameter names in call order (posonly first) and the
+    keyword-only names — static_argnums indexes the former; kwargs and
+    kwonly params are traced unless named in static_argnames."""
+    positional = ([a.arg for a in fn.args.posonlyargs]
+                  + [a.arg for a in fn.args.args])
+    return positional, [a.arg for a in fn.args.kwonlyargs]
+
+
+def _static_params(fn: ast.FunctionDef, call: Optional[ast.Call],
+                   partial_call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names of ``fn`` that are static under this jit site.
+    ``self`` is excluded from index mapping: bound-method jit sites
+    (``jax.jit(self._leaves)``) never see it."""
+    names, _kwonly = _param_names(fn)
+    names = [n for n in names if n != "self"]
+    static: Set[str] = set()
+    if call is not None:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for elt in ast.walk(kw.value):
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        static.add(elt.value)
+            elif kw.arg == "static_argnums":
+                for elt in ast.walk(kw.value):
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, int) \
+                            and 0 <= elt.value < len(names):
+                        static.add(names[elt.value])
+    if partial_call is not None:
+        # functools.partial(fn, a, b, k=v): leading positionals and every
+        # keyword are bound at trace time -> static
+        for i in range(1, len(partial_call.args)):
+            if i - 1 < len(names):
+                static.add(names[i - 1])
+        for kw in partial_call.keywords:
+            if kw.arg:
+                static.add(kw.arg)
+    return static
+
+
+class _Scope(ast.NodeVisitor):
+    """Collect (function def, enclosing-scope chain) pairs."""
+
+    def __init__(self):
+        self.defs: List[Tuple[ast.FunctionDef, Tuple[ast.AST, ...]]] = []
+        self._stack: List[ast.AST] = []
+
+    def _visit_scope(self, node):
+        self.defs.append((node, tuple(self._stack)))
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+    visit_Lambda = lambda self, node: self.generic_visit(node)  # noqa: E731
+
+
+def _fn_ref_name(node: ast.AST) -> Optional[str]:
+    """The local function name a jit argument refers to: bare ``step``
+    or bound ``self._leaves`` (methods resolve by bare name too)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _jit_target(call: ast.Call):
+    """(target_name, jit_call, partial_call) for jit(X) / jit(partial(X,
+    ...)) / pallas_call(X, ...) where X is a local function or a
+    ``self.<method>``; (None, None, None) otherwise."""
+    callee = dotted_name(call.func)
+    if callee in _JIT_NAMES or callee in _PALLAS_NAMES:
+        if not call.args:
+            return None, None, None
+        arg = call.args[0]
+        name = _fn_ref_name(arg)
+        if name is not None:
+            return name, call, None
+        if isinstance(arg, ast.Call) \
+                and dotted_name(arg.func) in _PARTIAL_NAMES and arg.args:
+            name = _fn_ref_name(arg.args[0])
+            if name is not None:
+                return name, call, arg
+    return None, None, None
+
+
+def _decorator_jit(fn: ast.FunctionDef):
+    """The jit-ish decorator call of ``fn`` (or True for a bare one)."""
+    for dec in fn.decorator_list:
+        d = dec
+        partial = None
+        if isinstance(d, ast.Call):
+            callee = dotted_name(d.func)
+            if callee in _PARTIAL_NAMES and d.args \
+                    and dotted_name(d.args[0]) in _JIT_NAMES:
+                return d, partial
+            if callee in _JIT_NAMES:
+                return d, partial
+            continue
+        if dotted_name(d) in _JIT_NAMES:
+            return True, partial
+    return None, None
+
+
+class TracedPurityRule(Rule):
+    name = "traced-purity"
+    doc = ("no host clocks, np.random, os.environ, host syncs "
+           "(.item()/float(param)/np.asarray) or Python branches on "
+           "traced params inside jit/pjit/pallas-traced functions")
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for f in project.files:
+            out.extend(self._check_file(f))
+        return out
+
+    def _check_file(self, f) -> List[Violation]:
+        scopes = _Scope()
+        scopes.visit(f.tree)
+        # name -> innermost defs (a name may repeat across scopes; flag
+        # them all — jit sites and defs are matched per enclosing scope)
+        defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for fn, _chain in scopes.defs:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(fn.name, []).append(fn)
+
+        traced: Dict[ast.FunctionDef, Set[str]] = {}
+
+        def mark(fn: ast.FunctionDef, static: Set[str]):
+            if fn in traced:
+                traced[fn] |= static
+            else:
+                traced[fn] = set(static)
+
+        for fn, _chain in scopes.defs:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            dec, partial = _decorator_jit(fn)
+            if dec is not None:
+                call = dec if isinstance(dec, ast.Call) else None
+                mark(fn, _static_params(fn, call, partial))
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target, jit_call, partial_call = _jit_target(node)
+            if target is None:
+                continue
+            for fn in defs_by_name.get(target, []):
+                mark(fn, _static_params(fn, jit_call, partial_call))
+
+        out: List[Violation] = []
+        for fn, static in traced.items():
+            positional, kwonly = _param_names(fn)
+            params = set(positional) | set(kwonly)
+            params -= static | {"self"}
+            out.extend(self._check_traced(f.rel, fn, params))
+        return out
+
+    def _check_traced(self, rel: str, fn: ast.FunctionDef,
+                      traced_params: Set[str]) -> List[Violation]:
+        out: List[Violation] = []
+
+        def v(node, msg):
+            out.append(Violation(self.name, rel, node.lineno,
+                                 f"in traced function {fn.name!r}: {msg}"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if callee in _CLOCK_CALLS:
+                    v(node, f"host clock {callee}() — a trace-time "
+                            "constant baked into the compiled program")
+                elif callee in _HOST_ARRAY_CALLS:
+                    v(node, f"{callee}() forces a device->host sync and "
+                            "materializes a traced value on the host")
+                elif callee in _CAST_CALLS and len(node.args) == 1 \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in traced_params:
+                    v(node, f"{callee}({node.args[0].id}) host-syncs a "
+                            "traced parameter")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" \
+                        and not node.args:
+                    v(node, ".item() host-syncs a traced value")
+            elif isinstance(node, ast.Attribute):
+                dn = dotted_name(node)
+                if dn in _ENV_NAMES:
+                    v(node, f"{dn} read — env state is a trace-time "
+                            "constant; hoist it out of the kernel")
+                elif dn is not None and (
+                        dn.startswith("np.random.")
+                        or dn.startswith("numpy.random.")):
+                    v(node, f"{dn} — host RNG inside traced code; use "
+                            "jax.random with an explicit key")
+            elif isinstance(node, (ast.If, ast.While)):
+                name = self._bare_traced_test(node.test, traced_params)
+                if name:
+                    v(node, f"Python {type(node).__name__.lower()} "
+                            f"branches on traced parameter {name!r}; "
+                            "use lax.cond/jnp.where or mark it static")
+        return out
+
+    @staticmethod
+    def _bare_traced_test(test: ast.AST,
+                          traced_params: Set[str]) -> Optional[str]:
+        """The offending param name when ``test`` is built purely from
+        bare names/constants and touches a traced param (``is``
+        comparisons are static and exempt)."""
+
+        def scan(node) -> Optional[str]:
+            if isinstance(node, ast.Name):
+                return node.id if node.id in traced_params else None
+            if isinstance(node, ast.Constant):
+                return None
+            if isinstance(node, ast.UnaryOp) \
+                    and isinstance(node.op, ast.Not):
+                return scan(node.operand)
+            if isinstance(node, ast.BoolOp):
+                for sub in node.values:
+                    hit = scan(sub)
+                    if hit:
+                        return hit
+                return None
+            if isinstance(node, ast.Compare):
+                if any(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in node.ops):
+                    return None
+                for sub in [node.left] + list(node.comparators):
+                    if not isinstance(sub, (ast.Name, ast.Constant)):
+                        return None
+                for sub in [node.left] + list(node.comparators):
+                    hit = scan(sub)
+                    if hit:
+                        return hit
+                return None
+            return None
+
+        return scan(test)

@@ -70,8 +70,8 @@ def run_dump(out_dir=".", rows=20_000, features=10, trees=8, leaves=15,
             metrics_file = os.path.join(out_dir, "obs_metrics.json")
             prom_file = os.path.join(out_dir, "obs_metrics.prom")
             global_registry.dump_json(metrics_file)
-            with open(prom_file, "w") as f:
-                f.write(global_registry.to_prometheus())
+            from lightgbm_tpu.utils.file_io import write_atomic
+            write_atomic(prom_file, global_registry.to_prometheus())
             snap = global_registry.to_dict()
         global_tracer.dump(trace_file)   # after close: drain spans included
 

@@ -33,7 +33,9 @@ def bank(stage, **kw):
     kw["t_elapsed"] = round(time.time() - T0, 1)
     DATA["stages"].append(kw)
     tmp = OUT + ".tmp"
-    with open(tmp, "w") as f:
+    # manual tmp+os.replace below; stdlib-only probe must stay
+    # importable before jax/package init
+    with open(tmp, "w") as f:  # tpulint: disable=atomic-write
         json.dump(DATA, f, indent=1, default=str)
     os.replace(tmp, OUT)
     print(f"[sweep] {stage}: {json.dumps(kw, default=str)[:400]}", flush=True)
