@@ -96,6 +96,12 @@ N-model registry under a shared-HBM residency plan — measured eviction
 with every model still servable, AOT zero-compile replica restart, and
 the opt-in bf16/int8 accuracy deltas via tools/fleet_smoke.py; a missed
 acceptance bar raises so failed fleet runs are never journaled);
+BENCH_SKIP_LIFECYCLE=1 skips the guarded model-lifecycle stage
+(lightgbm_tpu/lifecycle/: continual refresh -> shadow/canary promotion
+under loadgen traffic -> forced drift rollback with the fleet's output
+byte-identical to the pre-promotion model, via
+tools/lifecycle_smoke.py; a missed bar raises so failed lifecycle runs
+are never journaled);
 LGBM_TPU_VMEM_BYTES steers the fused-megakernel VMEM arena election and
 LGBM_TPU_FUSED=0 drops the fused arm entirely (staged family only);
 LGBM_TPU_COMPILE_CACHE=<dir> wires the persistent XLA compile cache
@@ -879,6 +885,30 @@ def run_fleet_bench(n_models=3, rows=20_000, trees=16, requests=300,
     return summary
 
 
+def run_lifecycle_bench(rows=20_000, trees=12, refresh_trees=4,
+                        requests=120, threads=4):
+    """Guarded model-lifecycle metric (lightgbm_tpu/lifecycle/): a full
+    train -> continual refresh -> shadow/canary promotion -> forced
+    drift rollback cycle under threaded loadgen traffic, via
+    tools/lifecycle_smoke.py's phased run.  The acceptance bars: a
+    clean promotion serves the candidate bit-identically with
+    ``model_age_seconds`` reset, and the forced rollback leaves the
+    fleet byte-identical to the pre-promotion model with a
+    flight-recorder bundle naming the breached gate.  Raises on any
+    missed bar so a failed lifecycle run is never journaled (PR 4
+    convention)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from lifecycle_smoke import run_smoke
+    summary = run_smoke(rows=rows, trees=trees,
+                        refresh_trees=refresh_trees, requests=requests,
+                        threads=threads)
+    if summary.get("failed"):
+        raise RuntimeError(
+            f"lifecycle smoke failed phases: "
+            f"{[k for k, ok in summary['phase_ok'].items() if not ok]}")
+    return summary
+
+
 def run_resilience_bench(n_train=50_000, trees=24, leaves=63, max_bin=63,
                          snapshot_freq=8):
     """Fault-tolerance overhead metric: checkpoint-bundle save/load
@@ -1294,6 +1324,12 @@ def tpu_worker():
     if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
         run_stage("resilience", run_resilience_bench, budget_floor=240)
 
+    # guarded model lifecycle (lightgbm_tpu/lifecycle/): continual
+    # refresh -> shadow/canary promotion -> forced rollback under load;
+    # errors raise so a failed cycle is never journaled
+    if os.environ.get("BENCH_SKIP_LIFECYCLE") != "1":
+        run_stage("lifecycle", run_lifecycle_bench, budget_floor=240)
+
     # automated bottleneck diagnosis (lightgbm_tpu/obs/diagnose.py):
     # joins THIS run's banked stages (mfu_measured, compile_cache,
     # stream_probe, collective_probe) + live registry gauges into ranked
@@ -1393,6 +1429,14 @@ def cpu_worker():
             except Exception as e:
                 res["resilience"] = {"error": str(e)[-300:]}
             emit(res)
+        if os.environ.get("BENCH_SKIP_LIFECYCLE") != "1":
+            try:
+                res["lifecycle"] = run_lifecycle_bench(
+                    rows=10_000, trees=8, refresh_trees=3,
+                    requests=80, threads=4)
+            except Exception as e:
+                res["lifecycle"] = {"error": str(e)[-300:]}
+            emit(res)
         return 0
     except Exception as e:
         emit({"stage": "cpu", "error": str(e)[-800:],
@@ -1472,6 +1516,15 @@ def _annotate(line, tpu_stages, cpu_result):
             "error" not in cpu_result["resilience"]:
         line["resilience"] = dict(cpu_result["resilience"],
                                   note="cpu-fallback resilience numbers")
+    lc = collect_ok(tpu_stages, "lifecycle")
+    if lc:
+        line["lifecycle"] = {k: v for k, v in lc.items()
+                             if k not in ("stage", "elapsed")}
+    if "lifecycle" not in line and cpu_result and \
+            isinstance(cpu_result.get("lifecycle"), dict) and \
+            "error" not in cpu_result["lifecycle"]:
+        line["lifecycle"] = dict(cpu_result["lifecycle"],
+                                 note="cpu-fallback lifecycle numbers")
     if cpu_result and "error" not in cpu_result:
         line["cpu_reference"] = {
             "sec_per_tree": cpu_result.get("sec_per_tree"),

@@ -18,8 +18,10 @@ from .config import Config
 from .dataset import Dataset
 from . import serving  # noqa: F401  (in-process inference server)
 from . import fleet  # noqa: F401  (multi-model serving fleet)
-from .engine import CVBooster, cv, serve, train
+from . import lifecycle  # noqa: F401  (guarded model lifecycle)
+from .engine import CVBooster, InitModelCompatibilityError, cv, serve, train
 from .fleet import Fleet
+from .lifecycle import LifecycleController
 
 __version__ = "0.1.0"
 
@@ -27,7 +29,8 @@ __all__ = [
     "Dataset", "Booster", "Config", "LightGBMError", "train", "cv",
     "CVBooster", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "serve", "serving",
-    "fleet", "Fleet",
+    "fleet", "Fleet", "lifecycle", "LifecycleController",
+    "InitModelCompatibilityError",
 ]
 
 try:  # sklearn API is optional at import time

@@ -366,10 +366,73 @@ def _restore_callback_states(cbs, states: dict) -> None:
             cb.set_state(states[tok])
 
 
+class InitModelCompatibilityError(ValueError):
+    """The ``init_model`` cannot continue training on this train set —
+    raised by name at ``train()`` entry (feature count, class count, or
+    bin-mapper layout mismatch) instead of a shape failure mid-boost."""
+
+
+def _validate_init_model(booster: Booster, predictor: Booster,
+                         train_set: Dataset) -> None:
+    """Continued training runs the old model's trees against the NEW
+    training matrix; every mismatch that would otherwise surface as an
+    opaque jit shape error (or silently wrong scores) is checked here.
+    Covers the cross-load path too: a predictor loaded from stock
+    LightGBM model text carries its feature count and class count in
+    the header."""
+    f_model = predictor.num_features()
+    f_train = train_set.num_total_features
+    if f_model != f_train:
+        raise InitModelCompatibilityError(
+            f"init_model was trained on {f_model} features but the "
+            f"training data has {f_train}; continued training requires "
+            "the same feature layout")
+    k_model = max(predictor.num_tree_per_iteration, 1)
+    k_train = max(booster.boosting.num_tree_per_iteration, 1)
+    if k_model != k_train:
+        raise InitModelCompatibilityError(
+            f"init_model has {k_model} tree(s) per iteration but this "
+            f"training is configured for {k_train} (num_class / "
+            "objective mismatch); continued training cannot mix them")
+    # an in-process predictor that retains its training Dataset also
+    # pins a bin grid.  Continued training itself is grid-agnostic (the
+    # old trees carry REAL thresholds, so init scores are exact on any
+    # binning — the stock cross-load path relies on that), but a
+    # production refresh is supposed to bin fresh rows on the DEPLOYED
+    # grid (Dataset(reference=...) / lifecycle.fresh_dataset): warn by
+    # name when the grids differ so a silent re-binning of the world is
+    # at least a visible decision.  Shared-identity mappers (the
+    # reference= path) short-circuit without comparing content.
+    pts = getattr(predictor, "train_set", None)
+    if pts is not None and getattr(pts, "constructed", False) \
+            and train_set.bin_mappers and pts.bin_mappers \
+            and pts.bin_mappers is not train_set.bin_mappers:
+        same = all(a.to_dict() == b.to_dict()
+                   for a, b in zip(pts.bin_mappers, train_set.bin_mappers))
+        if not same:
+            from .utils.log import log_warning
+            log_warning(
+                "continued training: the new train set's bin mappers "
+                "differ from the init model's training grid — init "
+                "scores stay exact (trees hold real thresholds), but "
+                "fresh histograms live on a DIFFERENT grid; bin "
+                "against the deployed Dataset (Dataset(reference=...) "
+                "/ lifecycle.fresh_dataset) to keep one grid")
+
+
 def _apply_init_model(booster: Booster, predictor: Booster, train_set: Dataset,
                       raw=None):
-    raw = predictor.predict(raw if raw is not None
-                            else _recover_raw(train_set), raw_score=True)
+    _validate_init_model(booster, predictor, train_set)
+    # streamed refresh (lifecycle/refresh.py): the deployed model's raw
+    # scores were computed chunk-by-chunk at push time — the dataset
+    # never kept raw features to re-predict from
+    pre = getattr(train_set, "_init_model_raw_scores", None)
+    if pre is not None:
+        raw = np.asarray(pre, np.float64)
+    else:
+        raw = predictor.predict(raw if raw is not None
+                                else _recover_raw(train_set),
+                                raw_score=True)
     K = booster.boosting.num_tree_per_iteration
     import jax.numpy as jnp
     n = train_set.num_data

@@ -209,6 +209,18 @@ class Fleet:
         self.metrics.counter("fleet_models_removed").inc()
         self.replan()
 
+    def set_weight(self, name: str, weight: float) -> None:
+        """Re-weight one fleet member (admission share + residency
+        priority) and replan — the lifecycle canary ramp drives this at
+        every step (lightgbm_tpu/lifecycle/)."""
+        if weight <= 0:
+            raise ValueError("model weight must be positive")
+        e = self.entry(name)
+        e.weight = float(weight)
+        self.metrics.gauge("model_weight", labels={"model": name}).set(
+            float(weight))
+        self.replan()
+
     def swap_model(self, name: str, booster_or_path, **kw):
         """Hot-swap one fleet member (Server.swap_model semantics: warm,
         probe, quarantine, atomic flip) and replan residency for the new

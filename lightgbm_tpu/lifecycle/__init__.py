@@ -1,0 +1,35 @@
+"""Guarded model lifecycle: continual training, canary promotion,
+automated rollback (docs/LIFECYCLE.md).
+
+Closes the ROADMAP "never serve a stale model" loop by composing the
+existing planes into one guarded cycle:
+
+* **refresh** (refresh.py) — warm-start boosting from the DEPLOYED
+  model over fresh rows binned on its frozen bin grid (engine
+  ``init_model`` + the PR 8 streaming plane), banked as an atomic
+  sha256-manifested checkpoint bundle (PR 2);
+* **promote** (rollout.py) — probe-batch quarantine -> shadow traffic
+  (mirrored raw-score drift + client-measured p99 vs declared budgets)
+  -> staged canary weight ramp through the serving ``Fleet`` (PR 9) ->
+  atomic probed cutover;
+* **rollback** — any gate breach (drift, latency, error rate,
+  non-finite outputs, corrupt bundle, failed cutover probe) restores
+  the previous verified bundle and dumps a flight-recorder bundle
+  naming the gate (PR 11); the rollout journal (journal.py) makes a
+  crashed pipeline resume-or-roll-back, never double-promote;
+* **freshness** — ``model_age_seconds`` is a watchdog SLO: a live
+  model past its age ceiling breaches ``freshness:<name>``.
+"""
+
+from .journal import RolloutJournal, RolloutJournalError
+from .refresh import (booster_digest, fresh_dataset, save_candidate,
+                      train_candidate)
+from .rollout import (CANARY_SUFFIX, LifecycleConfig, LifecycleController,
+                      LifecycleError, RollbackFailed, replay_traffic)
+
+__all__ = [
+    "LifecycleController", "LifecycleConfig", "LifecycleError",
+    "RollbackFailed", "RolloutJournal", "RolloutJournalError",
+    "CANARY_SUFFIX", "replay_traffic", "booster_digest",
+    "fresh_dataset", "train_candidate", "save_candidate",
+]

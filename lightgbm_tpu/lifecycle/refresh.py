@@ -1,0 +1,106 @@
+"""Continual-training candidate build: warm-start boosting from the
+deployed model over FRESH rows, binned against the deployed model's
+frozen bin mappers.
+
+The reference's continued-training seam (``train(init_model=...)``,
+engine.py) stacks the deployed model's trees under the new booster and
+starts boosting from its predictions; this module supplies the data
+half of the loop:
+
+* ``fresh_dataset`` bins new rows with the DEPLOYED training set's bin
+  mappers (``Dataset(reference=...)``), so candidate histograms live on
+  the exact bin grid the deployed model was grown on — a refresh never
+  silently re-bins the world;
+* chunked loads ride the PR 8 streaming plane
+  (``Dataset.from_reference_streaming`` + ``push_rows``): host RSS
+  stays O(chunk), and the deployed model's raw scores over each chunk
+  are computed AT PUSH TIME (``_init_model_raw_scores``) so the
+  warm-start needs no resident raw feature matrix;
+* ``train_candidate`` runs the warm-start and returns the candidate
+  booster; ``save_candidate`` writes the sha256-manifested bundle
+  (resilience/checkpoint.py) the guarded rollout promotes from.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+
+def booster_digest(booster) -> str:
+    """Content digest of a booster's full forest — the identity the
+    rollout journal records and the rollback bit-parity check compares
+    (serving/registry.forest_digest over every trained iteration)."""
+    from ..serving.registry import forest_digest
+    K = max(booster.num_tree_per_iteration, 1)
+    n_iter = len(booster.models) // K
+    return forest_digest(booster._forest(0, n_iter))
+
+
+def fresh_dataset(reference, X=None, label=None,
+                  chunks: Optional[Iterable[Tuple]] = None,
+                  num_rows: Optional[int] = None,
+                  predictor=None, params: Optional[dict] = None):
+    """A training Dataset of fresh rows on ``reference``'s frozen bin
+    grid.
+
+    Resident form: ``fresh_dataset(ref, X, y)`` keeps the raw rows
+    (``free_raw_data=False``) so ``train(init_model=...)`` can predict
+    its init scores.  Streamed form: ``chunks`` is an iterable of
+    ``(X_chunk, y_chunk)`` pairs totalling ``num_rows`` rows — each
+    chunk is binned and released, and when ``predictor`` (the deployed
+    booster) is given its raw scores over each chunk are accumulated as
+    ``_init_model_raw_scores``, which ``engine._apply_init_model``
+    consumes instead of re-predicting from raw data the streamed
+    dataset never kept."""
+    from ..dataset import Dataset
+    if chunks is None:
+        if X is None:
+            raise ValueError("fresh_dataset needs X (resident) or "
+                             "chunks (streamed)")
+        return Dataset(X, label=label, reference=reference,
+                       params=dict(params or {}), free_raw_data=False)
+    if num_rows is None:
+        raise ValueError("streamed fresh_dataset needs num_rows")
+    ds = Dataset.from_reference_streaming(reference, num_rows,
+                                          params=dict(params or {}))
+    labels = []
+    scores = [] if predictor is not None else None
+    for xc, yc in chunks:
+        xc = np.asarray(xc)
+        ds.push_rows(xc)
+        labels.append(np.asarray(yc, np.float32).reshape(-1))
+        if scores is not None:
+            scores.append(np.asarray(
+                predictor.predict(xc, raw_score=True), np.float64))
+    if not ds.constructed:
+        raise ValueError(
+            f"streamed fresh_dataset: chunks covered "
+            f"{int(ds._pushed.sum())}/{num_rows} rows")
+    ds.metadata.label = np.concatenate(labels)
+    if scores is not None:
+        ds._init_model_raw_scores = np.concatenate(
+            [s.reshape(len(s), -1) for s in scores], axis=0)
+    return ds
+
+
+def train_candidate(deployed, train_set, params: dict,
+                    num_boost_round: int, **train_kw):
+    """Warm-start ``num_boost_round`` fresh boosting rounds from the
+    DEPLOYED model over ``train_set`` (``lgb.train(init_model=...)``:
+    the deployed trees are stacked under the candidate and boosting
+    resumes from their predictions).  Compatibility between the init
+    model and the train set is validated up front
+    (``engine.InitModelCompatibilityError``), not by a shape failure
+    mid-boost."""
+    from ..engine import train
+    return train(dict(params), train_set, num_boost_round,
+                 init_model=deployed, verbose_eval=False, **train_kw)
+
+
+def save_candidate(booster, manager) -> str:
+    """Write the candidate's checkpoint bundle (atomic, sha256
+    manifest) through ``manager`` (resilience.CheckpointManager);
+    returns the bundle path the rollout phase verifies and promotes."""
+    return manager.save(booster, iteration=booster.current_iteration())

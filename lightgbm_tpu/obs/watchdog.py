@@ -19,6 +19,11 @@ WATCHES it happen and raises the alarm when it stops or degrades:
 - **latency ceilings** — ``watch_histogram_p99`` holds a latency
   histogram's estimated p99 (from its cumulative buckets) to a ceiling:
   the serving-p99 SLO.
+- **model freshness** — ``watch_freshness``/``mark_fresh`` hold a
+  deployed model's age (seconds since its last promotion,
+  ``model_age_seconds`` gauge) to a ceiling
+  (``LIGHTGBM_TPU_SLO_MODEL_AGE_S``): the lifecycle's "never serve a
+  stale model" SLO (docs/LIFECYCLE.md).
 
 Every breach increments ``slo_breach_total{slo=...}`` on the process
 registry, logs loudly, and — on the rising edge only, so a persistent
@@ -44,6 +49,7 @@ _WATCHDOG_ENV = "LIGHTGBM_TPU_WATCHDOG"
 _SLO_TPS_ENV = "LIGHTGBM_TPU_SLO_TREES_PER_SEC"
 _SLO_P99_ENV = "LIGHTGBM_TPU_SLO_SERVING_P99_MS"
 _SLO_STALE_ENV = "LIGHTGBM_TPU_SLO_HEARTBEAT_S"
+_SLO_AGE_ENV = "LIGHTGBM_TPU_SLO_MODEL_AGE_S"
 _INTERVAL_ENV = "LIGHTGBM_TPU_WATCHDOG_INTERVAL_S"
 
 
@@ -66,6 +72,7 @@ class SLOConfig:
     heartbeat_stale_s: float = 300.0
     trees_per_sec_floor: Optional[float] = None
     serving_p99_ms: Optional[float] = None
+    model_age_max_s: Optional[float] = None
     check_interval_s: float = 5.0
 
     @classmethod
@@ -76,6 +83,7 @@ class SLOConfig:
             cfg.heartbeat_stale_s = v
         cfg.trees_per_sec_floor = _env_float(_SLO_TPS_ENV)
         cfg.serving_p99_ms = _env_float(_SLO_P99_ENV)
+        cfg.model_age_max_s = _env_float(_SLO_AGE_ENV)
         v = _env_float(_INTERVAL_ENV)
         if v is not None and v > 0:
             cfg.check_interval_s = v
@@ -113,6 +121,8 @@ class Watchdog:
         self._floors: dict = {}       # name -> rate floor (units/sec)
         self._rate_state: dict = {}   # guarded-by: _lock (ts, count)/name
         self._hists: dict = {}        # name -> (Histogram, ceiling_ms)
+        self._fresh: dict = {}        # guarded-by: _lock
+        #                               name -> (fresh_ts, max_age_s|None)
         self._breached: set = set()   # guarded-by: _lock (edge detection)
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -172,6 +182,39 @@ class Watchdog:
             # edge (its dump would otherwise be suppressed forever)
             self._breached.discard(f"slo:{name}")
 
+    # ----------------------------------------------------------- freshness
+
+    def watch_freshness(self, name: str,
+                        max_age_s: Optional[float] = None) -> None:
+        """Hold ``name``'s model age (seconds since the last
+        ``mark_fresh``) to ``max_age_s`` (default: the config's
+        ``model_age_max_s``; never breaches while both are None).  The
+        age is published as ``model_age_seconds{model=...}`` either way
+        — freshness is a first-class SLO of the model lifecycle
+        (docs/LIFECYCLE.md): a deployment that stops refreshing breaches
+        ``freshness:<name>`` and dumps a forensic bundle."""
+        with self._lock:
+            prev = self._fresh.get(name)
+            self._fresh[name] = (prev[0] if prev is not None
+                                 else time.monotonic(), max_age_s)
+
+    def mark_fresh(self, name: str) -> None:
+        """Reset ``name``'s model age to zero (called at promotion)."""
+        with self._lock:
+            entry = self._fresh.get(name)
+            self._fresh[name] = (time.monotonic(),
+                                 entry[1] if entry is not None else None)
+
+    def unwatch_freshness(self, name: str) -> None:
+        with self._lock:
+            self._fresh.pop(name, None)
+            self._breached.discard(f"freshness:{name}")
+
+    def model_age_s(self, name: str) -> Optional[float]:
+        with self._lock:
+            entry = self._fresh.get(name)
+        return None if entry is None else time.monotonic() - entry[0]
+
     # -------------------------------------------------------------- checks
 
     def _breach(self, slo: str, evidence: dict) -> None:
@@ -184,7 +227,8 @@ class Watchdog:
         name = slo.split(":", 1)[-1]
         with self._lock:
             if name not in self._watched and name not in self._floors \
-                    and name not in self._hists:
+                    and name not in self._hists \
+                    and name not in self._fresh:
                 return
             rising = slo not in self._breached
             self._breached.add(slo)
@@ -212,6 +256,7 @@ class Watchdog:
             watched = dict(self._watched)
             floors = dict(self._floors)
             hists = dict(self._hists)
+            fresh = dict(self._fresh)
         for name, stale_s in watched.items():
             ts_count = self._beats.get(name)
             if ts_count is None:
@@ -254,6 +299,20 @@ class Watchdog:
                     "p99_ms": p99, "ceiling_ms": ceiling}))
             else:
                 self._clear(f"slo:{name}")
+        for name, (fresh_ts, max_age) in fresh.items():
+            age = now - fresh_ts
+            self._reg().gauge("model_age_seconds",
+                              labels={"model": name}).set(round(age, 3))
+            if max_age is None:
+                max_age = self.config.model_age_max_s
+            if max_age is None:
+                continue
+            if age > max_age:
+                breaches.append((f"freshness:{name}", {
+                    "model_age_s": round(age, 3),
+                    "max_age_s": max_age}))
+            else:
+                self._clear(f"freshness:{name}")
         for slo, evidence in breaches:
             self._breach(slo, evidence)
         return breaches
@@ -306,6 +365,7 @@ def maybe_start_from_env() -> bool:
     cfg = SLOConfig.from_env()
     if not opted and cfg.trees_per_sec_floor is None \
             and cfg.serving_p99_ms is None \
+            and cfg.model_age_max_s is None \
             and _env_float(_SLO_STALE_ENV) is None:
         return False
     global_watchdog.config = cfg

@@ -318,11 +318,22 @@ class CheckpointManager:
         log_info(f"checkpoint: wrote {path} (keep_last={self.keep_last})")
         return path
 
-    def latest_verified(self) -> Checkpoint:
+    def latest_verified(self, before: Optional[str] = None) -> Checkpoint:
         """Newest bundle that passes verification; corrupt ones are
         skipped with a loud warning.  Raises CheckpointNotFoundError when
-        nothing survives."""
+        nothing survives.
+
+        ``before`` (a bundle path/filename, or an iteration number)
+        restricts the walk to bundles strictly OLDER than it — the
+        lifecycle rollback pin: "the newest verified bundle older than
+        the failed candidate", so a rollback can never race a
+        concurrent save into re-promoting the model it is rolling
+        back (docs/LIFECYCLE.md)."""
         names = self.bundles()
+        if before is not None:
+            cutoff = (self.path_for(before) if isinstance(before, int)
+                      else str(before)).rsplit("/", 1)[-1]
+            names = [n for n in names if n < cutoff]
         errors: List[Tuple[str, str]] = []
         for name in reversed(names):
             path = f"{self.directory}/{name}"

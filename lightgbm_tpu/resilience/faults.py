@@ -9,7 +9,11 @@ Two seams exist already and both are wrapped, never monkeypatched:
 - the pluggable file system of ``utils/file_io.py`` —
   ``install_filesystem`` registers a ``chaos://`` scheme whose opener
   proxies to the real path underneath while injecting ENOSPC, silent
-  partial writes (the "crash mid-write" shape) and transient errors.
+  partial writes (the "crash mid-write" shape) and transient errors;
+- a predict callable (the lifecycle canary's serving path,
+  docs/LIFECYCLE.md) — ``wrap_predict`` injects latency spikes
+  (``serving.delay``), NaN outputs (``serving.nan``) and hard failures
+  (``serving.error``) so every rollout gate can be driven to breach.
 
 Faults are SCHEDULED, not sprayed: a ``FaultSpec`` names a site
 (``allgather`` / ``fs``), a kind, the 0-based op index at which it fires
@@ -46,6 +50,11 @@ from ..utils.log import log_warning
 ALLGATHER_KINDS = ("drop", "truncate", "bitflip", "delay", "stall",
                    "recv_bitflip", "recv_truncate")
 FS_KINDS = ("enospc", "partial", "transient")
+# the serving site wraps a predict callable (lifecycle canary path,
+# docs/LIFECYCLE.md): ``delay`` injects a latency spike (arg/sec
+# seconds), ``nan`` poisons one output element, ``error`` raises — the
+# exact failure shapes the rollout gates must catch
+SERVING_KINDS = ("delay", "nan", "error")
 
 
 class FaultInjected(OSError):
@@ -63,8 +72,10 @@ class FaultSpec:
     fired: int = 0
 
     def __post_init__(self):
-        ok = ALLGATHER_KINDS if self.site == "allgather" else FS_KINDS
-        if self.site not in ("allgather", "fs"):
+        kinds = {"allgather": ALLGATHER_KINDS, "fs": FS_KINDS,
+                 "serving": SERVING_KINDS}
+        ok = kinds.get(self.site)
+        if ok is None:
             raise ValueError(f"unknown fault site {self.site!r}")
         if self.kind not in ok:
             raise ValueError(
@@ -182,6 +193,37 @@ class ChaosRegistry:
                     out = list(out)
                     out[victim] = (blob[:i] + bytes([blob[i] ^ 0x40])
                                    + blob[i + 1:])
+            return out
+
+        return chaotic
+
+    # -------------------------------------------------------------- serving
+
+    def wrap_predict(self, fn: Callable) -> Callable:
+        """Chaos wrapper for a predict callable (the lifecycle canary's
+        serving path): scheduled ``serving.delay`` sleeps before the
+        call (a mid-ramp latency spike), ``serving.error`` raises
+        instead of serving, ``serving.nan`` poisons one element of the
+        returned scores — each at its 0-based call index, exactly like
+        the other sites."""
+
+        def chaotic(*args, **kwargs):
+            import numpy as np
+            op = self._next_op("serving", None)
+            post = []
+            for s in self._due("serving", None, op):
+                if s.kind == "delay":
+                    time.sleep(s.arg or 0.05)
+                elif s.kind == "error":
+                    raise FaultInjected(
+                        errno.EIO, "chaos: injected serving error")
+                else:
+                    post.append(s)
+            out = fn(*args, **kwargs)
+            for s in post:
+                if s.kind == "nan":
+                    out = np.array(out, dtype=np.float64, copy=True)
+                    out.reshape(-1)[0] = np.nan
             return out
 
         return chaotic

@@ -5,6 +5,13 @@ stage and ``tools/serve_smoke.py`` (their drivers used to be near-twins;
 a fix to one — e.g. dead-thread error accounting — kept missing the
 other).  Deliberately not a benchmark harness: it fires, optionally
 verifies bit-equality, and reports honest completed counts.
+
+``fire_requests`` additionally speaks **shadow mode** for the model
+lifecycle (docs/LIFECYCLE.md): a ``mirror_fraction`` sample of live
+requests is replayed against a candidate server and the summary's
+``shadow`` section carries the per-request raw-score drift and latency
+deltas — mirrored work is accounted SEPARATELY so the live path's
+shed/latency numbers stay honest.
 """
 
 from __future__ import annotations
@@ -18,32 +25,95 @@ import numpy as np
 
 def fire_requests(server, n_requests: int, n_threads: int,
                   max_request_rows: int, num_features: int,
-                  verify_forest=None, timeout: float = 300.0) -> dict:
+                  verify_forest=None, timeout: float = 300.0,
+                  shadow_server=None, mirror_fraction: float = 0.25,
+                  seed: int = 100) -> dict:
     """Fire ``n_requests`` (rounded down to a multiple of ``n_threads``)
     mixed-size requests of float32-precise rows from ``n_threads``
     threads; return completed/row counts, wall time, and per-thread
     errors.  With ``verify_forest`` every response is checked bit-equal
     to ``verify_forest.predict_raw`` (the serving acceptance bar).
+
+    ``QueueFull`` sheds and ``DeadlineExceeded`` expiries on the LIVE
+    path are counted as typed outcomes (``shed`` / ``expired``), not as
+    thread-killing errors — under deliberate overload both are correct
+    behavior, and a shed must not erase the rest of a thread's clean
+    numbers.
+
+    **Shadow mode** (docs/LIFECYCLE.md): with ``shadow_server`` a
+    ``mirror_fraction`` sample of completed live requests is ALSO sent
+    to the candidate, and the summary's ``shadow`` section reports the
+    mirrored count, per-request candidate-vs-live raw-score drift
+    (max/mean of per-request max |delta|), candidate latencies, the
+    per-request latency delta, non-finite candidate outputs, and
+    candidate-side errors — all SEPARATE from the live counts, so live
+    shed/latency accounting stays honest under mirroring.
     """
+    from .errors import DeadlineExceeded, QueueFull
+
     per_thread = n_requests // n_threads
     done = [0] * n_threads
     rows_served = [0] * n_threads
+    lock = threading.Lock()
     mismatches: list = []
     errors: list = []
+    live = {"shed": 0, "expired": 0, "lat_ms": []}
+    shadow = {"mirrored": 0, "drift": [], "lat_ms": [], "lat_delta_ms": [],
+              "nonfinite": 0, "errors": []}
+
+    def mirror(tidx: int, Xr, out, live_lat: float) -> None:
+        t0 = time.perf_counter()
+        try:
+            cand = shadow_server.predict(Xr, timeout=timeout)
+        except Exception as e:  # a candidate failure is candidate
+            with lock:          # evidence, never a live-path error
+                shadow["mirrored"] += 1
+                shadow["errors"].append(
+                    f"thread {tidx}: {type(e).__name__}: {str(e)[:200]}")
+            return
+        lat = (time.perf_counter() - t0) * 1e3
+        cand = np.asarray(cand, np.float64)
+        finite = bool(np.isfinite(cand).all())
+        with lock:
+            shadow["mirrored"] += 1
+            shadow["lat_ms"].append(lat)
+            shadow["lat_delta_ms"].append(lat - live_lat)
+            if finite:
+                shadow["drift"].append(float(np.max(np.abs(
+                    cand - np.asarray(out, np.float64)))))
+            else:
+                shadow["nonfinite"] += 1
 
     def worker(tidx: int) -> None:
-        r = np.random.RandomState(100 + tidx)
+        r = np.random.RandomState(seed + tidx)
         try:
             for _ in range(per_thread):
                 m = int(r.randint(1, max_request_rows + 1))
                 Xr = r.randn(m, num_features).astype(np.float32) \
                     .astype(np.float64)
-                out = server.predict(Xr, timeout=timeout)
+                do_mirror = (shadow_server is not None
+                             and r.rand() < mirror_fraction)
+                t0 = time.perf_counter()
+                try:
+                    out = server.predict(Xr, timeout=timeout)
+                except QueueFull:
+                    with lock:
+                        live["shed"] += 1
+                    continue
+                except DeadlineExceeded:
+                    with lock:
+                        live["expired"] += 1
+                    continue
+                lat = (time.perf_counter() - t0) * 1e3
                 rows_served[tidx] += m
                 done[tidx] += 1
+                with lock:
+                    live["lat_ms"].append(lat)
                 if verify_forest is not None and not np.array_equal(
                         out, verify_forest.predict_raw(Xr)[0]):
                     mismatches.append((tidx, m))
+                if do_mirror:
+                    mirror(tidx, Xr, out, lat)
         except Exception as e:  # a dead thread must not bank clean numbers
             errors.append(f"thread {tidx}: {type(e).__name__}: {str(e)[:200]}")
 
@@ -54,14 +124,31 @@ def fire_requests(server, n_requests: int, n_threads: int,
         t.start()
     for t in threads:
         t.join()
-    return {
+    out = {
         "requests": sum(done),
         "requests_planned": per_thread * n_threads,
         "rows": sum(rows_served),
+        "shed": live["shed"],
+        "expired": live["expired"],
         "wall_seconds": time.perf_counter() - t0,
+        "latency_ms": _latency_summary(live["lat_ms"]),
         "mismatches": mismatches,
         "errors": errors,
     }
+    if shadow_server is not None:
+        drift = np.asarray(shadow["drift"], np.float64)
+        out["shadow"] = {
+            "mirrored": shadow["mirrored"],
+            "drift_max": (round(float(drift.max()), 6)
+                          if drift.size else None),
+            "drift_mean": (round(float(drift.mean()), 6)
+                           if drift.size else None),
+            "nonfinite": shadow["nonfinite"],
+            "latency_ms": _latency_summary(shadow["lat_ms"]),
+            "latency_delta_ms": _latency_summary(shadow["lat_delta_ms"]),
+            "errors": shadow["errors"],
+        }
+    return out
 
 
 def _latency_summary(lat_ms: list) -> dict:

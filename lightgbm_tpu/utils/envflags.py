@@ -45,6 +45,7 @@ def _f(name: str, default: str, consumer: str, doc: str,
 _PERF = "docs/PERF.md"
 _PERFORMANCE = "docs/PERFORMANCE.md"
 _OBS = "docs/OBSERVABILITY.md"
+_LIFECYCLE = "docs/LIFECYCLE.md"
 
 FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
     # ------------------------------------------------ kernel/planner gates
@@ -94,6 +95,20 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "persistent XLA compile-cache + AOT-export directory", _PERF),
     _f("LGBT_DEFER_HOST_TREES", "", "boosting/gbdt.py",
        "'1' defers host tree fetch to training end (legacy prefix)", _PERF),
+    # ------------------------------------------------------ model lifecycle
+    _f("LGBM_TPU_LIFECYCLE_DIR", "", "lifecycle/rollout.py",
+       "bundle + rollout-journal directory for the model lifecycle",
+       _LIFECYCLE),
+    _f("LGBM_TPU_LIFECYCLE_DRIFT_BUDGET", "10.0", "lifecycle/rollout.py",
+       "max candidate-vs-live raw-score drift a rollout tolerates",
+       _LIFECYCLE),
+    _f("LGBM_TPU_LIFECYCLE_P99_MS", "", "lifecycle/rollout.py",
+       "candidate p99 latency ceiling (ms) for the rollout gates",
+       _LIFECYCLE),
+    _f("LGBM_TPU_LIFECYCLE_MIRROR", "0.25", "lifecycle/rollout.py",
+       "fraction of live requests mirrored to the candidate", _LIFECYCLE),
+    _f("LGBM_TPU_LIFECYCLE_RAMP", "0.05,0.25,0.5", "lifecycle/rollout.py",
+       "comma list of staged canary traffic fractions", _LIFECYCLE),
     # ------------------------------------------------------ parallel plane
     _f("LGBM_TPU_NUM_SLICES", "", "parallel/learners.py",
        "slice count for the simulated/hybrid multi-host mesh", _PERF),
@@ -123,6 +138,9 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "training throughput floor (trees/sec) the sentry enforces", _OBS),
     _f("LIGHTGBM_TPU_SLO_SERVING_P99_MS", "", "obs/watchdog.py",
        "serving p99 latency ceiling (ms)", _OBS),
+    _f("LIGHTGBM_TPU_SLO_MODEL_AGE_S", "", "obs/watchdog.py",
+       "deployed-model freshness ceiling (seconds since promotion)",
+       _OBS),
     _f("LIGHTGBM_TPU_SLO_HEARTBEAT_S", "300", "obs/watchdog.py",
        "heartbeat staleness threshold (seconds)", _OBS),
     _f("LIGHTGBM_TPU_METRICS_PORT", "", "obs/http.py",
@@ -197,6 +215,8 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        _PERF),
     _f("BENCH_SKIP_RESILIENCE", "", "bench.py",
        "'1' skips the resilience stage", _PERF),
+    _f("BENCH_SKIP_LIFECYCLE", "", "bench.py",
+       "'1' skips the model-lifecycle stage", _PERF),
     _f("BENCH_SKIP_OBS", "", "bench.py",
        "'1' skips obs_dump/obs_doctor stages + the measured-MFU table",
        _OBS),

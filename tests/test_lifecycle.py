@@ -1,0 +1,668 @@
+"""Guarded model lifecycle (lightgbm_tpu/lifecycle/): continual
+refresh, shadow/canary promotion, automated rollback, crash-resume,
+freshness SLO (docs/LIFECYCLE.md).
+
+The load-bearing claims:
+
+* a clean promotion serves the candidate bit-identically and resets
+  ``model_age_seconds``;
+* EVERY gate breach — drift, latency, error rate, non-finite outputs,
+  a corrupt bundle, a crash — leaves the fleet serving the previous
+  model BYTE-identically and dumps a flight bundle naming the gate;
+* a restarted pipeline resumes a committed cutover or rolls back —
+  never double-promotes;
+* fresh rows are binned on the deployed model's frozen bin grid, so
+  a streamed (chunked) refresh is byte-identical to a resident one.
+
+All CPU-runnable under the tier-1 command; chaos faults ride the PR 2
+``ChaosRegistry`` (``serving`` site) and ``chaos://`` filesystem.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.engine import InitModelCompatibilityError
+from lightgbm_tpu.lifecycle import (CANARY_SUFFIX, LifecycleConfig,
+                                    LifecycleController, booster_digest,
+                                    fresh_dataset, replay_traffic)
+from lightgbm_tpu.lifecycle.journal import (RolloutJournal,
+                                            RolloutJournalError)
+from lightgbm_tpu.obs.flight import FlightRecorder, global_flight
+from lightgbm_tpu.obs.metrics import MetricsRegistry
+from lightgbm_tpu.obs.watchdog import SLOConfig, Watchdog, global_watchdog
+from lightgbm_tpu.resilience.checkpoint import (CheckpointManager,
+                                                CheckpointNotFoundError)
+from lightgbm_tpu.resilience.faults import ChaosRegistry
+
+pytestmark = pytest.mark.lifecycle
+
+F = 8
+
+
+@pytest.fixture(autouse=True)
+def _flight_tmp(tmp_path, monkeypatch):
+    """Every test gets its own flight-bundle dir and a fresh dump
+    budget (rollbacks dump on purpose; the per-process cap must not
+    starve later tests)."""
+    monkeypatch.setattr(global_flight, "_out_dir", str(tmp_path))
+    monkeypatch.setattr(global_flight, "dumps", 0)
+    monkeypatch.setattr(global_flight, "max_dumps", 1 << 20)
+    yield
+
+
+def _data(seed, n, f=F):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+
+
+@pytest.fixture(scope="module")
+def deployed():
+    """One deployed model shared by every test: promotions swap FLEET
+    entries, never this booster, and refreshed candidates copy the tree
+    LIST (engine `_apply_init_model`), so no test can mutate it."""
+    X, y = _data(0, 2000)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    b = lgb.train(PARAMS, ds, 6, verbose_eval=False)
+    return b, ds, X
+
+
+def _fleet(booster):
+    # a short bucket ladder (8/16/32): canary warm() compiles every
+    # bucket per candidate digest, and these tests promote a lot
+    fl = lgb.Fleet(max_batch_rows=32)
+    fl.add_model("live", booster)
+    return fl
+
+
+def _controller(fleet, tmp_path, chaos=None, **cfg):
+    cfg.setdefault("drift_budget", 50.0)
+    cfg.setdefault("mirror_fraction", 0.5)
+    cfg.setdefault("ramp", (0.25, 0.5))
+    return LifecycleController(
+        fleet, "live", directory=str(tmp_path / "lc"),
+        config=LifecycleConfig(**cfg), chaos=chaos)
+
+
+def _dumps_named(tmp_path, token):
+    return [d for d in os.listdir(tmp_path)
+            if d.startswith("flight_lifecycle") and token in d]
+
+
+# --------------------------------------------------------------- full cycle
+
+
+def test_full_cycle_promotion_bit_parity(deployed, tmp_path):
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    try:
+        ctl = _controller(fleet, tmp_path)
+        Xf, yf = _data(1, 1000)
+        bundle, cand = ctl.refresh(Xf, yf, params=PARAMS,
+                                   num_boost_round=3)
+        assert cand.current_iteration() == 9       # 6 warm + 3 fresh
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=24))
+        assert res["status"] == "promoted"
+        # the fleet now serves the candidate BIT-identically
+        served = fleet.predict("live", X[:32], timeout=120)
+        assert np.array_equal(served,
+                              cand.predict(X[:32], raw_score=True))
+        assert fleet.entry("live").model.digest == booster_digest(cand)
+        # the canary entry is gone; freshness was reset
+        assert fleet.models() == ["live"]
+        age = global_watchdog.model_age_s("live")
+        assert age is not None and age < 60.0
+        # journal records the promotion durably
+        rec = ctl.journal.load()
+        assert rec["status"] == "promoted"
+        assert rec["candidate_digest"] == booster_digest(cand)
+        # every phase was measured
+        assert res["phases"]["shadow"]["mirrored"] > 0
+        assert len(res["phases"]["ramp"]) == 2
+    finally:
+        fleet.close()
+
+
+def test_second_refresh_after_promotion(deployed, tmp_path):
+    """A promoted candidate is reloaded from model text; the controller
+    must keep binning later refreshes on the ORIGINAL frozen grid."""
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    try:
+        ctl = _controller(fleet, tmp_path)
+        Xf, yf = _data(1, 1000)
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=16))
+        assert res["status"] == "promoted"
+        Xg, yg = _data(2, 1000)
+        bundle2, cand2 = ctl.refresh(Xg, yg, num_boost_round=3)
+        assert cand2.current_iteration() == 12     # 6 + 3 + 3
+        res2 = ctl.promote(bundle2, probe_X=X[:64],
+                           traffic=replay_traffic(X, requests=16))
+        assert res2["status"] == "promoted"
+        assert fleet.entry("live").model.digest == booster_digest(cand2)
+    finally:
+        fleet.close()
+
+
+def test_streamed_chunked_refresh_byte_identical(deployed):
+    """Fresh rows pushed chunk-by-chunk through the streaming plane
+    (frozen-grid binning + push-time init scores) must train the SAME
+    candidate as a resident refresh — byte-identical model text."""
+    b, ds, X = deployed
+    Xf, yf = _data(3, 1400)
+    res_ds = fresh_dataset(ds, Xf, yf)
+    cand_res = lgb.train(PARAMS, res_ds, 3, init_model=b,
+                         verbose_eval=False)
+    chunks = [(Xf[i:i + 500], yf[i:i + 500])      # ragged final chunk
+              for i in range(0, 1400, 500)]
+    str_ds = fresh_dataset(ds, chunks=iter(chunks), num_rows=1400,
+                           predictor=b)
+    cand_str = lgb.train(PARAMS, str_ds, 3, init_model=b,
+                         verbose_eval=False)
+    assert cand_res.model_to_string() == cand_str.model_to_string()
+
+
+# ------------------------------------------------------------ gate breaches
+
+
+def test_drift_gate_rolls_back_bit_identical(deployed, tmp_path):
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    try:
+        ctl = _controller(fleet, tmp_path, drift_budget=1e-12,
+                          mirror_fraction=1.0)
+        Xf, yf = _data(1, 1000)
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        pre = fleet.predict("live", X[:32], timeout=120)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=16))
+        assert res["status"] == "rolled_back" and res["gate"] == "drift"
+        post = fleet.predict("live", X[:32], timeout=120)
+        assert np.array_equal(pre, post)
+        assert fleet.models() == ["live"]          # canary unregistered
+        assert ctl.journal.load()["status"] == "rolled_back"
+        # the forensic bundle names the gate and parses as JSON
+        dumps = _dumps_named(tmp_path, "drift")
+        assert dumps, os.listdir(tmp_path)
+        bundle_json = json.load(open(tmp_path / dumps[0]))
+        assert bundle_json["trigger"] == "lifecycle:drift"
+        assert bundle_json["extra"]["gate"] == "drift"
+        assert "traceEvents" in bundle_json["ring"]
+    finally:
+        fleet.close()
+
+
+def test_chaos_corrupt_bundle_gate(deployed, tmp_path):
+    """A candidate bundle torn by a chaos:// partial write must fail
+    manifest verification and roll back before the candidate ever
+    serves."""
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    chaos = ChaosRegistry("fs.partial@0", seed=0)
+    chaos.install_filesystem()
+    try:
+        ctl = LifecycleController(
+            fleet, "live", directory=f"chaos://{tmp_path}/lc",
+            config=LifecycleConfig(drift_budget=50.0))
+        Xf, yf = _data(1, 1000)
+        # op 0 = the bundle write itself -> silently half-persisted
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        pre = fleet.predict("live", X[:32], timeout=120)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=8))
+        assert res["status"] == "rolled_back"
+        assert res["gate"] == "bundle-verify"
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+        assert _dumps_named(tmp_path, "bundle-verify")
+    finally:
+        chaos.uninstall_filesystem()
+        fleet.close()
+
+
+def test_chaos_nan_candidate_gate(deployed, tmp_path):
+    """NaN candidate outputs during shadow breach the nonfinite gate;
+    callers never see the NaN (shadow mirrors are observation-only)."""
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    chaos = ChaosRegistry(
+        ",".join(f"serving.nan@{i}" for i in range(16)), seed=0)
+    try:
+        ctl = _controller(fleet, tmp_path, chaos=chaos,
+                          mirror_fraction=1.0)
+        Xf, yf = _data(1, 1000)
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        pre = fleet.predict("live", X[:32], timeout=120)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=12))
+        assert res["status"] == "rolled_back"
+        assert res["gate"] == "nonfinite"
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+        assert _dumps_named(tmp_path, "nonfinite")
+    finally:
+        fleet.close()
+
+
+def test_chaos_latency_spike_mid_ramp(deployed, tmp_path):
+    """A latency spike that begins mid-ramp (the shadow window was
+    clean) must breach the p99 gate at that ramp step and roll back."""
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    # shadow mirrors ~12 candidate calls first (mirror_fraction 0.5 of
+    # 24 requests); the spike starts strictly after that window
+    chaos = ChaosRegistry(
+        ",".join(f"serving.delay@{i}:sec=0.25" for i in range(14, 90)),
+        seed=0)
+    try:
+        ctl = _controller(fleet, tmp_path, chaos=chaos,
+                          mirror_fraction=0.5, p99_budget_ms=100.0,
+                          ramp=(0.5,))
+        Xf, yf = _data(1, 1000)
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        pre = fleet.predict("live", X[:32], timeout=120)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=24))
+        assert res["status"] == "rolled_back"
+        assert res["gate"] == "latency"
+        assert res["evidence"]["phase"].startswith("ramp")
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+        assert _dumps_named(tmp_path, "latency")
+    finally:
+        fleet.close()
+
+
+def test_chaos_error_gate_degrades_to_live(deployed, tmp_path):
+    """Hard candidate failures breach the error-rate gate — and every
+    canary-routed request degraded to the live model instead of
+    failing the caller."""
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    chaos = ChaosRegistry(
+        ",".join(f"serving.error@{i}" for i in range(64)), seed=0)
+    try:
+        ctl = _controller(fleet, tmp_path, chaos=chaos,
+                          mirror_fraction=1.0)
+        Xf, yf = _data(1, 1000)
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        pre = fleet.predict("live", X[:32], timeout=120)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=12))
+        assert res["status"] == "rolled_back"
+        assert res["gate"] == "error-rate"
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+    finally:
+        fleet.close()
+
+
+def test_probe_gate_never_registers_nan_candidate(deployed, tmp_path):
+    """A candidate whose own predictions are non-finite is quarantined
+    at the probe phase — before a canary entry ever exists."""
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    try:
+        ctl = _controller(fleet, tmp_path)
+        Xf, yf = _data(1, 1000)
+        bundle, cand = ctl.refresh(Xf, yf, params=PARAMS,
+                                   num_boost_round=3)
+        # poison the banked bundle's leaf values: the reloaded
+        # candidate predicts NaN on every row
+        from lightgbm_tpu.resilience.checkpoint import (
+            build_bundle_bytes, load_checkpoint)
+        ck = load_checkpoint(bundle)
+        cand.boosting.models[-1].leaf_value[:] = np.nan
+        from lightgbm_tpu.utils.file_io import write_atomic
+        write_atomic(bundle, build_bundle_bytes(
+            cand, cand.current_iteration()))
+        pre = fleet.predict("live", X[:32], timeout=120)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=8))
+        assert res["status"] == "rolled_back" and res["gate"] == "probe"
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+        assert fleet.models() == ["live"]
+        assert ck.iteration == 9
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------------- crash/resume
+
+
+def test_crash_resume_mid_ramp_rolls_back(deployed, tmp_path):
+    """A pipeline killed between ramp steps leaves an in_progress
+    journal and a stale canary; a fresh controller's resume() must
+    clean both up and keep the fleet serving the old model
+    bit-identically."""
+    b, ds, X = deployed
+    Xf, yf = _data(1, 1000)
+    cand = lgb.train(PARAMS, fresh_dataset(ds, Xf, yf), 4,
+                     init_model=b, verbose_eval=False)
+    mgr = CheckpointManager(str(tmp_path / "lc"), prefix="lifecycle")
+    bundle = mgr.save(cand, iteration=cand.current_iteration())
+    # the "crashed" process: journal parked at ramp step 0, canary
+    # still registered
+    j = RolloutJournal(str(tmp_path / "lc" / "rollout.json"))
+    rec = j.begin("live", bundle, booster_digest(cand), None,
+                  booster_digest(b), (0.25, 0.5))
+    j.phase(rec, "ramp", 0)
+    fleet = _fleet(b)
+    fleet.add_model("live" + CANARY_SUFFIX, cand, weight=0.1)
+    try:
+        pre = b.predict(X[:32], raw_score=True)
+        ctl = _controller(fleet, tmp_path)
+        out = ctl.resume()
+        assert out["status"] == "rolled_back"
+        assert out["gate"] == "crash-resume"
+        assert fleet.models() == ["live"]
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+        assert ctl.journal.load()["status"] == "rolled_back"
+        # idempotent: a second resume is a no-op
+        assert ctl.resume()["status"] == "idle"
+    finally:
+        fleet.close()
+
+
+def test_resume_finishes_committed_cutover(deployed, tmp_path):
+    """A crash AFTER the swap landed but BEFORE the journal recorded
+    ``promoted``: resume() must finish the promotion (the live digest
+    is the commit witness) — and never swap again."""
+    b, ds, X = deployed
+    Xf, yf = _data(1, 1000)
+    cand = lgb.train(PARAMS, fresh_dataset(ds, Xf, yf), 4,
+                     init_model=b, verbose_eval=False)
+    mgr = CheckpointManager(str(tmp_path / "lc"), prefix="lifecycle")
+    bundle = mgr.save(cand, iteration=cand.current_iteration())
+    j = RolloutJournal(str(tmp_path / "lc" / "rollout.json"))
+    rec = j.begin("live", bundle, booster_digest(cand), None,
+                  booster_digest(b), (0.25,))
+    j.phase(rec, "cutover")
+    fleet = _fleet(cand)           # the flip already landed
+    try:
+        ctl = _controller(fleet, tmp_path)
+        swaps_before = fleet.entry("live").server.metrics.to_dict()[
+            "counters"].get("hot_swaps", 0)
+        out = ctl.resume()
+        assert out["status"] == "promoted" and out["resumed"]
+        assert ctl.journal.load()["status"] == "promoted"
+        swaps_after = fleet.entry("live").server.metrics.to_dict()[
+            "counters"].get("hot_swaps", 0)
+        assert swaps_after == swaps_before     # no double-promotion
+        assert np.array_equal(
+            fleet.predict("live", X[:32], timeout=120),
+            cand.predict(X[:32], raw_score=True))
+    finally:
+        fleet.close()
+
+
+def test_resume_uncommitted_cutover_restores_previous(deployed, tmp_path):
+    """A crash after journaling the cutover intent but BEFORE the flip:
+    the live digest is not the candidate's, so resume() rolls back."""
+    b, ds, X = deployed
+    Xf, yf = _data(1, 1000)
+    cand = lgb.train(PARAMS, fresh_dataset(ds, Xf, yf), 4,
+                     init_model=b, verbose_eval=False)
+    mgr = CheckpointManager(str(tmp_path / "lc"), prefix="lifecycle")
+    bundle = mgr.save(cand, iteration=cand.current_iteration())
+    j = RolloutJournal(str(tmp_path / "lc" / "rollout.json"))
+    rec = j.begin("live", bundle, booster_digest(cand), None,
+                  booster_digest(b), (0.25,))
+    j.phase(rec, "cutover")
+    fleet = _fleet(b)              # flip never landed
+    try:
+        pre = fleet.predict("live", X[:32], timeout=120)
+        ctl = _controller(fleet, tmp_path)
+        out = ctl.resume()
+        assert out["status"] == "rolled_back"
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+    finally:
+        fleet.close()
+
+
+def test_pipeline_error_after_flip_unflips(deployed, tmp_path):
+    """An unexpected failure AFTER the cutover swap committed (here:
+    the journal's promoted write dies) must still roll the live pointer
+    back — with the REAL candidate digest from the live journal record,
+    and the in-memory pre-promotion booster as the anchor when no older
+    verified bundle exists (a first promotion)."""
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    try:
+        ctl = _controller(fleet, tmp_path)
+        Xf, yf = _data(1, 1000)
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        pre = fleet.predict("live", X[:32], timeout=120)
+
+        def boom(rec):
+            raise RuntimeError("journal write died post-flip")
+
+        ctl.journal.promoted = boom
+        with pytest.raises(RuntimeError, match="post-flip"):
+            ctl.promote(bundle, probe_X=X[:64],
+                        traffic=replay_traffic(X, requests=12))
+        rec = ctl.journal.load()
+        assert rec["status"] == "rolled_back"
+        assert rec["gate"] == "pipeline-error"
+        assert rec["candidate_digest"]             # NOT the stale ""
+        assert rec["phase"] == "cutover"
+        assert np.array_equal(pre, fleet.predict("live", X[:32],
+                                                 timeout=120))
+        assert fleet.models() == ["live"]
+    finally:
+        fleet.close()
+
+
+def test_config_rejects_degenerate_ramp():
+    with pytest.raises(ValueError, match="ramp fractions"):
+        LifecycleConfig(ramp=())
+    with pytest.raises(ValueError, match="ramp fractions"):
+        LifecycleConfig(ramp=(1.5,))
+    with pytest.raises(ValueError, match="mirror_fraction"):
+        LifecycleConfig(mirror_fraction=1.5)
+
+
+def test_journal_refuses_concurrent_rollout(tmp_path):
+    j = RolloutJournal(str(tmp_path / "rollout.json"))
+    rec = j.begin("live", "b1", "d1", None, "d0", (0.5,))
+    with pytest.raises(RolloutJournalError, match="in_progress"):
+        j.begin("live", "b2", "d2", None, "d0", (0.5,))
+    j.rolled_back(rec, "drift", {})
+    j.begin("live", "b2", "d2", None, "d0", (0.5,))   # now fine
+
+
+# ------------------------------------------------- rollback pin (before=)
+
+
+def test_latest_verified_before_pins_older_bundle(deployed, tmp_path):
+    b, ds, X = deployed
+    mgr = CheckpointManager(str(tmp_path / "ck"), prefix="lifecycle",
+                            keep_last=5)
+    p1 = mgr.save(b, iteration=8)
+    Xf, yf = _data(1, 1000)
+    cand = lgb.train(PARAMS, fresh_dataset(ds, Xf, yf), 2,
+                     init_model=b, verbose_eval=False)
+    p2 = mgr.save(cand, iteration=10)
+    cand2 = lgb.train(PARAMS, fresh_dataset(ds, Xf, yf), 4,
+                      init_model=b, verbose_eval=False)
+    p3 = mgr.save(cand2, iteration=12)
+    # unpinned: newest wins
+    assert mgr.latest_verified().iteration == 12
+    # pinned below the "failed candidate" p2: p1 wins even though a
+    # NEWER verified bundle (p3, a concurrent save) exists
+    assert mgr.latest_verified(before=p2).iteration == 8
+    assert mgr.latest_verified(before=os.path.basename(p2)).iteration == 8
+    # an iteration number pins the same way
+    assert mgr.latest_verified(before=12).iteration == 10
+    with pytest.raises(CheckpointNotFoundError):
+        mgr.latest_verified(before=p1)
+    assert p3.endswith(".lgbckpt")
+
+
+# --------------------------------------------------------------- freshness
+
+
+def test_freshness_slo_breach_dumps(tmp_path):
+    reg = MetricsRegistry()
+    fl = FlightRecorder(enabled=True, out_dir=str(tmp_path / "fd"),
+                        max_dumps=4)
+    os.makedirs(tmp_path / "fd", exist_ok=True)
+    wd = Watchdog(SLOConfig(model_age_max_s=0.005), registry=reg,
+                  flight=fl)
+    wd.watch_freshness("m")
+    import time
+    time.sleep(0.02)
+    breaches = wd.check_once()
+    assert [s for s, _ in breaches] == ["freshness:m"]
+    # gauge published, counter bumped, bundle written on the rising edge
+    d = reg.to_dict()
+    assert d["gauges"]['model_age_seconds{model="m"}'] > 0
+    assert d["counters"]['slo_breach_total{slo="freshness:m"}'] == 1
+    dumps = os.listdir(tmp_path / "fd")
+    assert len(dumps) == 1 and "freshness" in dumps[0]
+    # persistent breach: no dump storm (edge-triggered)
+    wd.check_once()
+    assert len(os.listdir(tmp_path / "fd")) == 1
+    # mark_fresh clears the breach
+    wd.mark_fresh("m")
+    assert wd.check_once() == []
+    assert wd.model_age_s("m") < 1.0
+    wd.unwatch_freshness("m")
+    assert wd.check_once() == []
+
+
+def test_freshness_age_resets_on_promotion(deployed, tmp_path):
+    b, ds, X = deployed
+    fleet = _fleet(b)
+    try:
+        ctl = _controller(fleet, tmp_path)
+        # simulate a stale deployment, then promote
+        with global_watchdog._lock:
+            ts, cap = global_watchdog._fresh["live"]
+            global_watchdog._fresh["live"] = (ts - 10_000.0, cap)
+        assert global_watchdog.model_age_s("live") > 9_000
+        Xf, yf = _data(1, 1000)
+        bundle, _ = ctl.refresh(Xf, yf, params=PARAMS, num_boost_round=3)
+        res = ctl.promote(bundle, probe_X=X[:64],
+                          traffic=replay_traffic(X, requests=12))
+        assert res["status"] == "promoted"
+        assert global_watchdog.model_age_s("live") < 60.0
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------- init-model satellite
+
+
+def test_init_model_feature_mismatch_named_error(deployed):
+    b, ds, X = deployed
+    Xw, yw = _data(1, 400, f=F + 2)
+    with pytest.raises(InitModelCompatibilityError, match="features"):
+        lgb.train(PARAMS, lgb.Dataset(Xw, label=yw,
+                                      free_raw_data=False), 2,
+                  init_model=b, verbose_eval=False)
+
+
+def test_init_model_class_mismatch_named_error(deployed):
+    b, ds, X = deployed
+    rng = np.random.RandomState(2)
+    ym = rng.randint(0, 3, 400).astype(float)
+    with pytest.raises(InitModelCompatibilityError, match="per iteration"):
+        lgb.train({"objective": "multiclass", "num_class": 3,
+                   "verbosity": -1},
+                  lgb.Dataset(_data(1, 400)[0], label=ym,
+                              free_raw_data=False), 2,
+                  init_model=b, verbose_eval=False)
+
+
+def test_init_model_cross_load_from_model_text(deployed, tmp_path):
+    """Warm-start from saved model TEXT (the stock-LightGBM cross-load
+    path) must match warm-starting from the in-process Booster
+    byte-for-byte."""
+    b, ds, X = deployed
+    path = str(tmp_path / "deployed.txt")
+    b.save_model(path)
+    Xf, yf = _data(1, 1000)
+    from_obj = lgb.train(PARAMS, lgb.Dataset(Xf, label=yf,
+                                             free_raw_data=False), 3,
+                         init_model=b, verbose_eval=False)
+    from_txt = lgb.train(PARAMS, lgb.Dataset(Xf, label=yf,
+                                             free_raw_data=False), 3,
+                         init_model=path, verbose_eval=False)
+    assert from_obj.current_iteration() == 9
+    assert from_txt.model_to_string() == from_obj.model_to_string()
+
+
+# ------------------------------------------------------- loadgen satellite
+
+
+def test_loadgen_shadow_mode_summary(deployed):
+    from lightgbm_tpu.serving.loadgen import fire_requests
+    b, ds, X = deployed
+    Xf, yf = _data(1, 1000)
+    cand = lgb.train(PARAMS, fresh_dataset(ds, Xf, yf), 4,
+                     init_model=b, verbose_eval=False)
+    live = b.serve(max_batch_rows=128)
+    shadow = cand.serve(max_batch_rows=128)
+    try:
+        storm = fire_requests(live, 40, 4, 32, F, timeout=120,
+                              shadow_server=shadow, mirror_fraction=0.5)
+        # live accounting is honest: every planned request completed on
+        # the live path regardless of mirroring
+        assert storm["requests"] == storm["requests_planned"] == 40
+        assert storm["shed"] == 0 and storm["expired"] == 0
+        assert not storm["errors"]
+        assert storm["latency_ms"]["count"] == 40
+        sh = storm["shadow"]
+        assert 0 < sh["mirrored"] < 40
+        assert sh["drift_max"] is not None and sh["drift_max"] > 0
+        assert sh["nonfinite"] == 0 and not sh["errors"]
+        assert sh["latency_ms"]["count"] == sh["mirrored"]
+        assert sh["latency_delta_ms"]["count"] == sh["mirrored"]
+    finally:
+        live.close()
+        shadow.close()
+
+
+def test_loadgen_without_shadow_unchanged(deployed):
+    from lightgbm_tpu.serving.loadgen import fire_requests
+    b, ds, X = deployed
+    n_iter = len(b.models) // b.num_tree_per_iteration
+    live = b.serve(max_batch_rows=128)
+    try:
+        storm = fire_requests(live, 20, 4, 32, F, timeout=120,
+                              verify_forest=b._forest(0, n_iter))
+        assert storm["requests"] == storm["requests_planned"]
+        assert storm["mismatches"] == []
+        assert "shadow" not in storm
+    finally:
+        live.close()
+
+
+# ------------------------------------------------------------- smoke driver
+
+
+@pytest.mark.slow
+def test_lifecycle_smoke_tool(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from lifecycle_smoke import run_smoke
+    summary = run_smoke(rows=3000, trees=6, refresh_trees=3,
+                        requests=32, threads=2,
+                        directory=str(tmp_path / "smoke"))
+    assert not summary["failed"], summary["phase_ok"]
