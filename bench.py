@@ -95,7 +95,10 @@ BENCH_SKIP_FLEET=1 skips the serving-fleet stage (lightgbm_tpu/fleet/:
 N-model registry under a shared-HBM residency plan — measured eviction
 with every model still servable, AOT zero-compile replica restart, and
 the opt-in bf16/int8 accuracy deltas via tools/fleet_smoke.py; a missed
-acceptance bar raises so failed fleet runs are never journaled);
+acceptance bar raises so failed fleet runs are never journaled) AND the
+fleet_failover stage (kill one device of a BENCH_FLEET_DEVICES-wide
+replicated PodFleet under load: zero non-typed failures, availability
+>= 0.999, recovery within one replan tick);
 BENCH_SKIP_LIFECYCLE=1 skips the guarded model-lifecycle stage
 (lightgbm_tpu/lifecycle/: continual refresh -> shadow/canary promotion
 under loadgen traffic -> forced drift rollback with the fleet's output
@@ -885,6 +888,32 @@ def run_fleet_bench(n_models=3, rows=20_000, trees=16, requests=300,
     return summary
 
 
+def run_fleet_failover_bench(devices=None, n_models=2, rows=20_000,
+                             trees=16, requests=600, threads=6):
+    """Pod-scale availability metric (lightgbm_tpu/fleet/router.py): a
+    replicated multi-device PodFleet serves a threaded traffic storm
+    while chaos VANISHES one device mid-run.  Acceptance bars (raised on
+    a miss so a failed drill is never journaled, PR 4 convention): zero
+    non-typed request failures, availability >= 0.999, every response
+    bit-identical to Booster.predict(raw_score=True), and every model's
+    replica coverage restored within ONE replan tick.  Device count:
+    BENCH_FLEET_DEVICES (default 3)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from fleet_smoke import run_failover_smoke
+    if devices is None:
+        devices = int(os.environ.get("BENCH_FLEET_DEVICES", "") or 3)
+    summary = run_failover_smoke(devices=devices, n_models=n_models,
+                                 rows=rows, trees=trees,
+                                 requests=requests, threads=threads)
+    if summary.get("failed"):
+        raise RuntimeError(
+            f"fleet failover drill missed its bars: "
+            f"availability={summary.get('availability')} "
+            f"outcomes={summary.get('outcomes')} "
+            f"recovered={summary.get('recovered_within_one_tick')}")
+    return summary
+
+
 def run_lifecycle_bench(rows=20_000, trees=12, refresh_trees=4,
                         requests=120, threads=4):
     """Guarded model-lifecycle metric (lightgbm_tpu/lifecycle/): a full
@@ -1319,6 +1348,13 @@ def tpu_worker():
     if os.environ.get("BENCH_SKIP_FLEET") != "1":
         run_stage("fleet", run_fleet_bench, budget_floor=240)
 
+    # pod-scale failover drill (fleet/topology.py + fleet/router.py):
+    # kill one replicated device under load — zero non-typed failures,
+    # availability >= 0.999, recovery within one replan tick
+    if os.environ.get("BENCH_SKIP_FLEET") != "1":
+        run_stage("fleet_failover", run_fleet_failover_bench,
+                  budget_floor=180)
+
     # fault-tolerance overhead (lightgbm_tpu/resilience/): checkpoint
     # save/load cost + resume bit-parity on the live backend
     if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
@@ -1421,6 +1457,12 @@ def cpu_worker():
                     rows=10_000, trees=10, requests=200, threads=4)
             except Exception as e:
                 res["fleet"] = {"error": str(e)[-300:]}
+            emit(res)
+            try:
+                res["fleet_failover"] = run_fleet_failover_bench(
+                    rows=10_000, trees=10, requests=300, threads=4)
+            except Exception as e:
+                res["fleet_failover"] = {"error": str(e)[-300:]}
             emit(res)
         if os.environ.get("BENCH_SKIP_RESILIENCE") != "1":
             try:

@@ -20,7 +20,7 @@ from . import serving  # noqa: F401  (in-process inference server)
 from . import fleet  # noqa: F401  (multi-model serving fleet)
 from . import lifecycle  # noqa: F401  (guarded model lifecycle)
 from .engine import CVBooster, InitModelCompatibilityError, cv, serve, train
-from .fleet import Fleet
+from .fleet import Fleet, PodFleet
 from .lifecycle import LifecycleController
 
 __version__ = "0.1.0"
@@ -29,7 +29,7 @@ __all__ = [
     "Dataset", "Booster", "Config", "LightGBMError", "train", "cv",
     "CVBooster", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "serve", "serving",
-    "fleet", "Fleet", "lifecycle", "LifecycleController",
+    "fleet", "Fleet", "PodFleet", "lifecycle", "LifecycleController",
     "InitModelCompatibilityError",
 ]
 

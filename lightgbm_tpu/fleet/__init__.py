@@ -14,8 +14,20 @@ Quick start::
     print(fleet.prometheus_text())             # model="..."-labelled
     fleet.close()
 
+Pod scale (docs/SERVING.md multi-device section; docs/RESILIENCE.md
+failover section)::
+
+    pod = lightgbm_tpu.PodFleet(devices=4)
+    pod.add_model("ranker", booster, weight=3.0,
+                  deadline_class="interactive")
+    scores = pod.predict("ranker", X)   # health-routed, hedged, replicated
+    pod.kill_device(2)                  # a replan, not an outage
+
 Module map: ``registry`` (Fleet front door: weighted admission, deadline
-classes, residency replans), ``aot`` (jax.export serialize/restore of
+classes, residency replans), ``topology`` (multi-device placement
+planner: replicate hot models, partition the cold tail), ``router``
+(PodFleet: health-scored routing, hedged retries, brownout degradation,
+device-loss failover), ``aot`` (jax.export serialize/restore of
 bucket programs under LGBM_TPU_COMPILE_CACHE/serving), ``lowprec``
 (bf16/int8 forest quantization + the accuracy-budget measurement).
 The single-model building blocks stay in ``lightgbm_tpu.serving``.
@@ -25,9 +37,14 @@ from .aot import AOTStore, aot_dir_from_env
 from .lowprec import measure_accuracy_delta, quantize_forest
 from .registry import (DEFAULT_DEADLINE_CLASSES, Fleet, FleetConfig,
                        FleetEntry)
+from .router import PodFleet, RouterConfig
+from .topology import (DeviceSpec, TopologyPlan, plan_devices,
+                       plan_topology)
 
 __all__ = [
     "Fleet", "FleetConfig", "FleetEntry", "DEFAULT_DEADLINE_CLASSES",
+    "PodFleet", "RouterConfig", "DeviceSpec", "TopologyPlan",
+    "plan_devices", "plan_topology",
     "AOTStore", "aot_dir_from_env", "quantize_forest",
     "measure_accuracy_delta",
 ]

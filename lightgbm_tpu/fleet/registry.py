@@ -117,12 +117,12 @@ class Fleet:
             raise ValueError("pass either config or keyword overrides")
         self.config = config
         self.metrics = MetricsRegistry()
-        self._entries: Dict[str, FleetEntry] = {}
+        self._entries: Dict[str, FleetEntry] = {}   # guarded-by: _lock
         self._lock = threading.Lock()       # entry map + counters (cheap ops)
         self._replan_lock = threading.Lock()    # serializes plan application
-        self._admissions = 0
+        self._admissions = 0                        # guarded-by: _lock
         self._closed = False
-        self._plan: Optional[FleetPlan] = None
+        self._plan: Optional[FleetPlan] = None      # guarded-by: _lock
         self._obs_component = _obs_registry.attach_child(
             "fleet", self.metrics)
 
@@ -201,11 +201,23 @@ class Fleet:
             self.replan()
         return entry
 
-    def remove_model(self, name: str, drain: bool = True) -> None:
+    def remove_model(self, name: str, drain: bool = True,
+                     timeout: Optional[float] = None) -> None:
+        """Unregister ``name``: DRAIN it, then replan — never race a
+        replan in flight.  ``replan`` applies residency under
+        ``_replan_lock`` while reading each entry's server; closing one
+        mid-apply would restore/drop device arrays on a dying server
+        (and an eviction landing between the pop and the close could
+        resurrect its programs).  Holding the same lock makes removal
+        atomic with respect to plan application: a concurrent replan
+        sees the entry either fully alive or fully gone.  ``timeout``
+        bounds the batcher-thread join (the pod router passes one so a
+        wedged-but-not-yet-dead device can never freeze a replan)."""
         e = self.entry(name)
-        with self._lock:
-            self._entries.pop(name, None)
-        e.server.close(drain=drain)
+        with self._replan_lock:
+            with self._lock:
+                self._entries.pop(name, None)
+            e.server.close(drain=drain, timeout=timeout)
         self.metrics.counter("fleet_models_removed").inc()
         self.replan()
 

@@ -24,6 +24,13 @@ WATCHES it happen and raises the alarm when it stops or degrades:
   ``model_age_seconds`` gauge) to a ceiling
   (``LIGHTGBM_TPU_SLO_MODEL_AGE_S``): the lifecycle's "never serve a
   stale model" SLO (docs/LIFECYCLE.md).
+- **availability** — ``watch_availability`` holds a served model's
+  windowed availability (completed / (completed + non-typed failed)
+  between sweeps, sampled from the pod fleet's per-model outcome
+  counters; typed shed/expired are NOT failures) to a floor
+  (``LIGHTGBM_TPU_SLO_AVAILABILITY``): a fleet that starts failing
+  requests breaches ``availability:<model>`` and dumps a forensic
+  bundle, mirroring the p99-ceiling pattern (docs/RESILIENCE.md).
 
 Every breach increments ``slo_breach_total{slo=...}`` on the process
 registry, logs loudly, and — on the rising edge only, so a persistent
@@ -50,6 +57,7 @@ _SLO_TPS_ENV = "LIGHTGBM_TPU_SLO_TREES_PER_SEC"
 _SLO_P99_ENV = "LIGHTGBM_TPU_SLO_SERVING_P99_MS"
 _SLO_STALE_ENV = "LIGHTGBM_TPU_SLO_HEARTBEAT_S"
 _SLO_AGE_ENV = "LIGHTGBM_TPU_SLO_MODEL_AGE_S"
+_SLO_AVAIL_ENV = "LIGHTGBM_TPU_SLO_AVAILABILITY"
 _INTERVAL_ENV = "LIGHTGBM_TPU_WATCHDOG_INTERVAL_S"
 
 
@@ -73,6 +81,7 @@ class SLOConfig:
     trees_per_sec_floor: Optional[float] = None
     serving_p99_ms: Optional[float] = None
     model_age_max_s: Optional[float] = None
+    availability_floor: Optional[float] = None
     check_interval_s: float = 5.0
 
     @classmethod
@@ -84,6 +93,7 @@ class SLOConfig:
         cfg.trees_per_sec_floor = _env_float(_SLO_TPS_ENV)
         cfg.serving_p99_ms = _env_float(_SLO_P99_ENV)
         cfg.model_age_max_s = _env_float(_SLO_AGE_ENV)
+        cfg.availability_floor = _env_float(_SLO_AVAIL_ENV)
         v = _env_float(_INTERVAL_ENV)
         if v is not None and v > 0:
             cfg.check_interval_s = v
@@ -123,6 +133,10 @@ class Watchdog:
         self._hists: dict = {}        # name -> (Histogram, ceiling_ms)
         self._fresh: dict = {}        # guarded-by: _lock
         #                               name -> (fresh_ts, max_age_s|None)
+        self._avail: dict = {}        # guarded-by: _lock
+        #                               name -> (sample_fn, floor|None)
+        self._avail_state: dict = {}  # guarded-by: _lock
+        #                               name -> (completed, failed) last sweep
         self._breached: set = set()   # guarded-by: _lock (edge detection)
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -146,6 +160,17 @@ class Watchdog:
         """Record liveness (and optionally progress) of ``name``.  One
         dict store — safe on any hot loop, watched or not."""
         self._beats[name] = (time.monotonic(), count)
+
+    def beat_age(self, name: str,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Seconds since ``name`` last beat, or None when it never has —
+        the pod router's per-replica staleness input (fleet/router.py):
+        a replica whose batcher stops beating is wedged, whatever its
+        queue says."""
+        ts_count = self._beats.get(name)
+        if ts_count is None:
+            return None
+        return (time.monotonic() if now is None else now) - ts_count[0]
 
     def watch_heartbeat(self, name: str, stale_s: Optional[float] = None,
                         floor: Optional[float] = None) -> None:
@@ -215,6 +240,30 @@ class Watchdog:
             entry = self._fresh.get(name)
         return None if entry is None else time.monotonic() - entry[0]
 
+    # --------------------------------------------------------- availability
+
+    def watch_availability(self, name: str, sample_fn,
+                           floor: Optional[float] = None) -> None:
+        """Hold ``name``'s windowed availability to ``floor`` (default:
+        the config's ``availability_floor``, i.e.
+        ``LIGHTGBM_TPU_SLO_AVAILABILITY``; never breaches while both are
+        None).  ``sample_fn() -> (completed, failed)`` returns CUMULATIVE
+        per-model outcome counts (typed shed/expired excluded from both
+        — they are correct overload behavior, not unavailability); each
+        sweep differentiates the window exactly like the rate floors, so
+        one bad minute breaches even after a long clean run.  Breaches
+        count ``slo_breach_total{slo="availability:<name>"}`` and
+        flight-dump on the rising edge, mirroring the p99 ceiling."""
+        with self._lock:
+            self._avail[name] = (sample_fn, floor)
+            self._avail_state.pop(name, None)
+
+    def unwatch_availability(self, name: str) -> None:
+        with self._lock:
+            self._avail.pop(name, None)
+            self._avail_state.pop(name, None)
+            self._breached.discard(f"availability:{name}")
+
     # -------------------------------------------------------------- checks
 
     def _breach(self, slo: str, evidence: dict) -> None:
@@ -228,7 +277,8 @@ class Watchdog:
         with self._lock:
             if name not in self._watched and name not in self._floors \
                     and name not in self._hists \
-                    and name not in self._fresh:
+                    and name not in self._fresh \
+                    and name not in self._avail:
                 return
             rising = slo not in self._breached
             self._breached.add(slo)
@@ -257,6 +307,7 @@ class Watchdog:
             floors = dict(self._floors)
             hists = dict(self._hists)
             fresh = dict(self._fresh)
+            avail = dict(self._avail)
         for name, stale_s in watched.items():
             ts_count = self._beats.get(name)
             if ts_count is None:
@@ -313,6 +364,32 @@ class Watchdog:
                     "max_age_s": max_age}))
             else:
                 self._clear(f"freshness:{name}")
+        for name, (sample_fn, floor) in avail.items():
+            if floor is None:
+                floor = self.config.availability_floor
+            try:
+                completed, failed = sample_fn()
+            except Exception:  # noqa: BLE001 — a dead sampler never kills
+                continue       # the sweep (the fleet may be closing)
+            with self._lock:    # watch/unwatch reset this concurrently
+                prev = self._avail_state.get(name)
+                self._avail_state[name] = (completed, failed)
+            if prev is None:
+                continue
+            dc, df = completed - prev[0], failed - prev[1]
+            if dc + df <= 0:
+                continue
+            a = dc / (dc + df)
+            self._reg().gauge("fleet_availability",
+                              labels={"model": name}).set(round(a, 6))
+            if floor is None:
+                continue
+            if a < floor:
+                breaches.append((f"availability:{name}", {
+                    "availability": round(a, 6), "floor": floor,
+                    "window_completed": dc, "window_failed": df}))
+            else:
+                self._clear(f"availability:{name}")
         for slo, evidence in breaches:
             self._breach(slo, evidence)
         return breaches
@@ -366,6 +443,7 @@ def maybe_start_from_env() -> bool:
     if not opted and cfg.trees_per_sec_floor is None \
             and cfg.serving_p99_ms is None \
             and cfg.model_age_max_s is None \
+            and cfg.availability_floor is None \
             and _env_float(_SLO_STALE_ENV) is None:
         return False
     global_watchdog.config = cfg

@@ -915,6 +915,23 @@ def predict_program_bytes(num_trees: int, bucket_rows: int, features: int,
     return int(b)
 
 
+def fleet_replica_bytes(m: "FleetModelShape",
+                        accel: Optional[bool] = None):
+    """Device cost of ONE replica of ``m``: ``(forest_bytes,
+    {bucket: program_bytes})`` — the unit the single-device residency
+    election (``plan_fleet``) and the multi-device placement planner
+    (``fleet/topology.plan_topology``) both charge, so a topology's
+    per-device loads and each device's own residency verdicts can never
+    disagree about what a replica costs."""
+    fb = predict_forest_bytes(
+        m.num_trees, m.nodes_dim, m.leaves_dim, m.precision,
+        m.cat_words, accel, routing_only=m.precision != "f32")
+    ladder = sorted(set(int(b) for b in m.buckets)) or [8]
+    prog = {b: predict_program_bytes(m.num_trees, b, m.features, accel)
+            for b in ladder}
+    return fb, prog
+
+
 class FleetModelShape(NamedTuple):
     """One serving model's shape as the fleet election sees it."""
 
@@ -1006,12 +1023,8 @@ def plan_fleet(models, budget_bytes: Optional[int] = None,
     for i in order:
         m = models[i]
         prio = m.weight / (1.0 + max(m.age_s, 0.0))
-        fb = predict_forest_bytes(
-            m.num_trees, m.nodes_dim, m.leaves_dim, m.precision,
-            m.cat_words, accel, routing_only=m.precision != "f32")
-        ladder = sorted(set(int(b) for b in m.buckets)) or [8]
-        prog = {b: predict_program_bytes(m.num_trees, b, m.features, accel)
-                for b in ladder}
+        fb, prog = fleet_replica_bytes(m, accel)
+        ladder = sorted(prog)
         wanted += fb + sum(prog.values())
         if used + fb + prog[ladder[0]] > budget:
             plans[i] = FleetModelPlan(m.name, False, (), fb, 0, prio)

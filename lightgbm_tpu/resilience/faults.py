@@ -55,6 +55,17 @@ FS_KINDS = ("enospc", "partial", "transient")
 # seconds), ``nan`` poisons one output element, ``error`` raises — the
 # exact failure shapes the rollout gates must catch
 SERVING_KINDS = ("delay", "nan", "error")
+# the device site kills one SIMULATED serving device of a pod fleet
+# (fleet/router.py; docs/RESILIENCE.md failover section): ``error``
+# fails one batch execution (a transient XLA / driver fault), ``wedge``
+# blocks the device's batcher thread (arg/sec seconds, default forever
+# — the preempted-but-not-dead shape whose heartbeat goes stale), and
+# ``vanish`` makes the device gone for good (every later dispatch fails
+# fast with DeviceLost).  ``rank=`` selects the device id; the 0-based
+# op index counts batch executions on that device.  wedge/vanish are
+# PERSISTENT: once fired the device stays down until the registry is
+# discarded — a replan, not a retry, is the recovery path.
+DEVICE_KINDS = ("wedge", "error", "vanish")
 
 
 class FaultInjected(OSError):
@@ -63,17 +74,17 @@ class FaultInjected(OSError):
 
 @dataclass
 class FaultSpec:
-    site: str                   # "allgather" | "fs"
+    site: str                   # "allgather" | "fs" | "serving" | "device"
     kind: str
     at: int                     # 0-based op index on that (site, rank)
-    rank: Optional[int] = None  # allgather only; None = every rank
+    rank: Optional[int] = None  # allgather rank / device id; None = all
     prob: float = 1.0           # fire probability when the index matches
     arg: float = 0.0            # delay/stall seconds, etc.
     fired: int = 0
 
     def __post_init__(self):
         kinds = {"allgather": ALLGATHER_KINDS, "fs": FS_KINDS,
-                 "serving": SERVING_KINDS}
+                 "serving": SERVING_KINDS, "device": DEVICE_KINDS}
         ok = kinds.get(self.site)
         if ok is None:
             raise ValueError(f"unknown fault site {self.site!r}")
@@ -126,6 +137,7 @@ class ChaosRegistry:
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
         self._counts: Dict[tuple, int] = {}
+        self._downed: Dict[int, str] = {}   # device id -> "wedge"|"vanish"
         self.log: List[str] = []     # every fault actually fired
 
     # ------------------------------------------------------------ core match
@@ -143,7 +155,7 @@ class ChaosRegistry:
             for s in self.specs:
                 if s.site != site or s.at != op:
                     continue
-                if site == "allgather" and s.rank is not None \
+                if site in ("allgather", "device") and s.rank is not None \
                         and s.rank != rank:
                     continue
                 if s.prob < 1.0 and self._rng.rand() >= s.prob:
@@ -225,6 +237,67 @@ class ChaosRegistry:
                     out = np.array(out, dtype=np.float64, copy=True)
                     out.reshape(-1)[0] = np.nan
             return out
+
+        return chaotic
+
+    # --------------------------------------------------------------- device
+
+    def device_down(self, device_id: int) -> Optional[str]:
+        """The persistent down-state of a simulated device: ``"wedge"`` /
+        ``"vanish"`` once such a fault fired (or ``down_device`` was
+        called), else None.  The pod router consults this at dispatch so
+        a vanished device fails FAST instead of queueing work a dead
+        batcher will never pop."""
+        with self._lock:
+            return self._downed.get(int(device_id))
+
+    def down_device(self, device_id: int, kind: str = "vanish") -> None:
+        """Imperatively kill a device NOW — the mid-run kill switch for
+        failover drills (tools/fleet_smoke.py) where the interesting
+        moment is wall-clock ("under load"), not a batch index."""
+        if kind not in ("wedge", "vanish"):
+            raise ValueError(f"device down kind must be wedge|vanish, "
+                             f"got {kind!r}")
+        with self._lock:
+            self._downed[int(device_id)] = kind
+            self.log.append(f"device[{device_id}].{kind}@manual")
+
+    def wrap_device_batch(self, device_id: int, fn: Callable) -> Callable:
+        """Chaos wrapper for one simulated serving device's batch
+        executor (the MicroBatcher ``run_batch`` seam).  Scheduled
+        ``device.error`` fails this one batch (transient — the router
+        retries elsewhere); ``device.wedge`` marks the device down and
+        blocks the batcher thread (its liveness beat goes stale — the
+        health-scored death the watchdog detects); ``device.vanish``
+        marks the device down and raises ``DeviceLost``.  A device
+        already down keeps failing/blocking on every later batch."""
+        did = int(device_id)
+
+        def chaotic(batch):
+            from ..serving.errors import DeviceLost
+            op = self._next_op("device", did)
+            for s in self._due("device", did, op):
+                if s.kind in ("wedge", "vanish"):
+                    with self._lock:
+                        self._downed[did] = s.kind
+                elif s.kind == "error":
+                    raise FaultInjected(
+                        errno.EIO,
+                        f"chaos: injected device {did} batch error")
+            state = self.device_down(did)
+            if state == "vanish":
+                raise DeviceLost(f"chaos: device {did} vanished")
+            if state == "wedge":
+                # the wedged device's batcher blocks here: in-flight
+                # items never complete, the per-replica heartbeat goes
+                # stale, and only the router's drain/replan recovers
+                spec = next((s for s in self.specs
+                             if s.site == "device" and s.kind == "wedge"
+                             and (s.rank is None or s.rank == did)), None)
+                time.sleep((spec.arg if spec is not None and spec.arg
+                            else 3600.0))
+                raise DeviceLost(f"chaos: device {did} wedged")
+            return fn(batch)
 
         return chaotic
 

@@ -17,9 +17,9 @@ instrument registry), ``errors`` (typed rejections).
 """
 
 from .batcher import BucketLadder
-from .errors import (DeadlineExceeded, LowPrecisionQuarantined,
-                     ModelNotFound, QueueFull, ServerClosed, ServingError,
-                     SwapQuarantined)
+from .errors import (DeadlineExceeded, DeviceLost,
+                     LowPrecisionQuarantined, ModelNotFound, QueueFull,
+                     ServerClosed, ServingError, SwapQuarantined)
 from .metrics import MetricsRegistry
 from .registry import CompiledModel, ModelRegistry, ProgramRegistry
 from .server import Server, ServingConfig
@@ -29,4 +29,5 @@ __all__ = [
     "ProgramRegistry", "ModelRegistry", "CompiledModel",
     "ServingError", "QueueFull", "DeadlineExceeded", "ServerClosed",
     "SwapQuarantined", "LowPrecisionQuarantined", "ModelNotFound",
+    "DeviceLost",
 ]

@@ -125,12 +125,17 @@ class MicroBatcher:
 
     def __init__(self, ladder: BucketLadder, run_batch: Callable,
                  metrics, batch_window_ms: float = 2.0,
-                 max_queue_rows: int = 1 << 16):
+                 max_queue_rows: int = 1 << 16,
+                 beat_name: str = "serving.batcher"):
         self.ladder = ladder
         self.run_batch = run_batch
         self.metrics = metrics
         self.batch_window_s = max(batch_window_ms, 0.0) / 1e3
         self.max_queue_rows = max_queue_rows
+        # per-replica liveness: a pod fleet names each replica's beat
+        # (fleet/router.py health scoring) so ONE wedged device goes
+        # stale by name instead of hiding behind a shared heartbeat
+        self.beat_name = beat_name
         self._q = collections.deque()           # guarded-by: _lock
         self._carry: Optional[WorkItem] = None  # guarded-by: _lock
         self._queued_rows = 0                   # guarded-by: _lock
@@ -218,7 +223,7 @@ class MicroBatcher:
             # liveness heartbeat every scheduler turn (idle turns wake at
             # the pop timeout): a dead batcher thread goes stale within
             # ~0.1s of real time, whatever the queue holds (watchdog.py)
-            _beat("serving.batcher")
+            _beat(self.beat_name)
             item = self._pop(timeout=0.1)
             if item is None:
                 with self._lock:

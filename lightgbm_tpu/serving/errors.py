@@ -44,3 +44,11 @@ class LowPrecisionQuarantined(SwapQuarantined):
 class ModelNotFound(ServingError):
     """A fleet request named a model the registry does not hold
     (fleet/registry.py) — a routing error, not an overload condition."""
+
+
+class DeviceLost(ServingError):
+    """A serving device of a pod fleet is gone (preempted, vanished, or
+    health-declared dead).  RETRIABLE by construction: replicas serve
+    bit-identical scores, so the router re-dispatches the request to a
+    surviving replica instead of surfacing this to the caller
+    (fleet/router.py; docs/RESILIENCE.md failover section)."""

@@ -170,19 +170,31 @@ def _latency_summary(lat_ms: list) -> dict:
 def fire_fleet_requests(fleet, mix: dict, n_requests: int, n_threads: int,
                         max_request_rows: int, verify: Optional[dict] = None,
                         timeout: float = 300.0, seed: int = 100) -> dict:
-    """Multi-model traffic storm against a ``fleet.Fleet``.
+    """Multi-model traffic storm against a ``fleet.Fleet`` or
+    ``fleet.router.PodFleet``.
 
     ``mix`` maps model name -> traffic weight: every request picks its
     model by weighted draw, so the fleet bench models a real mixed
     workload instead of N sequential single-model storms.  Sheds
-    (``QueueFull`` — the fleet's weighted-admission verdict) and deadline
-    expiries (``DeadlineExceeded`` — the model's SLO class rejecting
-    queue-aged work) are counted per model, NOT as errors: under
-    deliberate overload both are the correct, typed behavior.  ``verify`` maps model name -> full-precision
-    ``StackedForest``; every verified response must be bit-equal to
-    ``predict_raw`` (the serving acceptance bar — only meaningful for
-    f32-precision models).  The summary carries per-model request/row
-    counts and CLIENT-measured latency percentiles.
+    (``QueueFull`` — the fleet's weighted-admission or brownout
+    verdict) and deadline expiries (``DeadlineExceeded`` — the model's
+    SLO class rejecting queue-aged work) are counted per model, NOT as
+    errors: under deliberate overload both are the correct, typed
+    behavior.  Any OTHER per-request failure is a typed-``failed``
+    outcome — counted, recorded, and the storm continues, so a failover
+    drill measures exactly how many requests a lost device cost instead
+    of losing a whole thread's numbers.  ``verify`` maps model name ->
+    full-precision ``StackedForest``; every verified response must be
+    bit-equal to ``predict_raw`` (the serving acceptance bar — only
+    meaningful for f32-precision models).
+
+    The summary carries per-model request/row counts, CLIENT-measured
+    latency percentiles, per-outcome counts (``outcomes``:
+    completed/shed/expired/failed), and **availability** = 1 −
+    failed / (completed + failed) — typed shed/expired excluded from
+    both sides, because rejecting work you cannot serve on time is
+    correct behavior, not unavailability.  Failover tests and the bench
+    assert this number, not a vibe (None before any non-typed outcome).
     """
     from .errors import DeadlineExceeded, QueueFull
 
@@ -194,8 +206,10 @@ def fire_fleet_requests(fleet, mix: dict, n_requests: int, n_threads: int,
     per_thread = n_requests // n_threads
     lock = threading.Lock()
     per_model = {n: {"requests": 0, "rows": 0, "shed": 0, "expired": 0,
-                     "lat_ms": [], "mismatches": 0} for n in names}
+                     "failed": 0, "lat_ms": [], "mismatches": 0}
+                 for n in names}
     errors: list = []
+    failures: list = []
 
     def worker(tidx: int) -> None:
         r = np.random.RandomState(seed + tidx)
@@ -215,6 +229,13 @@ def fire_fleet_requests(fleet, mix: dict, n_requests: int, n_threads: int,
                 except DeadlineExceeded:
                     with lock:
                         per_model[name]["expired"] += 1
+                    continue
+                except Exception as e:  # noqa: BLE001 — a failed request
+                    with lock:          # is an OUTCOME, not a dead thread
+                        per_model[name]["failed"] += 1
+                        failures.append(
+                            f"thread {tidx} [{name}]: "
+                            f"{type(e).__name__}: {str(e)[:200]}")
                     continue
                 lat = (time.perf_counter() - t0) * 1e3
                 ok = True
@@ -241,6 +262,11 @@ def fire_fleet_requests(fleet, mix: dict, n_requests: int, n_threads: int,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+
+    def availability(completed: int, failed: int):
+        return (None if completed + failed == 0
+                else round(1.0 - failed / (completed + failed), 6))
+
     models_out = {}
     for n in names:
         s = per_model[n]
@@ -250,17 +276,28 @@ def fire_fleet_requests(fleet, mix: dict, n_requests: int, n_threads: int,
             "rows": s["rows"],
             "shed": s["shed"],
             "expired": s["expired"],
+            "failed": s["failed"],
+            "availability": availability(s["requests"], s["failed"]),
             "mismatches": s["mismatches"],
             "latency_ms": _latency_summary(s["lat_ms"]),
         }
+    completed = sum(s["requests"] for s in per_model.values())
+    failed = sum(s["failed"] for s in per_model.values())
+    shed = sum(s["shed"] for s in per_model.values())
+    expired = sum(s["expired"] for s in per_model.values())
     return {
-        "requests": sum(s["requests"] for s in per_model.values()),
+        "requests": completed,
         "requests_planned": per_thread * n_threads,
         "rows": sum(s["rows"] for s in per_model.values()),
-        "shed": sum(s["shed"] for s in per_model.values()),
-        "expired": sum(s["expired"] for s in per_model.values()),
+        "shed": shed,
+        "expired": expired,
+        "failed": failed,
+        "outcomes": {"completed": completed, "shed": shed,
+                     "expired": expired, "failed": failed},
+        "availability": availability(completed, failed),
         "mismatches": sum(s["mismatches"] for s in per_model.values()),
         "wall_seconds": wall,
         "errors": errors,
+        "failures": failures,
         "models": models_out,
     }

@@ -66,6 +66,10 @@ class ServingConfig:
     # AOT serving-program cache directory (fleet/aot.py); None = look at
     # LGBM_TPU_COMPILE_CACHE/serving, "" / "off" = disabled
     aot_dir: Optional[str] = None
+    # liveness-beat name of this server's batcher thread (watchdog.py);
+    # a pod fleet names each replica's beat so per-replica health
+    # scoring can tell WHICH device wedged (fleet/router.py)
+    heartbeat_name: str = "serving.batcher"
 
     def __post_init__(self):
         if self.backend not in ("device", "host"):
@@ -162,7 +166,8 @@ class Server:
         self._batcher = MicroBatcher(
             self.ladder, self._run_batch, self.metrics,
             batch_window_ms=config.batch_window_ms,
-            max_queue_rows=config.max_queue_rows)
+            max_queue_rows=config.max_queue_rows,
+            beat_name=config.heartbeat_name)
         self._closed = False
         # join the unified process registry (docs/OBSERVABILITY.md): the
         # per-server registry stays authoritative (tests/serve_smoke read

@@ -141,6 +141,9 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
     _f("LIGHTGBM_TPU_SLO_MODEL_AGE_S", "", "obs/watchdog.py",
        "deployed-model freshness ceiling (seconds since promotion)",
        _OBS),
+    _f("LIGHTGBM_TPU_SLO_AVAILABILITY", "", "obs/watchdog.py",
+       "per-model windowed availability floor (0..1) the sentry "
+       "enforces; typed shed/expired excluded", _OBS),
     _f("LIGHTGBM_TPU_SLO_HEARTBEAT_S", "300", "obs/watchdog.py",
        "heartbeat staleness threshold (seconds)", _OBS),
     _f("LIGHTGBM_TPU_METRICS_PORT", "", "obs/http.py",
@@ -211,8 +214,10 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "'1' skips the ranking stage", _PERF),
     _f("BENCH_SKIP_SERVING", "", "bench.py",
        "'1' skips the serving stage", _PERF),
-    _f("BENCH_SKIP_FLEET", "", "bench.py", "'1' skips the fleet stage",
-       _PERF),
+    _f("BENCH_SKIP_FLEET", "", "bench.py",
+       "'1' skips the fleet AND fleet_failover stages", _PERF),
+    _f("BENCH_FLEET_DEVICES", "3", "bench.py",
+       "simulated device count for the fleet_failover drill", _PERF),
     _f("BENCH_SKIP_RESILIENCE", "", "bench.py",
        "'1' skips the resilience stage", _PERF),
     _f("BENCH_SKIP_LIFECYCLE", "", "bench.py",

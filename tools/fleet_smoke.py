@@ -20,11 +20,18 @@ Phases (each banks its own sub-dict in the summary):
 * ``lowprec`` — register bf16 and int8 twins of a model under a
   declared accuracy budget; journal the measured deltas; demonstrate
   the quarantine by offering an int8 model a budget of 0.
+* ``failover`` (``--devices N``, N >= 2; the bench ``fleet_failover``
+  stage) — stand up a replicated ``PodFleet`` over N simulated
+  devices, fire a threaded traffic storm, KILL one device mid-run
+  (chaos ``device`` site), and assert the acceptance bars: ZERO
+  non-typed request failures, availability >= 0.999, every response
+  bit-equal to ``Booster.predict(raw_score=True)``, and recovery
+  (every model regains replica coverage) within ONE replan tick.
 
 Usage:
     JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
         [--models 3] [--requests 240] [--threads 6] [--rows 3000] \
-        [--max-batch-rows 256] [--accuracy-budget 0.5]
+        [--max-batch-rows 256] [--accuracy-budget 0.5] [--devices 2]
 """
 
 import argparse
@@ -100,6 +107,8 @@ def run_smoke(n_models=3, rows=3000, trees=10, features=10, leaves=15,
         "rows": storm["rows"],
         "shed": storm["shed"],
         "expired": storm["expired"],
+        "failed": storm["failed"],
+        "availability": storm["availability"],
         "mismatches": storm["mismatches"],
         "wall_seconds": round(storm["wall_seconds"], 3),
         "rows_per_second": round(
@@ -108,9 +117,14 @@ def run_smoke(n_models=3, rows=3000, trees=10, features=10, leaves=15,
         "models": storm["models"],
         "plan": fleet.plan.summary() if fleet.plan else None,
     }
-    serve_ok = (not storm["errors"] and storm["mismatches"] == 0
+    # failed requests are typed OUTCOMES now (loadgen no longer kills
+    # the thread), so the bar must assert them zero EXPLICITLY — the
+    # planned-request tally alone would also catch them, but a named
+    # zero reads honestly in the journal
+    serve_ok = (not storm["errors"] and storm["failed"] == 0
+                and storm["mismatches"] == 0
                 and storm["requests"] + storm["shed"] + storm["expired"]
-                == storm["requests_planned"])
+                + storm["failed"] == storm["requests_planned"])
 
     # ----------------------------------------------------------- evict
     plan0 = fleet.replan()
@@ -132,6 +146,7 @@ def run_smoke(n_models=3, rows=3000, trees=10, features=10, leaves=15,
         "requests": evict_storm["requests"],
         "shed": evict_storm["shed"],
         "expired": evict_storm["expired"],
+        "failed": evict_storm["failed"],
         "mismatches": evict_storm["mismatches"],
         "errors": evict_storm["errors"],
         "all_models_served": all(
@@ -139,6 +154,7 @@ def run_smoke(n_models=3, rows=3000, trees=10, features=10, leaves=15,
             for n, m in evict_storm["models"].items()),
     }
     evict_ok = (len(plan.evicted) >= 1 and not evict_storm["errors"]
+                and evict_storm["failed"] == 0
                 and evict_storm["mismatches"] == 0
                 and summary["phases"]["evict"]["all_models_served"])
     fleet.config.hbm_budget_bytes = None
@@ -222,6 +238,96 @@ def run_smoke(n_models=3, rows=3000, trees=10, features=10, leaves=15,
     return summary
 
 
+def run_failover_smoke(devices=3, n_models=2, rows=3000, trees=10,
+                       features=10, leaves=15, requests=600, threads=6,
+                       max_request_rows=60, max_batch_rows=128,
+                       kill_after_s=0.2, availability_floor=0.999) -> dict:
+    """Kill-one-device-under-load drill (module docstring ``failover``
+    phase).  Returns the JSON-ready summary; ``failed`` True when any
+    acceptance bar was missed."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.fleet.router import RouterConfig
+    from lightgbm_tpu.resilience.faults import ChaosRegistry
+    from lightgbm_tpu.serving.loadgen import fire_fleet_requests
+
+    if devices < 2:
+        raise ValueError("failover drill needs --devices >= 2")
+    boosters = _train_models(n_models, rows, trees, features, leaves)
+    verify = _verify_forests(boosters)
+    weights = {f"m{i}": float(n_models - i) for i in range(n_models)}
+
+    chaos = ChaosRegistry()
+    pod = lgb.PodFleet(
+        devices=devices, chaos=chaos, max_batch_rows=max_batch_rows,
+        router=RouterConfig(stale_beat_s=1.0, dead_strikes=2,
+                            health_interval_s=0.2))
+    # generous deadlines: the drill measures availability under device
+    # loss, not queue aging (deadline classes have their own tests)
+    for cls in list(pod.deadline_classes):
+        pod.deadline_classes[cls] = 60_000.0
+    for i, b in enumerate(boosters):
+        pod.add_model(f"m{i}", b, weight=weights[f"m{i}"])
+    pod.warm()
+    victim = pod.topology.replicas["m0"][0]
+    lost_before = pod.metrics.counter("fleet_devices_lost_total").value
+
+    import threading
+    import time as _time
+
+    def killer():
+        _time.sleep(kill_after_s)
+        chaos.down_device(victim, "vanish")
+
+    threading.Thread(target=killer, daemon=True).start()
+    storm = fire_fleet_requests(pod, weights, requests, threads,
+                                max_request_rows, verify=verify,
+                                timeout=120)
+    # let the health sweep finish declaring/draining the victim even if
+    # the storm outran it
+    deadline = _time.monotonic() + 10.0
+    while _time.monotonic() < deadline and \
+            pod.metrics.counter("fleet_devices_lost_total").value \
+            <= lost_before:
+        _time.sleep(0.1)
+    _time.sleep(0.3)        # drain thread: replan + recovery gauge
+    recovered = pod.metrics.gauge("fleet_recovered_one_tick").value
+    live = pod.live_devices()
+    replicas = ({n: list(ids)
+                 for n, ids in pod.topology.replicas.items()}
+                if pod.topology else {})
+    summary = {
+        "devices": devices,
+        "victim_device": victim,
+        "requests": storm["requests"],
+        "requests_planned": storm["requests_planned"],
+        "outcomes": storm["outcomes"],
+        "availability": storm["availability"],
+        "mismatches": storm["mismatches"],
+        "failures": storm["failures"][:5],
+        "errors": storm["errors"],
+        "wall_seconds": round(storm["wall_seconds"], 3),
+        "devices_lost": pod.metrics.counter(
+            "fleet_devices_lost_total").value - lost_before,
+        "recovered_within_one_tick": bool(recovered),
+        "live_devices": live,
+        "replicas_after": replicas,
+        "hedges": sum(
+            pod.metrics.counter("fleet_hedges_total",
+                                labels={"model": n}).value
+            for n in weights),
+    }
+    pod.close(drain=False, timeout=2.0)
+    summary["failed"] = not (
+        storm["failed"] == 0 and not storm["errors"]
+        and storm["mismatches"] == 0
+        and (storm["availability"] or 0.0) >= availability_floor
+        and summary["devices_lost"] == 1
+        and summary["recovered_within_one_tick"]
+        and victim not in live
+        and all(len(ids) >= 1 for ids in replicas.values()))
+    return summary
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--models", type=int, default=3)
@@ -236,6 +342,9 @@ def main():
     ap.add_argument("--accuracy-budget", type=float, default=0.5)
     ap.add_argument("--aot-dir", default=None,
                     help="AOT store dir (default: a temp dir)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help=">= 2 adds the kill-one-device failover phase "
+                         "(a replicated PodFleet under chaos)")
     args = ap.parse_args()
 
     print(f"[fleet_smoke] {args.models} models, {args.requests} requests "
@@ -246,6 +355,17 @@ def main():
         threads=args.threads, max_request_rows=args.max_request_rows,
         max_batch_rows=args.max_batch_rows,
         accuracy_budget=args.accuracy_budget, aot_dir=args.aot_dir)
+    if args.devices >= 2:
+        print(f"[fleet_smoke] failover drill over {args.devices} "
+              "simulated devices", flush=True)
+        fo = run_failover_smoke(
+            devices=args.devices, n_models=min(args.models, 2),
+            rows=args.rows, trees=args.trees, features=args.features,
+            requests=args.requests, threads=args.threads,
+            max_batch_rows=args.max_batch_rows)
+        summary["phases"]["failover"] = fo
+        summary["phase_ok"]["failover"] = not fo["failed"]
+        summary["failed"] = summary["failed"] or fo["failed"]
     print(json.dumps(summary, indent=1, sort_keys=True))
     return 1 if summary["failed"] else 0
 
