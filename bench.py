@@ -91,6 +91,15 @@ host+HBM verdict banks as the "stream_plan" stage and the run
 journals planner-predicted vs measured peaks on BOTH memories;
 LGBM_TPU_STREAM / LGBM_TPU_STREAM_BLOCK_ROWS / LGBM_TPU_HOST_BYTES
 steer the election);
+BENCH_SKIP_SWEEP=1 skips the batched model-axis sweep micro-bench
+(tools/sweep_probe.py: the SAME macro-chunk body solo vs vmapped at
+B in {2,4,8} heterogeneous lanes over one shared binned matrix —
+per-dispatch latency, aggregate boosting iters/sec and measured MFU
+per batch width, plus ops/planner.plan_model_batch's lane-chunk
+verdict; on accelerators the journaled acceptance bar is B=8
+aggregate iters/sec >= 4x B=1, and a missed bar raises so failed
+sweep runs are never journaled; LGBM_TPU_MODEL_BATCH caps the
+production lane chunk itself);
 BENCH_SKIP_FLEET=1 skips the serving-fleet stage (lightgbm_tpu/fleet/:
 N-model registry under a shared-HBM residency plan — measured eviction
 with every model still servable, AOT zero-compile replica restart, and
@@ -1237,6 +1246,21 @@ def tpu_worker():
             return coll_run(rows=min(N, 1_000_000), features=F,
                             max_bin=MAX_BIN, leaves=LEAVES, trees=TREES)
         run_stage("collective_probe", _coll_probe)
+
+    # batched model-axis sweep micro-bench (tools/sweep_probe.py): the
+    # same chunk body solo vs vmapped at B in {2,4,8} lanes over one
+    # shared binned matrix — aggregate iters/sec + measured MFU per
+    # batch width next to plan_model_batch's lane-chunk verdict; on
+    # accelerators the probe raises below the 4x-at-B=8 bar, and errors
+    # are never journaled so a failed sweep retries
+    if os.environ.get("BENCH_SKIP_SWEEP") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _sweep():
+            from sweep_probe import run_probe as sweep_run
+            return sweep_run(rows=min(N, 200_000), features=F,
+                             max_bin=MAX_BIN, leaves=LEAVES)
+        run_stage("sweep", _sweep)
 
     # tpulint (tools/lint.py, docs/LINTING.md): the static-analysis
     # suite runs as a journaled stage so every bench round records that

@@ -22,6 +22,8 @@ from . import lifecycle  # noqa: F401  (guarded model lifecycle)
 from .engine import CVBooster, InitModelCompatibilityError, cv, serve, train
 from .fleet import Fleet, PodFleet
 from .lifecycle import LifecycleController
+from . import multi  # noqa: F401  (batched multi-booster training)
+from .multi import expand_param_grid, train_many
 
 __version__ = "0.1.0"
 
@@ -30,7 +32,8 @@ __all__ = [
     "CVBooster", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "serve", "serving",
     "fleet", "Fleet", "PodFleet", "lifecycle", "LifecycleController",
-    "InitModelCompatibilityError",
+    "InitModelCompatibilityError", "multi", "train_many",
+    "expand_param_grid",
 ]
 
 try:  # sklearn API is optional at import time

@@ -136,35 +136,54 @@ class GBDT:
         elif self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             if self._data_axis is not None:
-                src = self.train_set.host_binned()
-                if self._row_perm is not None:
-                    # query-aligned layout: gather rows (pads -> bin 0)
-                    b = np.concatenate(
-                        [src, np.zeros((1, src.shape[1]), src.dtype)]
-                    )[self._row_perm]
-                else:
-                    b = np.pad(src, ((0, n_pad - n), (0, 0)))
-                # feature-major device residency (ops/histogram.py LAYOUT
-                # DOCTRINE): minor dim n stays unpadded in the (8,128)/
-                # (32,128) tiles; [n, 28] u8 row-major would pad 4.6x
-                self.binned = jax.device_put(
-                    np.ascontiguousarray(b.T),
-                    NamedSharding(self._mesh, P(None, self._data_axis)))
+                perm = self._row_perm
+                key = ("data", id(self._mesh), self._data_axis, n_pad,
+                       None if perm is None else hash(perm.tobytes()))
+                self.binned = self._cached_device_binned(key)
+                if self.binned is None:
+                    src = self.train_set.host_binned()
+                    if perm is not None:
+                        # query-aligned layout: gather rows (pads -> bin 0)
+                        b = np.concatenate(
+                            [src, np.zeros((1, src.shape[1]), src.dtype)]
+                        )[perm]
+                    else:
+                        b = np.pad(src, ((0, n_pad - n), (0, 0)))
+                    # feature-major device residency (ops/histogram.py LAYOUT
+                    # DOCTRINE): minor dim n stays unpadded in the (8,128)/
+                    # (32,128) tiles; [n, 28] u8 row-major would pad 4.6x
+                    self.binned = self._cache_device_binned(
+                        key, jax.device_put(
+                            np.ascontiguousarray(b.T),
+                            NamedSharding(self._mesh,
+                                          P(None, self._data_axis))))
             else:
-                src = self.train_set.host_binned()
-                if self._col_perm is not None:
-                    # shard-major EFB columns (pads -> all-zero column)
-                    b = np.concatenate(
-                        [src, np.zeros((src.shape[0], 1), src.dtype)],
-                        axis=1)[:, self._col_perm]
-                else:
-                    b = np.pad(src, ((0, 0), (0, self._f_pad - F)))
-                self.binned = jax.device_put(
-                    np.ascontiguousarray(b.T),
-                    NamedSharding(self._mesh, P(self._feature_axis, None)))
+                perm = self._col_perm
+                key = ("feat", id(self._mesh), self._feature_axis,
+                       self._f_pad,
+                       None if perm is None else hash(perm.tobytes()))
+                self.binned = self._cached_device_binned(key)
+                if self.binned is None:
+                    src = self.train_set.host_binned()
+                    if perm is not None:
+                        # shard-major EFB columns (pads -> all-zero column)
+                        b = np.concatenate(
+                            [src, np.zeros((src.shape[0], 1), src.dtype)],
+                            axis=1)[:, perm]
+                    else:
+                        b = np.pad(src, ((0, 0), (0, self._f_pad - F)))
+                    self.binned = self._cache_device_binned(
+                        key, jax.device_put(
+                            np.ascontiguousarray(b.T),
+                            NamedSharding(self._mesh,
+                                          P(self._feature_axis, None))))
         else:
-            self.binned = jnp.asarray(
-                np.ascontiguousarray(self.train_set.host_binned().T))
+            key = ("serial",)
+            self.binned = self._cached_device_binned(key)
+            if self.binned is None:
+                self.binned = self._cache_device_binned(
+                    key, jnp.asarray(
+                        np.ascontiguousarray(self.train_set.host_binned().T)))
         self._row_valid = jnp.asarray(self._pad_rows_np(np.ones(n, np.float32)))
         if objective is not None:
             objective.init(self.train_set.metadata, self.num_data)
@@ -227,6 +246,32 @@ class GBDT:
             self.train_set.release_host_binned()
 
     # ------------------------------------------------------------------ setup
+
+    def _cached_device_binned(self, key):
+        """The Dataset's device-binned cache: a second GBDT on the SAME
+        constructed Dataset with the same device layout (mesh, axis,
+        padding, permutation) reuses the first upload instead of paying a
+        second host->device copy AND a second HBM residency.  This is
+        what makes batched multi-booster training (lightgbm_tpu/multi/)
+        HBM-cheap in shared-data mode — every lane of a sweep indexes ONE
+        matrix (multi/group.py keys shared groups on ``id(binned)``).
+        ``release_host_binned`` drops this cache with the host copy — a
+        released Dataset keeps its cannot-build-another-booster
+        contract."""
+        cache = getattr(self.train_set, "_dev_binned_cache", None)
+        return cache.get(key) if cache else None
+
+    def _cache_device_binned(self, key, arr):
+        cache = getattr(self.train_set, "_dev_binned_cache", None)
+        if cache is None:
+            cache = self.train_set._dev_binned_cache = {}
+        # one entry per layout; two layouts at once (e.g. a serial probe
+        # next to a sharded run) is the realistic ceiling — beyond that,
+        # evict oldest rather than grow HBM pins unboundedly
+        while len(cache) >= 2 and key not in cache:
+            cache.pop(next(iter(cache)))
+        cache[key] = arr
+        return arr
 
     def _build_forced_plan(self):
         """Parse ``config.forcedsplits_filename`` into plan arrays

@@ -85,8 +85,15 @@ def _ix(arr, j):
     return lax.dynamic_index_in_dim(arr, j, 0, keepdims=False)
 
 
-def build_chunk_program(b):
-    """One jitted loop program over a chunk of iterations for booster ``b``.
+def make_chunk_fn(b):
+    """The UNJITTED chunk callable for booster ``b`` — the body shared by
+    the solo jitted program (``build_chunk_program``) and the batched
+    model-axis program (``lightgbm_tpu/multi/batch.py``), which wraps the
+    SAME callable in ``jax.vmap`` over a leading booster axis.  Batched
+    training composes this exact body, so batch-invariance inherits the
+    chunk program's bit-parity discipline wherever the elected histogram
+    variant accumulates order-invariantly (scatter / integer paths —
+    docs/PERF.md "model axis").
 
     The loop is a ``fori_loop`` whose trip count ``n_steps`` is a RUNTIME
     scalar (always equal to the static chunk capacity ``c`` carried by the
@@ -104,8 +111,7 @@ def build_chunk_program(b):
 
     ``c`` rides in the input shapes: jax retraces per distinct chunk
     capacity, so one returned callable serves every chunk size the
-    scheduler picks.  The carried score buffer is donated, like the
-    per-iteration program.
+    scheduler picks.
     """
     from ..grower import TreeArrays
     core = b._macro_core          # the SAME iter_body (serial or shard_map)
@@ -162,7 +168,14 @@ def build_chunk_program(b):
             0, n_steps, body, (score, cegb_used, cegb_rows, ys0, qss0))
         return score, cegb_used, cegb_rows, ys, qss
 
-    return jax.jit(chunk, donate_argnums=(1,))
+    return chunk
+
+
+def build_chunk_program(b):
+    """The solo jitted chunk program: ``make_chunk_fn`` under ``jax.jit``
+    with the carried score buffer donated, like the per-iteration
+    program."""
+    return jax.jit(make_chunk_fn(b), donate_argnums=(1,))
 
 
 def build_chunk_valid(b):
@@ -207,27 +220,16 @@ def _stack_row_arrays(b, arrs: Sequence[jax.Array]) -> jax.Array:
     return out
 
 
-def run_chunk(b, c: int, lrs: Optional[Sequence[float]] = None) -> bool:
-    """Train ``c`` iterations of booster ``b`` in one fused dispatch.
-
-    ``lrs``: per-iteration learning rates (a reset_parameter schedule
-    precomputed by the engine); None = the booster's current shrinkage.
-    Returns True when training stopped (no more splittable leaves, only
-    detectable on the eager host path; the deferred path reports it at
-    drain time exactly like per-iteration training).
-    """
-    if c < 1:
-        raise ValueError(f"chunk size must be >= 1, got {c}")
-    if not b.chunk_supported():
-        raise RuntimeError(
-            f"boosting={b.boosting_type!r} with this config needs "
-            "per-iteration host logic; use train_one_iter (the engine's "
-            "chunk scheduler falls back to c=1 automatically)")
-    b.boost_from_average()
+def chunk_host_inputs(b, c: int, lrs: Optional[Sequence[float]] = None):
+    """Draw booster ``b``'s per-iteration host inputs for a chunk of ``c``
+    iterations starting at ``b.iter`` — bagging masks, feature masks,
+    per-round node keys, the lr schedule, iteration indices and GOSS
+    subkeys — in the EXACT per-iteration order, so the host RNG streams
+    replay identically whether the chunk runs solo (``run_chunk``) or
+    stacked along a model axis (multi/driver.py).  Returns ``(xs,
+    lr_list)``; the caller is responsible for ``boost_from_average`` first
+    (the draw order starts after init)."""
     it0 = b.iter
-
-    # host-side per-iteration inputs, drawn in the exact per-iteration
-    # order so the RNG streams replay identically
     masks: List[jax.Array] = []
     fmasks: List[jax.Array] = []
     keys: List[jax.Array] = []
@@ -246,10 +248,32 @@ def run_chunk(b, c: int, lrs: Optional[Sequence[float]] = None) -> bool:
         lr_list = [float(b.shrinkage_rate)] * c
     its = jnp.arange(it0, it0 + c, dtype=jnp.int32)
     gkeys, gon = b._macro_goss_inputs(c, it0, lr_list)
-    grad_c, hess_c = b._macro_const_grads()
     xs = (_stack_row_arrays(b, masks), jnp.stack(fmasks),
           jnp.asarray(lr_list, jnp.float32), jnp.stack(keys), its,
           gkeys, gon)
+    return xs, lr_list
+
+
+def run_chunk(b, c: int, lrs: Optional[Sequence[float]] = None) -> bool:
+    """Train ``c`` iterations of booster ``b`` in one fused dispatch.
+
+    ``lrs``: per-iteration learning rates (a reset_parameter schedule
+    precomputed by the engine); None = the booster's current shrinkage.
+    Returns True when training stopped (no more splittable leaves, only
+    detectable on the eager host path; the deferred path reports it at
+    drain time exactly like per-iteration training).
+    """
+    if c < 1:
+        raise ValueError(f"chunk size must be >= 1, got {c}")
+    if not b.chunk_supported():
+        raise RuntimeError(
+            f"boosting={b.boosting_type!r} with this config needs "
+            "per-iteration host logic; use train_one_iter (the engine's "
+            "chunk scheduler falls back to c=1 automatically)")
+    b.boost_from_average()
+    it0 = b.iter
+    xs, lr_list = chunk_host_inputs(b, c, lrs)
+    grad_c, hess_c = b._macro_const_grads()
 
     if b._macro_chunk_jit is None:
         b._macro_chunk_jit = build_chunk_program(b)

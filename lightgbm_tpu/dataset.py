@@ -858,6 +858,10 @@ class Dataset:
         if self.binned is not None:
             self.binned = None
             self._host_binned_released = True
+            # the device-binned reuse cache (boosting/gbdt.py) rides on
+            # the live Dataset; a released Dataset keeps the documented
+            # cannot-build-another-booster contract
+            self._dev_binned_cache = None
         return self
 
     def host_binned(self) -> np.ndarray:

@@ -540,8 +540,19 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
        early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
        show_stdv: bool = True, seed: int = 0, callbacks=None,
        eval_train_metric: bool = False,
-       return_cvbooster: bool = False) -> Dict[str, List[float]]:
-    """reference: engine.py:375."""
+       return_cvbooster: bool = False,
+       fused: bool = False) -> Dict[str, List[float]]:
+    """reference: engine.py:375.
+
+    ``fused=True`` batches the folds' per-round training steps along a
+    model axis (lightgbm_tpu/multi/): every fold advances one iteration
+    in ONE vmapped device dispatch instead of nfold sequential programs.
+    The results dict is IDENTICAL — same keys, same mean/stdv layout,
+    bit-for-bit the same values as the serial loop (tests/test_multi.py
+    pins it) — because both paths run the same c=1 chunk program per
+    fold; configs with per-iteration host logic (or a custom ``fobj``)
+    fall back to serial stepping with a logged warning.
+    """
     from .utils.platform import enable_compile_cache
     enable_compile_cache()
     params = dict(params)
@@ -587,10 +598,15 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
         cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
     cbs = sorted(cbs, key=lambda cb: getattr(cb, "order", 0))
 
+    from .multi.driver import CVStepper
+    stepper = CVStepper(boosters, fused, fobj)
     for i in range(num_boost_round):
         agg: Dict[str, List[float]] = collections.defaultdict(list)
+        # advance EVERY fold first (batched across folds when fused),
+        # then evaluate — folds are independent, so the reordering vs
+        # the reference's update-then-eval-per-fold changes nothing
+        stepper.step()
         for bst in boosters:
-            bst.update(fobj=fobj)
             # reference cv names the train split 'train' (engine.py:353)
             res = ([("train", mn, v, h)
                     for (_, mn, v, h) in bst.eval_train(feval)]

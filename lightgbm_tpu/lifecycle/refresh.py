@@ -99,6 +99,30 @@ def train_candidate(deployed, train_set, params: dict,
                  init_model=deployed, verbose_eval=False, **train_kw)
 
 
+def refresh_many(deployed, train_sets, params_list, num_boost_round: int,
+                 **train_kw):
+    """Warm-start a whole per-segment model FAMILY in one batched run.
+
+    A production deployment rarely refreshes one model: per-segment
+    families (per-region, per-surface) retrain on the same cadence, and
+    each segment's candidate is an independent small training that
+    leaves the chip idle.  This routes the family through
+    ``multi.train_many`` stacked mode — one Dataset per segment (each on
+    its deployed model's frozen bin grid via ``fresh_dataset``), one
+    deployed booster per segment as ``init_models`` — so structurally
+    compatible segments advance in ONE vmapped dispatch while each
+    candidate stays byte-identical to its solo ``train_candidate`` run.
+    Returns the candidate boosters in segment order."""
+    from ..multi import train_many
+    deployed = list(deployed)
+    if len(deployed) != len(params_list):
+        raise ValueError(
+            f"refresh_many: {len(deployed)} deployed models for "
+            f"{len(params_list)} configs")
+    return train_many(list(params_list), list(train_sets), num_boost_round,
+                      init_models=deployed, **train_kw)
+
+
 def save_candidate(booster, manager) -> str:
     """Write the candidate's checkpoint bundle (atomic, sha256
     manifest) through ``manager`` (resilience.CheckpointManager);

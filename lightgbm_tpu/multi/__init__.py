@@ -1,0 +1,20 @@
+"""Batched multi-booster training: a model axis that fills the idle MXU.
+
+Train B boosters — CV folds, a hyperparameter sweep, per-segment model
+families — in ONE device dispatch by vmapping the fused macro-chunk
+program (boosting/macro.py) over a leading lane axis.  Each extracted
+booster is byte-identical in model text to the same config trained
+alone; `ops.planner.plan_model_batch` elects how many lanes one dispatch
+may carry under the HBM budget.  docs/PERF.md "model axis" has the
+design; tests/test_multi.py pins the parity matrix.
+"""
+
+from .batch import BatchedChunkProgram
+from .driver import CVStepper, expand_param_grid, train_many
+from .group import MultiGroup, group_boosters, structural_key
+
+__all__ = [
+    "BatchedChunkProgram", "CVStepper", "MultiGroup",
+    "expand_param_grid", "group_boosters", "structural_key",
+    "train_many",
+]

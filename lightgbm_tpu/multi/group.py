@@ -1,0 +1,160 @@
+"""Structural grouping: which boosters may share one batched program.
+
+``jax.vmap`` traces the chunk body ONCE, so everything the trace bakes in
+as a compile-time constant must be EQUAL across the boosters sharing a
+lane axis — tree shape (GrowerConfig), objective family and its baked
+scalars, padded array shapes, the mesh.  Everything that rides into the
+program as a runtime argument — bagging/feature masks, learning-rate
+schedules, per-round node keys, GOSS subkeys, row counts via masks — may
+differ per lane.  This module computes a conservative structural key:
+two boosters land in the same group only when every non-whitelisted
+``Config`` field, the derived ``grower_cfg``, and the objective's baked
+constants match.  Conservative means CORRECT — an over-split key costs
+batching efficiency (smaller groups), never bit-parity.
+
+Two data modes:
+
+* ``shared`` — every booster trains on the SAME ``Dataset`` (a sweep):
+  the binned matrix rides into the batched program unbatched
+  (``in_axes=None``) and its HBM cost does not scale with B;
+* ``stacked`` — per-booster Datasets of identical padded shape (CV
+  folds, per-segment families): binned matrices stack along the lane
+  axis (×B HBM — ops/planner.plan_model_batch models the difference),
+  and the objective's baked per-dataset arrays (labels, binary's
+  label_sign, multiclass one-hots) are swapped for traced lane-stacked
+  arguments at trace time (multi/batch.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# Config fields that ride into the batched program as runtime inputs (or
+# pure host-side schedule/bookkeeping) and therefore may differ between
+# boosters sharing one batched program.  Everything NOT listed here must
+# be equal across a group.
+RUNTIME_VARYING_FIELDS = frozenset({
+    "learning_rate",            # [c]-stacked lr schedule argument
+    "bagging_fraction",         # host RNG -> stacked row masks
+    "bagging_freq",
+    "bagging_seed",
+    "feature_fraction",         # host RNG -> stacked feature masks
+    "feature_fraction_seed",
+    "num_iterations",           # per-lane liveness, not trace structure
+    "early_stopping_round",     # host-side callback
+    "first_metric_only",
+    "metric",                   # host-side evaluation only
+    "metric_freq",
+    "is_provide_training_metric",
+    "verbosity",
+    "seed",                     # master seed: only consumed via the
+                                # derived per-concern seeds above
+    "snapshot_freq",
+})
+
+
+class MultiGroup:
+    """One structurally-compatible set of boosters (GBDT objects) that a
+    single vmapped chunk program can train; ``stacked`` marks the data
+    mode (per-lane binned matrices vs one shared matrix)."""
+
+    def __init__(self, key: tuple, boosters: List, stacked: bool):
+        self.key = key
+        self.boosters = boosters
+        self.stacked = stacked
+
+    def __len__(self) -> int:
+        return len(self.boosters)
+
+
+def objective_array_attrs(obj) -> List[str]:
+    """Names of the objective's baked per-dataset device/host arrays —
+    the attributes multi/batch.py swaps for traced lane-stacked
+    arguments in stacked mode (labels, binary's ``label_sign``,
+    multiclass ``label_onehot``...).  Sorted for a deterministic
+    argument order."""
+    import jax
+    if obj is None:
+        return []
+    return sorted(k for k, v in vars(obj).items()
+                  if isinstance(v, (jax.Array, np.ndarray)))
+
+
+def _objective_fingerprint(obj) -> tuple:
+    """The objective's trace-relevant baked scalars.  Private attrs
+    (leading underscore, e.g. binary's host-only ``_pavg``) are derived
+    caches that never enter the traced program; public scalars (binary's
+    is_unbalance class weights, sigmoid steepness riding on config is
+    covered by the Config filter) DO bake in and must match."""
+    if obj is None:
+        return ("none",)
+    scalars = tuple(sorted(
+        (k, v) for k, v in vars(obj).items()
+        if not k.startswith("_") and isinstance(v, (bool, int, float, str))))
+    return (type(obj).__name__, scalars, tuple(objective_array_attrs(obj)),
+            obj.weight is None if hasattr(obj, "weight") else True)
+
+
+def _config_fingerprint(cfg) -> tuple:
+    """Every Config field that may bake into the traced program, as a
+    hashable tuple.  ``repr`` normalizes list-valued fields."""
+    return tuple(sorted(
+        (k, repr(v)) for k, v in vars(cfg).items()
+        if k not in RUNTIME_VARYING_FIELDS))
+
+
+def structural_key(b, stacked: bool) -> Optional[tuple]:
+    """The structural group key for GBDT ``b``, or None when ``b`` cannot
+    join ANY batched group (it then trains through the solo chunk path).
+
+    ``stacked=False`` keys on the training Dataset's identity — lanes of
+    a shared-data group index one device matrix.  ``stacked=True`` keys
+    on shape/dtype instead, and excludes boosting families whose traced
+    closures bake per-dataset values beyond the swappable objective
+    arrays (RF's init-score column)."""
+    if not b.chunk_supported():
+        return None
+    if stacked and b.boosting_type == "rf":
+        # rf bakes init_scores (data-derived) into the chunk closure;
+        # per-lane datasets would need per-lane closures — not vmappable
+        return None
+    if b.binned is None:      # out-of-core streamed executor
+        return None
+    data_key = (("stacked",) + tuple(b.binned.shape) + (str(b.binned.dtype),)
+                if stacked else ("shared", id(b.train_set), id(b.binned)))
+    mesh_key = (id(b._mesh) if b._mesh is not None else None,
+                b._data_axis, b._feature_axis)
+    return (data_key, mesh_key,
+            b.boosting_type, b.num_tree_per_iteration,
+            b.num_data, b._n_pad,
+            # GrowerConfig.learning_rate is carried for bookkeeping but
+            # never read in a traced body (shrinkage rides the runtime
+            # [c] lr input) — normalize it so heterogeneous-lr sweeps
+            # share one program
+            b.grower_cfg._replace(learning_rate=0.0),
+            _config_fingerprint(b.config),
+            _objective_fingerprint(b.objective),
+            b.train_set.metadata.init_score is not None,
+            bool(getattr(b, "_quant_on", False)))
+
+
+def group_boosters(bs: Sequence, stacked: bool) -> List[MultiGroup]:
+    """Partition GBDTs into batched groups (insertion-ordered, so the
+    driver trains lanes in a deterministic order).  Boosters with key
+    None become singleton groups with ``key=None`` — the driver routes
+    those through the solo chunk path."""
+    groups: dict = {}
+    out: List[MultiGroup] = []
+    for b in bs:
+        key = structural_key(b, stacked)
+        if key is None:
+            out.append(MultiGroup(None, [b], stacked))
+            continue
+        g = groups.get(key)
+        if g is None:
+            g = groups[key] = MultiGroup(key, [], stacked)
+            out.append(g)
+        g.boosters.append(b)
+    return out

@@ -227,9 +227,14 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
     the Pallas-megakernel work (ROADMAP item 2) is steered by; the
     ``*/fused`` rows are that megakernel itself (ops/fused.py: histogram
     build + in-VMEM split scan in one program — the acceptance figure is
-    its MFU against the staged rows at the same shape).  A variant
-    unsupported on the backend reports ``{"error": ...}`` instead of
-    failing the table.
+    its MFU against the staged rows at the same shape).  The
+    ``f32/scatter_batched8`` row is the model-axis plane
+    (lightgbm_tpu/multi/): the same scatter build vmapped over 8
+    lane-stacked gradient vectors against ONE shared binned matrix —
+    its MFU against ``f32/scatter`` at the same shape is the per-kernel
+    evidence behind the batched sweep stage (tools/sweep_probe.py).  A
+    variant unsupported on the backend reports ``{"error": ...}``
+    instead of failing the table.
     """
     import jax
     import jax.numpy as jnp
@@ -250,6 +255,11 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
     gq = jnp.asarray(rng.randint(-8, 8, n, dtype=np.int64), jnp.int8)
     hq = jnp.asarray(rng.randint(0, 8, n, dtype=np.int64), jnp.int8)
     member = jnp.ones((n,), bool)
+    # model-axis fixtures: 8 heterogeneous gradient lanes over the ONE
+    # shared binned matrix (lane scaling defeats cross-lane CSE)
+    lanes = 8
+    gradB = jnp.stack([grad * (1.0 + 0.01 * i) for i in range(lanes)])
+    hessB = jnp.stack([hess * (1.0 + 0.01 * i) for i in range(lanes)])
 
     if tile_rows is None:
         tile_rows = 1 << max((n // 4).bit_length() - 1, 10)
@@ -273,6 +283,10 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
                 b, g, h, m, B, method="matmul_f32", tile_rows=tile),
             "f32/scatter": lambda b, g, h, m: H.build_histogram(
                 b, g, h, m, B, method="scatter", tile_rows=tile),
+            "f32/scatter_batched8": lambda b, g, h, m: jax.vmap(
+                lambda gg, hh: H.build_histogram(
+                    b, gg, hh, m, B, method="scatter", tile_rows=tile)
+            )(gradB, hessB),
             "f32/pallas": lambda b, g, h, m: H.build_histogram(
                 b, g, h, m, B, method="pallas", tile_rows=tile),
             "f32/sorted": lambda b, g, h, m: H.segment_histogram_sorted(

@@ -503,6 +503,135 @@ def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None,
 
 
 # ======================================================================
+# Model-axis (batched multi-booster) memory model: lightgbm_tpu/multi/
+# trains B boosters in ONE vmapped chunk program.  Per-lane state — the
+# carried scores, gradients, per-tree hist cache, per-pass transients —
+# scales ×B; the binned matrix does NOT in shared-data mode (every lane
+# indexes one device matrix, in_axes=None) and DOES in stacked-data mode
+# (CV folds upload per-lane matrices along the lane axis).  plan_model_batch
+# elects the largest lane-chunk Bc <= B whose predicted peak fits the
+# budget; the driver degrades to ceil(B / Bc) sequential dispatch groups
+# when HBM says no.  LGBM_TPU_MODEL_BATCH: "" = planner-elected, "0"/"off"
+# = force sequential (Bc=1), N = cap Bc.
+# ======================================================================
+
+
+def _model_batch_override():
+    """LGBM_TPU_MODEL_BATCH: None = planner-elected, 1 = batching off,
+    N = cap the elected lane chunk."""
+    v = os.environ.get("LGBM_TPU_MODEL_BATCH", "").strip().lower()
+    if not v:
+        return None
+    if v in ("0", "off", "false", "none", "no"):
+        return 1
+    try:
+        return max(int(v), 1)
+    except ValueError:
+        return None
+
+
+class ModelBatchPlan(NamedTuple):
+    """Lane-chunk verdict for one batched multi-booster group."""
+
+    b_total: int                # boosters in the group
+    b_chunk: int                # lanes per device dispatch
+    num_dispatch_groups: int    # ceil(b_total / b_chunk)
+    stacked: bool               # binned matrix scales with Bc
+    per_lane_bytes: int         # what ONE extra lane costs
+    shared_bytes: int           # lane-independent residency (shared binned)
+    predicted_peak_bytes: int   # at the elected b_chunk
+    budget_bytes: int
+    limit_bytes: int
+    limit_source: str           # "memory_stats" | "env" | "default" | "caller"
+    feasible: bool              # even Bc=1 fits the budget
+    degraded: bool              # budget forced Bc < b_total
+    forced: bool                # LGBM_TPU_MODEL_BATCH capped the election
+
+    def summary(self) -> dict:
+        """JSON-friendly form for bench journals / telemetry."""
+        return {
+            "b_total": self.b_total,
+            "b_chunk": self.b_chunk,
+            "num_dispatch_groups": self.num_dispatch_groups,
+            "stacked": self.stacked,
+            "per_lane_bytes": self.per_lane_bytes,
+            "shared_bytes": self.shared_bytes,
+            "predicted_peak_bytes": self.predicted_peak_bytes,
+            "budget_bytes": self.budget_bytes,
+            "hbm_limit_bytes": self.limit_bytes,
+            "limit_source": self.limit_source,
+            "feasible": self.feasible,
+            "degraded": self.degraded,
+            "forced": self.forced,
+        }
+
+
+def plan_model_batch(
+    b_total: int,
+    rows: int,
+    features: int,
+    num_bins: int,
+    num_leaves: int = 31,
+    num_class: int = 1,
+    quant: bool = False,
+    method: str = "auto",
+    round_width: int = 128,
+    machines: int = 1,
+    stacked: bool = False,
+    tile_rows: int = 0,
+    use_pack: bool = True,
+    budget_bytes: Optional[int] = None,   # tests: fake memory model
+    accel: Optional[bool] = None,
+) -> ModelBatchPlan:
+    """Elect the lane chunk for a B-booster batched training group.
+
+    Memory model: ``total(Bc) = shared + Bc * per_lane`` where ``shared``
+    is the binned matrix (plus its transformation copy) in shared-data
+    mode and zero in stacked mode, and ``per_lane`` is everything else in
+    ``predict_peak_bytes``'s breakdown (scores, gradients, hist cache,
+    per-pass transients — all of which vmap replicates along the lane
+    axis) plus, in stacked mode, the lane's own binned matrix.  Walk Bc
+    down from B until the prediction fits; ``feasible=False`` means even
+    one lane does not fit (same contract as ``plan_histograms``: refuse,
+    don't OOM).
+    """
+    B = max(int(b_total), 1)
+    if budget_bytes is not None:
+        limit, source = int(budget_bytes), "caller"
+    else:
+        limit, source = hbm_limit_bytes()
+    budget = int(limit * HEADROOM)
+    variant = _resolved_variant(method, quant)
+    solo_peak, bd = predict_peak_bytes(
+        rows, features, num_bins, num_leaves, num_class, quant, variant,
+        tile_rows, use_pack, round_width, machines, accel)
+    binned = bd["binned"]
+    shared = 0 if stacked else binned
+    per_lane = solo_peak - binned + (binned if stacked else 0)
+    forced_cap = _model_batch_override()
+    cap = B if forced_cap is None else min(B, forced_cap)
+
+    def total(bc):
+        return shared + bc * per_lane
+
+    bc = cap
+    while bc > 1 and total(bc) > budget:
+        bc -= 1
+    plan = ModelBatchPlan(
+        b_total=B, b_chunk=bc, num_dispatch_groups=-(-B // bc),
+        stacked=bool(stacked), per_lane_bytes=int(per_lane),
+        shared_bytes=int(shared), predicted_peak_bytes=int(total(bc)),
+        budget_bytes=budget, limit_bytes=limit, limit_source=source,
+        feasible=total(1) <= budget,
+        degraded=bc < B and (forced_cap is None or bc < cap),
+        forced=forced_cap is not None)
+    from ..obs.trace import instant
+    instant("planner.model_batch", rows=rows, features=features,
+            **plan.summary())
+    return plan
+
+
+# ======================================================================
 # Per-tier collective link model: the hybrid ("dcn", "ici") mesh's
 # reduction-schedule election (parallel/collectives.py).
 #

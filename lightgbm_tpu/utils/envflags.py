@@ -91,6 +91,9 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "'1' frees the host binned matrix after device upload", _PERF),
     _f("LGBM_TPU_CHUNK", "", "boosting/macro.py",
        "macro-chunk size override ('0'/'off' disables chunking)", _PERF),
+    _f("LGBM_TPU_MODEL_BATCH", "", "ops/planner.py",
+       "cap the batched model-axis lane chunk ('0'/'off' forces "
+       "sequential training)", _PERF),
     _f("LGBM_TPU_COMPILE_CACHE", "", "utils/platform.py, fleet/aot.py",
        "persistent XLA compile-cache + AOT-export directory", _PERF),
     _f("LGBT_DEFER_HOST_TREES", "", "boosting/gbdt.py",
@@ -227,6 +230,8 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        _OBS),
     _f("BENCH_SKIP_LINT", "", "bench.py",
        "'1' skips the journaled tpulint stage", _PERF),
+    _f("BENCH_SKIP_SWEEP", "", "bench.py",
+       "'1' skips the batched model-axis sweep probe", _PERF),
 ]}
 
 

@@ -391,9 +391,11 @@ def test_devprof_histogram_table_small():
                                     slots=4, reps=1, quant=True)
     keys = [k for k in t if "/" in k]
     # the full family x {f32, quant} x {untiled, tiled}, incl. the
-    # Pallas rows (bin-only VPU kernel + fused megakernel)
-    assert len(keys) == 24
-    for fam in ("f32/pallas", "f32/fused", "quant/fused"):
+    # Pallas rows (bin-only VPU kernel + fused megakernel) and the
+    # 8-lane model-axis row (f32/scatter_batched8)
+    assert len(keys) == 26
+    for fam in ("f32/pallas", "f32/fused", "quant/fused",
+                "f32/scatter_batched8"):
         assert f"{fam}/untiled" in t and f"{fam}/tiled" in t
     for k in keys:
         v = t[k]
