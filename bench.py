@@ -57,7 +57,7 @@ BENCH_SKIP_OBS=1 (skip the obs_dump + obs_doctor stages AND the measured
 per-variant MFU table; obs_doctor — tools/obs_doctor.py over
 lightgbm_tpu/obs/diagnose.py — runs LAST and journals ranked bottleneck
 verdicts ("dcn-bound", "compile-bound", "input-bound", "straggler",
-"kernel-underutilized") derived from the banked stages, so every bench
+"contention", "kernel-underutilized") derived from the banked stages, so every bench
 round self-reports its bottleneck; the measured MFU table is the
 lightgbm_tpu/obs/devprof.py cost_analysis numbers that
 otherwise ride in the full/fallback run_bench results as "mfu_measured",
@@ -69,8 +69,8 @@ fused-vs-staged sec/level + HBM bytes_accessed drop per level).
 Observability: LIGHTGBM_TPU_TRACE=1 records structured spans through
 every stage (bench phases, engine loop, dispatch/fetch, serving) and
 each run_bench stage dumps a Chrome-trace JSON (bench_trace_<stage>.json)
-plus a unified metrics-registry snapshot (bench_obs_metrics.json) next
-to the journal; "obs" in the stage JSON carries the file + a span-tree
+plus a unified metrics-registry snapshot (bench_obs_metrics.json) under
+./bench_out/ (gitignored); "obs" in the stage JSON carries the file + a span-tree
 wall-clock coverage figure (docs/OBSERVABILITY.md).
 Memory/caching: LGBM_TPU_TILE_ROWS / LGBM_TPU_HBM_BYTES steer the HBM
 budget planner (ops/planner.py; the >=10M-row stage is gated on its
@@ -114,6 +114,12 @@ under loadgen traffic -> forced drift rollback with the fleet's output
 byte-identical to the pre-promotion model, via
 tools/lifecycle_smoke.py; a missed bar raises so failed lifecycle runs
 are never journaled);
+BENCH_SKIP_CORESIDENT=1 skips the co-resident train+serve stage
+(lightgbm_tpu/coresident/: loadgen traffic AND a residency-ledger-
+budgeted refresh on the SAME device set, via tools/coresident_smoke.py;
+the bars — zero non-typed failures with p99 within SLO, model age
+drops, the brownout throttle counter moved — raise when missed so
+failed co-residency runs are never journaled);
 LGBM_TPU_VMEM_BYTES steers the fused-megakernel VMEM arena election and
 LGBM_TPU_FUSED=0 drops the fused arm entirely (staged family only);
 LGBM_TPU_COMPILE_CACHE=<dir> wires the persistent XLA compile cache
@@ -137,6 +143,11 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+
+# all per-run observability artifacts (Chrome traces, metrics snapshots)
+# land here, NOT in the repo root — gitignored so bench runs stop
+# churning the working tree
+BENCH_OUT = os.path.join(REPO, "bench_out")
 
 BASELINE_SECONDS = 130.094
 
@@ -522,7 +533,8 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
 
         profile = os.environ.get("BENCH_PROFILE") == "1"
         if profile:
-            jax.profiler.start_trace(os.path.join(REPO, "bench_trace"))
+            os.makedirs(BENCH_OUT, exist_ok=True)
+            jax.profiler.start_trace(os.path.join(BENCH_OUT, "bench_trace"))
 
         t0 = time.perf_counter()
         with obs_span("bench.train_loop", trees=trees - 1):
@@ -690,9 +702,10 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
         safe_tag = (tag or "-full").strip("-").replace("/", "_") or "full"
         evs = global_tracer.since(trace_mark)   # THIS stage's slice only
         try:
+            os.makedirs(BENCH_OUT, exist_ok=True)
             result["obs"] = {
                 "trace_file": global_tracer.dump(
-                    os.path.join(REPO, f"bench_trace_{safe_tag}.json"),
+                    os.path.join(BENCH_OUT, f"bench_trace_{safe_tag}.json"),
                     events=evs),
                 "trace_events": len(evs),
                 "trace_coverage": round(
@@ -702,7 +715,8 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
             result["obs"] = {"error": str(e)[-200:]}
     try:
         from lightgbm_tpu.utils.file_io import write_atomic
-        snap_path = os.path.join(REPO, "bench_obs_metrics.json")
+        os.makedirs(BENCH_OUT, exist_ok=True)
+        snap_path = os.path.join(BENCH_OUT, "bench_obs_metrics.json")
         write_atomic(snap_path, obs_registry.dump_json())
         result["obs_metrics_file"] = snap_path
     except OSError:
@@ -943,6 +957,29 @@ def run_lifecycle_bench(rows=20_000, trees=12, refresh_trees=4,
     if summary.get("failed"):
         raise RuntimeError(
             f"lifecycle smoke failed phases: "
+            f"{[k for k, ok in summary['phase_ok'].items() if not ok]}")
+    return summary
+
+
+def run_coresident_bench(rows=12_000, trees=10, refresh_trees=6,
+                         requests=120, threads=4):
+    """Co-residency metric (lightgbm_tpu/coresident/): loadgen traffic
+    AND a continual refresh on the SAME device set behind the shared
+    residency ledger, via tools/coresident_smoke.py's phased run.  The
+    acceptance bars: zero non-typed serving failures with overall p99
+    within the serving SLO, ``model_age_seconds`` drops across the
+    refresh, and the brownout throttle counter moved (training yielded
+    to serving through the pause_control seam at least once during the
+    injected device-delay window).  Raises on any missed bar so a
+    failed co-residency run is never journaled (PR 4 convention)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from coresident_smoke import run_smoke
+    summary = run_smoke(rows=rows, trees=trees,
+                        refresh_trees=refresh_trees, requests=requests,
+                        threads=threads)
+    if summary.get("failed"):
+        raise RuntimeError(
+            f"coresident smoke failed phases: "
             f"{[k for k, ok in summary['phase_ok'].items() if not ok]}")
     return summary
 
@@ -1390,6 +1427,13 @@ def tpu_worker():
     if os.environ.get("BENCH_SKIP_LIFECYCLE") != "1":
         run_stage("lifecycle", run_lifecycle_bench, budget_floor=240)
 
+    # co-resident train+serve (lightgbm_tpu/coresident/): traffic and a
+    # ledger-budgeted refresh share one device set; brownout must
+    # throttle training while p99 stays within SLO; errors raise so a
+    # failed co-residency cycle is never journaled
+    if os.environ.get("BENCH_SKIP_CORESIDENT") != "1":
+        run_stage("coresident", run_coresident_bench, budget_floor=240)
+
     # automated bottleneck diagnosis (lightgbm_tpu/obs/diagnose.py):
     # joins THIS run's banked stages (mfu_measured, compile_cache,
     # stream_probe, collective_probe) + live registry gauges into ranked
@@ -1503,6 +1547,14 @@ def cpu_worker():
             except Exception as e:
                 res["lifecycle"] = {"error": str(e)[-300:]}
             emit(res)
+        if os.environ.get("BENCH_SKIP_CORESIDENT") != "1":
+            try:
+                res["coresident"] = run_coresident_bench(
+                    rows=6_000, trees=8, refresh_trees=4,
+                    requests=80, threads=4)
+            except Exception as e:
+                res["coresident"] = {"error": str(e)[-300:]}
+            emit(res)
         return 0
     except Exception as e:
         emit({"stage": "cpu", "error": str(e)[-800:],
@@ -1591,6 +1643,15 @@ def _annotate(line, tpu_stages, cpu_result):
             "error" not in cpu_result["lifecycle"]:
         line["lifecycle"] = dict(cpu_result["lifecycle"],
                                  note="cpu-fallback lifecycle numbers")
+    co = collect_ok(tpu_stages, "coresident")
+    if co:
+        line["coresident"] = {k: v for k, v in co.items()
+                              if k not in ("stage", "elapsed")}
+    if "coresident" not in line and cpu_result and \
+            isinstance(cpu_result.get("coresident"), dict) and \
+            "error" not in cpu_result["coresident"]:
+        line["coresident"] = dict(cpu_result["coresident"],
+                                  note="cpu-fallback coresident numbers")
     if cpu_result and "error" not in cpu_result:
         line["cpu_reference"] = {
             "sec_per_tree": cpu_result.get("sec_per_tree"),

@@ -24,6 +24,7 @@ from .fleet import Fleet, PodFleet
 from .lifecycle import LifecycleController
 from . import multi  # noqa: F401  (batched multi-booster training)
 from .multi import expand_param_grid, train_many
+from . import coresident  # noqa: F401  (co-resident train+serve)
 
 __version__ = "0.1.0"
 
@@ -33,7 +34,7 @@ __all__ = [
     "reset_parameter", "EarlyStopException", "serve", "serving",
     "fleet", "Fleet", "PodFleet", "lifecycle", "LifecycleController",
     "InitModelCompatibilityError", "multi", "train_many",
-    "expand_param_grid",
+    "expand_param_grid", "coresident",
 ]
 
 try:  # sklearn API is optional at import time

@@ -1,0 +1,21 @@
+"""Co-resident train+serve on one pod (docs/PERF.md co-residency).
+
+The co-residency stack runs lifecycle refreshes on the SAME devices
+that serve traffic, behind the shared residency ledger
+(``ops.planner.ResidencyLedger``): training plans against the bytes
+serving left over, throttles and pauses through the engine's
+``pause_control`` seam when the serving plane brownouts, and shrinks
+its world in the same coordinated replan that drains serving replicas
+when a device is lost.
+
+- :class:`Scheduler` — the brownout-aware refresh driver;
+- :class:`PauseControl` — the run/throttle/pause seam the engine polls;
+- :class:`CoresidentConfig` — the brownout policy knobs;
+- :class:`CoresidencyInfeasible` — the loud refuse-don't-OOM verdict.
+"""
+
+from .control import PauseControl
+from .scheduler import CoresidencyInfeasible, CoresidentConfig, Scheduler
+
+__all__ = ["Scheduler", "PauseControl", "CoresidentConfig",
+           "CoresidencyInfeasible"]

@@ -1,0 +1,492 @@
+"""Co-resident train+serve scheduler: lifecycle refreshes in the
+serving troughs of the SAME device set, behind the shared residency
+ledger (docs/PERF.md co-residency; ROADMAP item 4).
+
+The pieces, composed rather than reinvented:
+
+* **budget** — every refresh plans through
+  ``ops.planner.plan_histograms(ledger=...)`` against the ledger's
+  REMAINING bytes (serving residency leased out first), so the plan
+  degrades its tile size before anyone touches serving residency, and
+  an infeasible co-residency raises ``CoresidencyInfeasible`` — a loud
+  verdict carrying the lease table, never a compile-OOM.  During
+  training the ledger pins ``LGBM_TPU_HBM_BYTES`` to the training
+  plane's envelope (``ResidencyLedger.train_env``) so planners deep
+  inside ``engine.train`` agree.
+* **troughs** — the macro-chunk cap is negotiated from the fleet's
+  observed p99 headroom under the brownout ceiling
+  (``negotiate_chunk_cap``): a loaded fleet trains in small chunks that
+  fit between batcher deadlines, an idle one gets the full cap.
+* **brownout** — the scheduler registers WINDOWED p99 watches over the
+  serving latency histograms at ``brownout_fraction`` of the serving
+  SLO (``guard_latency``/``guard_fleet``) and hooks the watchdog's
+  breach stream: a breach ping throttles training (halved chunks + a
+  host-side yield per consult), a persistent one pauses it through the
+  engine's ``pause_control`` seam (state evicted to a checkpoint
+  bundle; the resumed refresh is byte-identical — PR 2 capture/restore),
+  and ``recovery_s`` of quiet resumes.  Throttling fires BEFORE the real
+  serving SLO would breach — that is the point of brownout-aware
+  training.
+* **dual-plane device loss** — hooked on
+  ``PodFleet.add_device_lost_listener``: one lost device drains the
+  serving replicas (the fleet's own replan) AND shrinks the training
+  world (``resilience/elastic.plan_shrunk_world`` + ``apply_world``) in
+  the same coordinated replan, with a ``coresident:device_lost`` flight
+  bundle naming both planes' outcomes (docs/RESILIENCE.md §8).
+
+Telemetry: ``coresident_throttle_total`` / ``coresident_pause_total``
+counters, ``coresident.pause`` spans and ``coresident.resume`` /
+``coresident.throttle`` instants (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..obs.metrics import global_registry as _obs_registry
+from ..obs.trace import instant as _instant
+from ..obs.trace import span as _span
+from ..obs.watchdog import global_watchdog, histogram_p99_ms
+from ..ops.planner import (LedgerError, ResidencyLedger, set_active_ledger,
+                           active_ledger)
+from .control import PauseControl
+
+_CHUNK_CAP_ENV = "LGBM_TPU_CORESIDENT_CHUNK_CAP"
+_THROTTLE_ENV = "LGBM_TPU_CORESIDENT_THROTTLE_S"
+_RECOVERY_ENV = "LGBM_TPU_CORESIDENT_RECOVERY_S"
+
+
+class CoresidencyInfeasible(RuntimeError):
+    """Training cannot fit beside the current serving residency — the
+    loud refuse-don't-OOM verdict, carrying the plan summary and the
+    ledger's lease table."""
+
+
+def _env_float(name: str) -> Optional[float]:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+@dataclass
+class CoresidentConfig:
+    """Brownout policy knobs (env-overridable, utils/envflags.py)."""
+
+    # brownout ceiling = fraction * the serving p99 SLO — throttling
+    # must engage BEFORE the real SLO breaches
+    brownout_fraction: float = 0.6
+    # explicit brownout ceiling (ms); overrides the fraction when set
+    brownout_p99_ms: Optional[float] = None
+    # host-side yield per engine consult while throttled
+    # (LGBM_TPU_CORESIDENT_THROTTLE_S)
+    throttle_delay_s: float = 0.02
+    # persistent breach pings past this escalate throttle -> pause
+    escalate_s: float = 0.25
+    # quiet (no breach pings) for this long de-escalates to run
+    # (LGBM_TPU_CORESIDENT_RECOVERY_S)
+    recovery_s: float = 1.0
+    # macro-chunk cap ceiling (LGBM_TPU_CORESIDENT_CHUNK_CAP; None =
+    # boosting.macro.chunk_cap())
+    chunk_cap: Optional[int] = None
+    # paused-refresh poll cadence and give-up bound
+    poll_interval_s: float = 0.05
+    max_pause_s: float = 120.0
+
+    @classmethod
+    def from_env(cls) -> "CoresidentConfig":
+        cfg = cls()
+        v = _env_float(_CHUNK_CAP_ENV)
+        if v is not None and v >= 1:
+            cfg.chunk_cap = int(v)
+        v = _env_float(_THROTTLE_ENV)
+        if v is not None:
+            cfg.throttle_delay_s = max(v, 0.0)
+        v = _env_float(_RECOVERY_ENV)
+        if v is not None:
+            cfg.recovery_s = max(v, 0.0)
+        return cfg
+
+
+class Scheduler:
+    """One pod, whole lifecycle: run guarded refreshes beside serving.
+
+    ``fleet`` is a ``PodFleet`` (or None for ledger-only use);
+    ``ledger`` defaults to a fresh ``ResidencyLedger`` over the device
+    limit; ``world`` optionally carries the training mesh as
+    ``{"num_slices": s, "devices_per_slice": d}`` for the dual-plane
+    shrink.  ``workdir`` hosts pause/snapshot bundles.
+    """
+
+    def __init__(self, fleet=None, ledger: Optional[ResidencyLedger] = None,
+                 config: Optional[CoresidentConfig] = None,
+                 watchdog=None, world: Optional[dict] = None,
+                 workdir: Optional[str] = None):
+        self.fleet = fleet
+        self.ledger = ledger if ledger is not None else ResidencyLedger()
+        self.config = config or CoresidentConfig.from_env()
+        self.world = dict(world) if world else None
+        self.workdir = workdir or "coresident_work"
+        self._wd = watchdog or global_watchdog
+        import threading
+        self._lock = threading.Lock()
+        self._guards: dict = {}       # guarded-by: _lock
+        #                               watch name -> (hist, ceiling_ms)
+        self._last_ping = 0.0         # guarded-by: _lock
+        self._first_ping = 0.0        # guarded-by: _lock
+        self._last_sweep = 0.0        # guarded-by: _lock
+        self._throttles = 0           # guarded-by: _lock
+        self._pauses = 0              # guarded-by: _lock
+        self._device_losses = 0       # guarded-by: _lock
+        self._closed = False          # guarded-by: _lock
+        self.control = PauseControl(
+            base_chunk_cap=self.config.chunk_cap or 32,
+            throttle_delay_s=self.config.throttle_delay_s,
+            on_step=self._on_step)
+        self._prev_ledger = set_active_ledger(self.ledger)
+        self._wd.add_breach_listener(self._on_breach)
+        if fleet is not None and hasattr(fleet, "add_device_lost_listener"):
+            fleet.add_device_lost_listener(self._on_device_lost)
+
+    # ----------------------------------------------------------- guards
+
+    def _brownout_ceiling_ms(self,
+                             slo_ms: Optional[float]) -> Optional[float]:
+        if self.config.brownout_p99_ms is not None:
+            return float(self.config.brownout_p99_ms)
+        slo = slo_ms if slo_ms is not None else self._wd.config.serving_p99_ms
+        if slo is None:
+            return None
+        return float(slo) * float(self.config.brownout_fraction)
+
+    def guard_latency(self, name: str, hist,
+                      slo_ms: Optional[float] = None) -> Optional[str]:
+        """Watch ``hist``'s WINDOWED p99 at the brownout ceiling (a
+        fraction of the serving SLO ``slo_ms``); breach pings throttle
+        and pause training.  Returns the watch name, or None when no
+        ceiling is derivable (no SLO configured anywhere)."""
+        ceiling = self._brownout_ceiling_ms(slo_ms)
+        if ceiling is None:
+            return None
+        wname = f"coresident:{name}"
+        self._wd.watch_histogram_p99(wname, hist, ceiling_ms=ceiling,
+                                     windowed=True)
+        with self._lock:
+            self._guards[wname] = (hist, ceiling)
+        return wname
+
+    def guard_fleet(self, slo_ms: Optional[float] = None) -> list:
+        """Guard every live replica's request-latency histogram of the
+        attached pod fleet; returns the watch names registered."""
+        if self.fleet is None:
+            return []
+        names = []
+        for (model, device), hist in \
+                self.fleet.latency_histograms().items():
+            w = self.guard_latency(f"{model}:d{device}", hist, slo_ms)
+            if w is not None:
+                names.append(w)
+        return names
+
+    def lease_serving_residency(self):
+        """Lease the serving plane's planned resident bytes (the pod
+        topology's busiest device) so training planning sees only the
+        true remainder.  Returns the lease, or None without a planned
+        fleet."""
+        if self.fleet is None:
+            return None
+        topo = getattr(self.fleet, "topology", None)
+        if topo is None:
+            return None
+        resident = max((p.total_resident_bytes
+                        for p in topo.device_plans.values()), default=0)
+        if resident <= 0:
+            resident = max(topo.device_load_bytes.values(), default=0)
+        if resident <= 0:
+            return None
+        return self.ledger.lease("fleet:resident", resident,
+                                 plane="serving", preemptible=False)
+
+    # ------------------------------------------------- brownout machine
+
+    def _on_breach(self, slo: str, evidence: dict, rising: bool) -> None:
+        # the signals that mean "serving is hurting on our devices":
+        # our own windowed brownout guards, the server's serving-p99
+        # SLO, and fleet availability
+        if not (slo.startswith("slo:coresident:")
+                or slo.startswith("slo:serving_p99:")
+                or slo.startswith("availability:")):
+            return
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return
+            self._last_ping = now
+            if self.control.state == PauseControl.RUN:
+                self._first_ping = now
+            escalate = (self.control.state == PauseControl.THROTTLE
+                        and now - self._first_ping
+                        >= self.config.escalate_s)
+        if self.control.request_throttle():
+            with self._lock:
+                self._throttles += 1
+            _obs_registry.counter("coresident_throttle_total").inc()
+            _instant("coresident.throttle", slo=slo, **{
+                k: v for k, v in evidence.items()
+                if isinstance(v, (int, float, str))})
+        elif escalate and self.control.request_pause():
+            with self._lock:
+                self._pauses += 1
+            _obs_registry.counter("coresident_pause_total").inc()
+            _instant("coresident.pause_requested", slo=slo)
+
+    def _on_step(self, iteration: int) -> None:
+        """The engine's per-chunk check-in (PauseControl.on_step)."""
+        self._tick()
+
+    def _tick(self) -> None:
+        """One brownout-machine turn: sweep the watchdog (when no sentry
+        thread owns the cadence) and de-escalate after quiet."""
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return
+            sweep = (now - self._last_sweep
+                     >= max(self.config.poll_interval_s, 0.0))
+            if sweep:
+                self._last_sweep = now
+        if sweep and not self._wd.running:
+            try:
+                self._wd.check_once()
+            except Exception:  # noqa: BLE001 — the tick never kills
+                pass           # training
+        with self._lock:
+            last = self._last_ping
+        if self.control.state != PauseControl.RUN and last > 0 and \
+                time.monotonic() - last >= self.config.recovery_s:
+            if self.control.request_run():
+                _instant("coresident.recover",
+                         quiet_s=round(time.monotonic() - last, 3))
+
+    def negotiate_chunk_cap(self) -> int:
+        """Macro-chunk cap from observed p99 headroom under the brownout
+        ceiling: full cap with ample headroom (or no data), down to 1 as
+        observed p99 approaches the ceiling — chunks sized to fit
+        between batcher deadlines."""
+        from ..boosting.macro import chunk_cap as _env_cap, pow2_chunk
+        base = max(int(self.config.chunk_cap or _env_cap()), 1)
+        with self._lock:
+            guards = dict(self._guards)
+        fracs = []
+        for _wname, (hist, ceiling) in guards.items():
+            p99 = histogram_p99_ms(hist)
+            if p99 is None or ceiling <= 0:
+                continue
+            fracs.append(max(1.0 - p99 / ceiling, 0.0))
+        if not fracs:
+            return base
+        want = max(int(base * min(fracs)), 1)
+        return pow2_chunk(want, base)
+
+    # ------------------------------------------------------ the refresh
+
+    def refresh(self, name: str, train_set, params: dict,
+                num_boost_round: int, init_model=None, swap: bool = True,
+                **train_kw):
+        """One guarded lifecycle refresh beside live serving.
+
+        Plans against the ledger's remainder (raising
+        ``CoresidencyInfeasible`` when even the degraded plan does not
+        fit), leases the predicted peak as a PREEMPTIBLE training
+        claim, trains with the brownout ``pause_control`` under
+        ``ResidencyLedger.train_env`` — riding out any number of
+        pause/resume cycles byte-identically — then hot-swaps the fleet
+        model and marks it fresh.  Returns ``(booster, stats)``.
+        """
+        from ..config import Config
+        from ..engine import TrainingPaused, train
+
+        train_set.construct()
+        cfg = Config.from_params(dict(params))
+        rows = int(train_set.num_data)
+        features = max(int(train_set.num_total_features or 1), 1)
+        from ..ops.planner import plan_histograms
+        plan = plan_histograms(
+            rows=rows, features=features, num_bins=cfg.max_bin + 1,
+            num_leaves=cfg.num_leaves, num_class=max(cfg.num_class, 1),
+            ledger=self.ledger)
+        if not plan.feasible:
+            raise CoresidencyInfeasible(
+                f"refresh {name!r} cannot fit beside serving residency: "
+                f"predicted peak {plan.predicted_peak_bytes} bytes at "
+                f"tile {plan.tile_rows} > remaining "
+                f"{self.ledger.available_bytes()} of the "
+                f"{self.ledger.budget_bytes}-byte budget; plan="
+                f"{plan.summary()}; leases={self.ledger.table()}")
+        try:
+            lease = self.ledger.lease(f"refresh:{name}",
+                                      plan.predicted_peak_bytes,
+                                      plane="train", preemptible=True)
+        except LedgerError as e:
+            raise CoresidencyInfeasible(str(e)) from e
+
+        cap = self.negotiate_chunk_cap()
+        self.control.set_base_cap(cap)
+        self.control.request_run()
+        os.makedirs(self.workdir, exist_ok=True)
+        train_kw.setdefault("snapshot_out",
+                            os.path.join(self.workdir, f"{name}.txt"))
+        with self._lock:
+            throttles0, pauses0 = self._throttles, self._pauses
+        resume_from = train_kw.pop("resume_from", None)
+        pauses = 0
+        t0 = time.monotonic()
+        while True:
+            try:
+                with self.ledger.train_env(lease):
+                    booster = train(dict(params), train_set,
+                                    num_boost_round,
+                                    init_model=init_model,
+                                    verbose_eval=False,
+                                    resume_from=resume_from,
+                                    pause_control=self.control,
+                                    **train_kw)
+                break
+            except TrainingPaused as e:
+                pauses += 1
+                resume_from = e.bundle_path
+                # training state lives in the bundle now: give the HBM
+                # back to serving for the duration of the brownout
+                self.ledger.release(lease)
+                with _span("coresident.pause", model=name,
+                           iteration=e.iteration, pauses=pauses):
+                    lease = self._await_resume(name,
+                                               plan.predicted_peak_bytes)
+                _instant("coresident.resume", model=name,
+                         iteration=e.iteration, pauses=pauses)
+            except BaseException:
+                self.ledger.release(lease)
+                raise
+        self.ledger.release(lease)
+        if swap and self.fleet is not None:
+            self.fleet.swap_model(name, booster)
+        # freshness SLO: the refresh IS the promotion — age resets to
+        # zero only now, never during a pause (a paused refresh must not
+        # fake freshness, nor reset the deployed model's age)
+        self._wd.mark_fresh(name)
+        with self._lock:
+            throttled = self._throttles - throttles0
+            paused_total = self._pauses - pauses0
+        stats = {"model": name, "rows": rows,
+                 "num_boost_round": int(num_boost_round),
+                 "chunk_cap": cap, "pauses": pauses,
+                 "throttles": throttled,
+                 "pause_requests": paused_total,
+                 "tile_rows": plan.tile_rows,
+                 "predicted_peak_bytes": plan.predicted_peak_bytes,
+                 "wall_s": round(time.monotonic() - t0, 3)}
+        _instant("coresident.refresh", **stats)
+        return booster, stats
+
+    def _await_resume(self, name: str, want_bytes: int):
+        """Block until the brownout clears AND the training bytes can be
+        re-leased; loud RuntimeError past ``max_pause_s`` (a refresh
+        must never vanish into a silent forever-pause)."""
+        deadline = time.monotonic() + max(self.config.max_pause_s, 0.0)
+        while True:
+            self._tick()
+            if self.control.state != PauseControl.PAUSE:
+                lease = self.ledger.try_lease(
+                    f"refresh:{name}", want_bytes, plane="train",
+                    preemptible=True)
+                if lease is not None:
+                    return lease
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"coresident refresh {name!r}: paused longer than "
+                    f"max_pause_s={self.config.max_pause_s}s (state="
+                    f"{self.control.state}, leases="
+                    f"{self.ledger.table()}); refusing to wait forever")
+            time.sleep(max(self.config.poll_interval_s, 0.005))
+
+    # ------------------------------------------------- dual-plane loss
+
+    def _on_device_lost(self, device_id: int, reason: str,
+                        recovered: bool) -> None:
+        """PodFleet drain hook: shrink the training world in the SAME
+        coordinated replan that drained the serving replicas, and bundle
+        both planes' outcomes."""
+        with self._lock:
+            if self._closed:
+                return
+            self._device_losses += 1
+        world_before = dict(self.world) if self.world else None
+        world_after = world_before
+        # a paused/running refresh must re-plan onto the shrunk world:
+        # order a pause (state rides a bundle), re-plan, then resume —
+        # the resumed train() constructs its mesh from the new env
+        was_training = self.control.state != PauseControl.PAUSE
+        self.control.request_pause()
+        if self.world and int(self.world.get("num_slices", 1)) > 1:
+            from ..resilience.elastic import apply_world, plan_shrunk_world
+            mp = plan_shrunk_world(
+                int(self.world["num_slices"]),
+                int(self.world.get("devices_per_slice", 1)),
+                lost_slices=1)
+            apply_world(mp)
+            self.world = {"num_slices": mp.num_slices,
+                          "devices_per_slice": mp.devices_per_slice}
+            world_after = dict(self.world)
+        serving = {"device": device_id, "reason": reason,
+                   "replanned": True, "recovered_one_tick": bool(recovered)}
+        if self.fleet is not None:
+            try:
+                serving["live_devices"] = self.fleet.live_devices()
+                serving["models"] = self.fleet.models()
+            except Exception:  # noqa: BLE001 — forensics never fail the
+                pass           # replan
+        training = {"world_before": world_before,
+                    "world_after": world_after,
+                    "was_training": was_training,
+                    "state": self.control.state}
+        from ..obs.flight import global_flight
+        global_flight.dump("coresident:device_lost", extra={
+            "serving": serving, "training": training,
+            "ledger": self.ledger.table()})
+        _obs_registry.counter("coresident_device_lost_total").inc()
+        # both planes replanned: release the brownout hold so the
+        # paused refresh re-leases and resumes on the shrunk world
+        with self._lock:
+            self._last_ping = 0.0
+        self.control.request_run()
+
+    # ------------------------------------------------------------ teardown
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"throttles": self._throttles, "pauses": self._pauses,
+                    "device_losses": self._device_losses,
+                    "state": self.control.state,
+                    "ledger": self.ledger.summary()}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            guards = list(self._guards)
+            self._guards.clear()
+        self._wd.remove_breach_listener(self._on_breach)
+        for wname in guards:
+            self._wd.unwatch_histogram(wname)
+        if self.fleet is not None and \
+                hasattr(self.fleet, "remove_device_lost_listener"):
+            self.fleet.remove_device_lost_listener(self._on_device_lost)
+        if active_ledger() is self.ledger:
+            set_active_ledger(self._prev_ledger)

@@ -23,6 +23,22 @@ from .obs.trace import span as _span
 from .obs.watchdog import global_watchdog as _watchdog
 
 
+class TrainingPaused(Exception):
+    """Raised out of ``train()`` when its ``pause_control`` orders a
+    pause: the full training state was evicted to a checkpoint bundle
+    FIRST, so the caller resumes byte-identically later by re-calling
+    ``train`` with the same arguments plus ``resume_from=e.bundle_path``
+    (the PR 2 capture/restore machinery — docs/RESILIENCE.md).  Not an
+    error: the engine's forensic on-exception dump does not fire."""
+
+    def __init__(self, iteration: int, bundle_path: str):
+        super().__init__(
+            f"training paused at iteration {iteration}; state evicted "
+            f"to {bundle_path}")
+        self.iteration = int(iteration)
+        self.bundle_path = bundle_path
+
+
 def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
           valid_names: Optional[List[str]] = None,
@@ -35,7 +51,8 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
           callbacks: Optional[List[Callable]] = None,
           snapshot_freq: int = -1, snapshot_out: str = "model.txt",
           snapshot_keep: int = 3,
-          resume_from: Optional[str] = None) -> Booster:
+          resume_from: Optional[str] = None,
+          pause_control=None) -> Booster:
     """reference: engine.py:18.
 
     ``snapshot_freq`` mirrors the CLI's periodic snapshots
@@ -50,6 +67,13 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     ``LGBM_TPU_COMPILE_CACHE=<dir>`` enables the persistent XLA
     compilation cache at engine init (docs/PERF.md): repeated trainings
     of same-shaped programs skip XLA entirely on the warm path.
+
+    ``pause_control`` is the co-resident brownout seam
+    (coresident/control.py, duck-typed): consulted at every chunk
+    boundary.  ``consult(i)`` may sleep (throttle) and returns "run" or
+    "pause"; ``chunk_cap()`` caps the macro-chunk so training yields the
+    device between serving deadlines.  A "pause" verdict checkpoints the
+    full state and raises ``TrainingPaused`` — docs/PERF.md co-residency.
     """
     from .utils.platform import enable_compile_cache
     enable_compile_cache()
@@ -252,6 +276,21 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     train_root.__enter__()
     try:
         while i < num_boost_round:
+            if pause_control is not None \
+                    and pause_control.consult(i) == "pause":
+                # evict the full training state to a bundle BEFORE
+                # yielding the device: the resumed run is byte-identical
+                mgr = ckpt_mgr
+                if mgr is None:
+                    from .resilience.checkpoint import CheckpointManager
+                    mgr = CheckpointManager(f"{snapshot_out}.ckpt",
+                                            keep_last=max(snapshot_keep, 1))
+                path = mgr.save(
+                    booster, iteration=i,
+                    engine_state={"callbacks": _collect_callback_states(
+                        cbs_before + cbs_after)})
+                _flight.note("engine.pause", i=i, bundle=str(path))
+                raise TrainingPaused(i, path)
             c = 1
             if can_chunk:
                 d = num_boost_round - i
@@ -260,6 +299,12 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
                 if ckpt_mgr is not None:
                     d = min(d, snapshot_freq - (i % snapshot_freq))
                 c = pow2_chunk(d, cap)
+                if pause_control is not None:
+                    # brownout throttle: the negotiated cap shrinks the
+                    # macro-chunk so the host regains control (and the
+                    # batcher its deadline) sooner
+                    c = pow2_chunk(c, max(int(pause_control.chunk_cap()),
+                                          1))
             t_step0 = time.perf_counter()
             if c > 1:
                 lrs = ([_lr_at(j) for j in range(i, i + c)] if lr_cbs else None)
@@ -322,6 +367,11 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
                         cbs_before + cbs_after)})
             if early_stopped or finished:
                 break
+    except TrainingPaused:
+        # a brownout pause is an ORDERED yield, not a failure: no
+        # forensic dump (the scheduler journals the pause/resume spans)
+        train_root.set(paused=True)
+        raise
     except BaseException as e:
         train_root.set(error=type(e).__name__)
         # unhandled engine-loop failure: dump the forensic bundle (ring

@@ -352,6 +352,7 @@ class PodFleet:
         self._specs: Dict[str, _ModelSpec] = {}     # guarded-by: _table_lock
         self._replicas: Dict[str, List[Replica]] = {}  # guarded-by: _table_lock
         self._dead: set = set()                     # guarded-by: _table_lock
+        self._device_lost_listeners: list = []      # guarded-by: _table_lock
         self._topology: Optional[TopologyPlan] = None  # guarded-by: _table_lock
         self._admissions = 0                        # guarded-by: _table_lock
         self._replan_every = int(
@@ -406,6 +407,24 @@ class PodFleet:
         with self._table_lock:
             return [d.device_id for d in self._devices
                     if d.device_id not in self._dead]
+
+    def latency_histograms(self) -> dict:
+        """``{(model, device_id): request_latency_ms Histogram}`` for
+        every live full-precision replica — the co-resident scheduler's
+        brownout guards watch these (coresident/scheduler.py).  Replicas
+        whose entry vanished mid-read are skipped, like ``fill()``."""
+        with self._table_lock:
+            reps = [(name, r) for name, rs in self._replicas.items()
+                    for r in rs
+                    if not r.lowprec and r.device_id not in self._dead]
+        out = {}
+        for name, r in reps:
+            try:
+                hist = r.server.metrics.histogram("request_latency_ms")
+            except (ModelNotFound, ServerClosed):
+                continue
+            out[(name, r.device_id)] = hist
+        return out
 
     # ----------------------------------------------------------- registry
 
@@ -979,6 +998,32 @@ class PodFleet:
         it, re-dispatch its in-flight requests, replan the topology."""
         self._device_lost(device_id, reason, wait=True)
 
+    def add_device_lost_listener(self, fn) -> None:
+        """Register ``fn(device_id, reason, recovered)`` to run after a
+        lost device's drain settles (serving replan done or abandoned).
+        The co-resident scheduler hooks here so a device loss shrinks
+        the TRAINING world in the same coordinated replan that drained
+        the serving replicas (coresident/scheduler.py).  Exceptions are
+        swallowed: a broken hook never blocks the drain."""
+        with self._table_lock:
+            if fn not in self._device_lost_listeners:
+                self._device_lost_listeners.append(fn)
+
+    def remove_device_lost_listener(self, fn) -> None:
+        with self._table_lock:
+            if fn in self._device_lost_listeners:
+                self._device_lost_listeners.remove(fn)
+
+    def _notify_device_lost(self, device_id: int, reason: str,
+                            recovered: bool) -> None:
+        with self._table_lock:
+            listeners = list(self._device_lost_listeners)
+        for fn in listeners:
+            try:
+                fn(device_id, reason, recovered)
+            except Exception:  # noqa: BLE001 — hooks never block the drain
+                pass
+
     def _device_lost(self, device_id: int, reason: str,
                      wait: bool = False) -> None:
         with self._table_lock:
@@ -1035,12 +1080,15 @@ class PodFleet:
         try:
             plan = self.replan()
         except DeviceLost:
-            return  # every device gone: host-path-only from here
+            # every device gone: host-path-only from here
+            self._notify_device_lost(device_id, reason, recovered=False)
+            return
         except ServingError as e:  # a replacement replica quarantined:
             from ..utils.log import log_warning   # recovery is partial,
             log_warning(                          # the drain lives on
                 f"pod fleet: replan after losing device {device_id} "
                 f"failed: {e}")
+            self._notify_device_lost(device_id, reason, recovered=False)
             return
         # the acceptance bar: the FIRST replan after a loss restores
         # every model's replica coverage — recovery within one tick
@@ -1048,6 +1096,7 @@ class PodFleet:
             ok = all(len(plan.replicas.get(n, ())) > 0
                      for n in self._specs)
         self.metrics.gauge("fleet_recovered_one_tick").set(int(ok))
+        self._notify_device_lost(device_id, reason, recovered=bool(ok))
 
     # ----------------------------------------------------------- warm/aot
 

@@ -117,21 +117,38 @@ class TopologyPlan(NamedTuple):
 
 
 def plan_topology(models, devices, accel: Optional[bool] = None,
-                  max_replicas: Optional[int] = None) -> TopologyPlan:
+                  max_replicas: Optional[int] = None,
+                  ledgers: Optional[Dict[int, "ResidencyLedger"]] = None
+                  ) -> TopologyPlan:
     """Elect replica placement for ``models`` (``FleetModelShape`` list)
     over ``devices`` (``DeviceSpec`` list) — module docstring for the
     election; deterministic for identical inputs (ties break on the
-    lower device id / earlier model)."""
+    lower device id / earlier model).
+
+    ``ledgers`` maps device ids to co-residency ledgers
+    (``ops.planner.ResidencyLedger``): a device with a ledger is planned
+    against the ledger's REMAINING budget (bytes an in-flight training
+    refresh has leased are not available for replica placement), and its
+    per-device residency election runs through ``plan_fleet(ledger=)``
+    so the two verdicts agree."""
     models = list(models)
     devices = tuple(sorted(devices, key=lambda d: d.device_id))
     if not devices:
         raise ValueError("plan_topology needs at least one device")
     cap = min(max_replicas or len(devices), len(devices))
+    ledgers = ledgers or {}
 
     default_limit = None
     limits: Dict[int, int] = {}
     budgets: Dict[int, int] = {}
     for d in devices:
+        lg = ledgers.get(d.device_id)
+        if lg is not None:
+            # the ledger already applied HEADROOM once; its remainder IS
+            # the placement budget for this device
+            limits[d.device_id] = int(lg.limit_bytes)
+            budgets[d.device_id] = int(lg.available_bytes())
+            continue
         limit = d.hbm_budget_bytes
         if limit is None:
             if default_limit is None:
@@ -198,8 +215,13 @@ def plan_topology(models, devices, accel: Optional[bool] = None,
     for d in devices:
         placed = [shapes[p.name] for p in placements
                   if p.device_id == d.device_id]
-        device_plans[d.device_id] = plan_fleet(
-            placed, budget_bytes=limits[d.device_id], accel=accel)
+        lg = ledgers.get(d.device_id)
+        if lg is not None:
+            device_plans[d.device_id] = plan_fleet(
+                placed, accel=accel, ledger=lg)
+        else:
+            device_plans[d.device_id] = plan_fleet(
+                placed, budget_bytes=limits[d.device_id], accel=accel)
 
     return TopologyPlan(
         devices=devices, placements=tuple(placements),

@@ -21,6 +21,12 @@ Verdict taxonomy (docs/OBSERVABILITY.md):
                          hide device_put behind compute;
 - ``straggler``        — one slice's iterations run materially slower
                          than its peers' (names the slice);
+- ``contention``       — co-resident train and serve are fighting over
+                         the same devices: training has been throttled /
+                         paused by brownout signals while serving p99
+                         climbed (evidence: the residency-ledger lease
+                         table plus the throttle/pause event counts —
+                         coresident/scheduler.py);
 - ``kernel-underutilized`` — none of the above, yet measured MFU says
                          the chip is mostly idle (the per-level work is
                          just too small: batch models or fuse more);
@@ -43,6 +49,7 @@ COMPILE_FRACTION_MATERIAL = 0.4   # compile / (compile + train) wall
 OVERLAP_EFFICIENCY_FLOOR = 1.05   # pump gain below this = no overlap
 STRAGGLER_SKEW_MATERIAL = 1.15    # slowest / fastest slice
 MFU_HEALTHY_FLOOR = 0.01          # below this the chip is mostly idle
+CONTENTION_EVENTS_MATERIAL = 1    # >= this many throttles+pauses fires
 
 
 @dataclass
@@ -88,6 +95,30 @@ def collect_signals(registry=None, stages: Optional[dict] = None) -> dict:
     sig["slo_breach_total"] = sum(
         v for k, v in c.items() if k.startswith("slo_breach_total"))
     sig["stream_blocks_total"] = c.get("stream_blocks_total", 0)
+    # co-residency contention signals: brownout event counters, the
+    # residency ledger's lease accounting, and the worst watched p99
+    sig["coresident_throttle_total"] = sum(
+        v for k, v in c.items()
+        if k.startswith("coresident_throttle_total"))
+    sig["coresident_pause_total"] = sum(
+        v for k, v in c.items() if k.startswith("coresident_pause_total"))
+    for k, v in g.items():
+        if k.startswith("ledger_leased_bytes"):
+            sig["ledger_leased_bytes"] = sig.get("ledger_leased_bytes",
+                                                 0.0) + _num(v)
+    if "ledger_available_bytes" in g:
+        sig["ledger_available_bytes"] = g["ledger_available_bytes"]
+    p99s = [_num(v) for k, v in g.items()
+            if k.startswith("watchdog_p99_")]
+    if p99s:
+        sig["watchdog_p99_ms_max"] = max(p99s)
+    try:
+        from ..ops.planner import active_ledger
+        lg = active_ledger()
+        if lg is not None:
+            sig["ledger_lease_table"] = lg.table()
+    except Exception:  # noqa: BLE001 — forensics only
+        pass
     # bench journal stages refine / supply the workload-scale numbers
     stages = stages or {}
     full = None
@@ -209,6 +240,31 @@ def diagnose(signals: dict) -> List[Verdict]:
             "links); elastic shrink-rejoin can drop it",
             {"straggler_slice": slice_k, "straggler_skew": skew,
              "threshold": STRAGGLER_SKEW_MATERIAL}))
+
+    # --- contention: co-resident planes fighting over the same devices
+    thr = _num(s.get("coresident_throttle_total"))
+    pauses = _num(s.get("coresident_pause_total"))
+    if thr + pauses >= CONTENTION_EVENTS_MATERIAL:
+        ev = {"coresident_throttle_total": int(thr),
+              "coresident_pause_total": int(pauses)}
+        for k in ("ledger_leased_bytes", "ledger_available_bytes",
+                  "watchdog_p99_ms_max"):
+            if k in s:
+                ev[k] = s[k]
+        table = s.get("ledger_lease_table")
+        if isinstance(table, list):
+            ev["ledger_lease_table"] = table
+        # pauses weigh double: a pause means the brownout persisted past
+        # throttling — deeper contention than a transient spike
+        out.append(Verdict(
+            "contention",
+            min(0.4 + 0.05 * (thr + 2.0 * pauses), 0.9),
+            f"co-resident training was throttled {int(thr)}x and paused "
+            f"{int(pauses)}x by serving brownout signals — train and "
+            "serve are contending for the same devices; shrink the "
+            "training chunk cap / lease, move the refresh off-peak, or "
+            "give serving its own devices",
+            ev))
 
     # --- kernel-underutilized: nothing specific, chip still idle
     mfu = s.get("mfu_measured_best")

@@ -8,7 +8,10 @@ dumps.  Endpoints:
 
 - ``GET /metrics``       — Prometheus text exposition (version 0.0.4)
 - ``GET /metrics.json``  — the ``to_dict()`` JSON snapshot
-- ``GET /healthz``       — ``ok`` (liveness for orchestration)
+- ``GET /healthz``       — ``ok`` (200) while the global watchdog has no
+  un-recovered SLO breach; 503 with a JSON breach list otherwise, so an
+  orchestrator's readiness probe sheds traffic from a browned-out pod
+  instead of reading "alive" as "healthy"
 
 Opt-in only: ``LIGHTGBM_TPU_METRICS_PORT=<port>`` makes the engine and
 every ``Server`` call ``maybe_start_from_env`` (idempotent, one server
@@ -73,7 +76,16 @@ class MetricsHTTPServer:
                                               sort_keys=True).encode(),
                                    "application/json")
                     elif path == "/healthz":
-                        self._send(200, b"ok\n", "text/plain")
+                        from .watchdog import global_watchdog
+                        breaches = global_watchdog.active_breaches()
+                        if breaches:
+                            self._send(503, json.dumps(
+                                {"status": "degraded",
+                                 "breaches": breaches},
+                                sort_keys=True).encode(),
+                                "application/json")
+                        else:
+                            self._send(200, b"ok\n", "text/plain")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # noqa: BLE001 — scrape never kills

@@ -130,7 +130,10 @@ class Watchdog:
         self._watched: dict = {}      # name -> stale threshold seconds
         self._floors: dict = {}       # name -> rate floor (units/sec)
         self._rate_state: dict = {}   # guarded-by: _lock (ts, count)/name
-        self._hists: dict = {}        # name -> (Histogram, ceiling_ms)
+        self._hists: dict = {}        # name -> (Histogram, ceiling_ms,
+        #                               windowed)
+        self._hist_state: dict = {}   # guarded-by: _lock — windowed p99:
+        #                               name -> (bucket counts, count)
         self._fresh: dict = {}        # guarded-by: _lock
         #                               name -> (fresh_ts, max_age_s|None)
         self._avail: dict = {}        # guarded-by: _lock
@@ -138,6 +141,7 @@ class Watchdog:
         self._avail_state: dict = {}  # guarded-by: _lock
         #                               name -> (completed, failed) last sweep
         self._breached: set = set()   # guarded-by: _lock (edge detection)
+        self._listeners: list = []    # guarded-by: _lock (breach hooks)
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -193,16 +197,28 @@ class Watchdog:
                               if not b.endswith(":" + name)}
 
     def watch_histogram_p99(self, name: str, hist,
-                            ceiling_ms: Optional[float] = None) -> None:
+                            ceiling_ms: Optional[float] = None,
+                            windowed: bool = False) -> None:
         """Hold ``hist``'s estimated p99 to ``ceiling_ms`` (defaults to
         the config's serving_p99_ms; never breaches while both are
-        None)."""
+        None).
+
+        ``windowed=True`` estimates the p99 over the samples observed
+        SINCE THE LAST SWEEP (differencing the cumulative buckets, like
+        the availability watch) instead of over the histogram's whole
+        cumulative history.  A cumulative p99 is sticky — one latency
+        spike breaches it for the process lifetime — so windowed is the
+        mode brownout controllers use: the breach clears once the
+        current traffic is back under the ceiling
+        (coresident/scheduler.py)."""
         with self._lock:
-            self._hists[name] = (hist, ceiling_ms)
+            self._hists[name] = (hist, ceiling_ms, bool(windowed))
+            self._hist_state.pop(name, None)
 
     def unwatch_histogram(self, name: str) -> None:
         with self._lock:
             self._hists.pop(name, None)
+            self._hist_state.pop(name, None)
             # a re-registered same-name watch must get a fresh rising
             # edge (its dump would otherwise be suppressed forever)
             self._breached.discard(f"slo:{name}")
@@ -266,6 +282,51 @@ class Watchdog:
 
     # -------------------------------------------------------------- checks
 
+    def active_breaches(self) -> list:
+        """Sorted snapshot of the currently UN-RECOVERED breach names —
+        what /healthz reports as degraded (obs/http.py) and what a
+        brownout controller polls between sweeps."""
+        with self._lock:
+            return sorted(self._breached)
+
+    def add_breach_listener(self, fn) -> None:
+        """Register ``fn(slo, evidence, rising)`` to be called on EVERY
+        breach occurrence (not just the rising edge — a throttle
+        controller needs the repeat signal to know the brownout
+        persists).  Exceptions from listeners are swallowed: a broken
+        hook must never kill the sentry sweep."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_breach_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _windowed_p99(self, name: str, hist) -> Optional[float]:
+        """p99 estimate over the samples since the LAST sweep (delta of
+        the cumulative buckets).  None on the arming sweep or an empty
+        window."""
+        cum, _total, count = hist.cumulative()
+        counts = [c for _b, c in cum]
+        with self._lock:
+            prev = self._hist_state.get(name)
+            self._hist_state[name] = (counts, count)
+        if prev is None:
+            return None
+        dcount = count - prev[1]
+        if dcount <= 0:
+            return None
+        target = 0.99 * dcount
+        for (bound, c), pc in zip(cum, prev[0]):
+            if c - pc >= target:
+                if math.isinf(bound):
+                    snap = hist.snapshot()
+                    return float(snap.get("max", 0.0))
+                return float(bound)
+        return None
+
     def _breach(self, slo: str, evidence: dict) -> None:
         # the sentry thread and a caller's unwatch() both touch the
         # breach set; the rising-edge read must pair with the add, and a
@@ -292,6 +353,13 @@ class Watchdog:
         if rising:
             # rising edge only: a persistent breach must not dump-storm
             self._fl().dump(f"watchdog:{slo}", extra=evidence)
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(slo, evidence, rising)
+            except Exception:  # noqa: BLE001 — hooks never kill the sweep
+                pass
 
     def _clear(self, slo: str) -> None:
         with self._lock:
@@ -336,12 +404,13 @@ class Watchdog:
                     "rate": round(rate, 4), "floor": floor}))
             else:
                 self._clear(f"slo:{name}")
-        for name, (hist, ceiling) in hists.items():
+        for name, (hist, ceiling, windowed) in hists.items():
             if ceiling is None:
                 ceiling = self.config.serving_p99_ms
             if ceiling is None:
                 continue
-            p99 = histogram_p99_ms(hist)
+            p99 = (self._windowed_p99(name, hist) if windowed
+                   else histogram_p99_ms(hist))
             if p99 is None:
                 continue
             self._reg().gauge(f"watchdog_p99_{name}").set(p99)

@@ -42,6 +42,8 @@ an operator-tuned chunk count.
 from __future__ import annotations
 
 import os
+import threading
+from contextlib import contextmanager
 from typing import NamedTuple, Optional
 
 # default assumed HBM when the backend reports nothing (one v5e-class
@@ -373,6 +375,7 @@ def plan_histograms(
     accel: Optional[bool] = None,
     fused_ok: bool = False,               # caller-verified fused context
     vmem_bytes: Optional[int] = None,     # tests: fake VMEM model
+    ledger: Optional["ResidencyLedger"] = None,   # co-resident budget
 ) -> HistPlan:
     """Choose {tile_rows, use_pack, psum narrowing} for a training shape.
 
@@ -397,11 +400,18 @@ def plan_histograms(
 
     if budget_bytes is not None:
         limit, source = int(budget_bytes), "caller"
+        budget = int(limit * HEADROOM)
+    elif ledger is not None:
+        # co-resident planning: the budget is what the ledger has LEFT
+        # (already post-HEADROOM — the ledger applied it once to the
+        # device limit; re-applying here would double-charge)
+        limit, source = int(ledger.limit_bytes), "ledger"
+        budget = int(ledger.available_bytes())
     else:
         limit, source = hbm_limit_bytes()
-    # HEADROOM applies to EVERY limit source (caller-supplied fake
-    # memory models included) so tests exercise the shipped decision rule
-    budget = int(limit * HEADROOM)
+        # HEADROOM applies to EVERY limit source (caller-supplied fake
+        # memory models included) so tests exercise the shipped rule
+        budget = int(limit * HEADROOM)
     fp = None
     if fused_ok and method in ("auto", "fused") and fused_enabled_env():
         # the frontier never exceeds num_leaves - 1 candidates, so the
@@ -582,6 +592,7 @@ def plan_model_batch(
     use_pack: bool = True,
     budget_bytes: Optional[int] = None,   # tests: fake memory model
     accel: Optional[bool] = None,
+    ledger: Optional["ResidencyLedger"] = None,   # co-resident budget
 ) -> ModelBatchPlan:
     """Elect the lane chunk for a B-booster batched training group.
 
@@ -598,9 +609,13 @@ def plan_model_batch(
     B = max(int(b_total), 1)
     if budget_bytes is not None:
         limit, source = int(budget_bytes), "caller"
+        budget = int(limit * HEADROOM)
+    elif ledger is not None:
+        limit, source = int(ledger.limit_bytes), "ledger"
+        budget = int(ledger.available_bytes())   # already post-HEADROOM
     else:
         limit, source = hbm_limit_bytes()
-    budget = int(limit * HEADROOM)
+        budget = int(limit * HEADROOM)
     variant = _resolved_variant(method, quant)
     solo_peak, bd = predict_peak_bytes(
         rows, features, num_bins, num_leaves, num_class, quant, variant,
@@ -1124,7 +1139,8 @@ class FleetPlan(NamedTuple):
 
 
 def plan_fleet(models, budget_bytes: Optional[int] = None,
-               accel: Optional[bool] = None) -> FleetPlan:
+               accel: Optional[bool] = None,
+               ledger: Optional["ResidencyLedger"] = None) -> FleetPlan:
     """Elect per-model device residency for a serving fleet.
 
     Greedy by priority ``weight / (1 + age_s)`` — hot, heavily-weighted
@@ -1138,9 +1154,16 @@ def plan_fleet(models, budget_bytes: Optional[int] = None,
     """
     if budget_bytes is not None:
         limit, source = int(budget_bytes), "caller"
+        budget = int(limit * HEADROOM)
+    elif ledger is not None:
+        # serving election against the ledger's REMAINING budget: bytes
+        # already leased (e.g. by an in-flight training refresh) are not
+        # available for model residency
+        limit, source = int(ledger.limit_bytes), "ledger"
+        budget = int(ledger.available_bytes())
     else:
         limit, source = hbm_limit_bytes()
-    budget = int(limit * HEADROOM)
+        budget = int(limit * HEADROOM)
     models = list(models)
     order = sorted(
         range(len(models)),
@@ -1188,6 +1211,7 @@ def plan_stream(
     device_budget_bytes: Optional[int] = None,   # tests: fake memory model
     host_budget_bytes: Optional[int] = None,     # tests: fake memory model
     accel: Optional[bool] = None,
+    ledger: Optional["ResidencyLedger"] = None,  # co-resident budget
 ) -> StreamPlan:
     """Choose resident vs row-block-streamed execution for a shape.
 
@@ -1210,6 +1234,8 @@ def plan_stream(
     variant = _resolved_variant(method, quant)
     if device_budget_bytes is not None:
         dev_budget = int(device_budget_bytes * HEADROOM)
+    elif ledger is not None:
+        dev_budget = int(ledger.available_bytes())   # already post-HEADROOM
     else:
         dev_budget = int(hbm_limit_bytes()[0] * HEADROOM)
     if host_budget_bytes is not None:
@@ -1275,3 +1301,230 @@ def plan_stream(
     block = align(min(MIN_STREAM_BLOCK_ROWS, n))
     dp, hp = peaks(block)
     return mk(True, block, reason, dp, hp)
+
+
+# ======================================================================
+# Residency ledger: ONE per-device HBM budget both planes lease from.
+#
+# Every planner above models its OWN plane's peak against a budget it
+# assumes it owns — which is exactly how co-resident train+serve on one
+# pod over-commits and dies as a compile-OOM.  ``ResidencyLedger`` is
+# the arbitration layer: one post-HEADROOM budget per device, explicit
+# leases (who, which plane, how many bytes, preemptible?), and a
+# ``ledger=`` seam on ``plan_histograms`` / ``plan_model_batch`` /
+# ``plan_stream`` / ``plan_fleet`` (and ``fleet.topology.plan_topology``)
+# that makes each planner elect against the ledger's REMAINING bytes.
+# The degradation order falls out of the existing planners: a training
+# refresh planned against the remainder degrades its tile size first
+# (plan_histograms' tile walk), and only an explicit ``preempt`` ever
+# touches serving residency.  Infeasible co-residency is a loud
+# ``LedgerError`` carrying the lease table — never an XLA OOM.  Every
+# ledger event is journaled as a ``planner.ledger`` trace instant and
+# mirrored to ``ledger_*`` gauges (docs/OBSERVABILITY.md).
+# ======================================================================
+
+
+class LedgerError(RuntimeError):
+    """A lease request exceeds the ledger's remaining budget — the loud
+    co-residency verdict (refuse, don't OOM).  The message carries the
+    full lease table so the operator sees WHO holds the HBM."""
+
+
+class Lease(NamedTuple):
+    """One admitted residency claim."""
+
+    lease_id: int
+    owner: str                  # e.g. "fleet:ranker" / "refresh:ranker"
+    plane: str                  # "serving" | "train"
+    nbytes: int
+    preemptible: bool           # preempt() may evict it
+
+
+class ResidencyLedger:
+    """Per-device HBM budget shared by the serving and training planes.
+
+    Thread-safe: the serving fleet's replan thread and the co-resident
+    training scheduler lease/release concurrently.  The ledger applies
+    ``HEADROOM`` ONCE to the device limit; planners handed a ledger use
+    ``available_bytes()`` directly (already post-HEADROOM), so the slack
+    is never double-charged.
+    """
+
+    def __init__(self, limit_bytes: Optional[int] = None):
+        if limit_bytes is not None:
+            limit, source = max(int(limit_bytes), 1), "caller"
+        else:
+            limit, source = hbm_limit_bytes()
+        self.limit_bytes = limit
+        self.limit_source = source
+        self.budget_bytes = int(limit * HEADROOM)
+        self._lock = threading.Lock()
+        self._leases = {}       # guarded-by: _lock
+        self._next_id = 1       # guarded-by: _lock
+
+    # -- accounting --------------------------------------------------
+
+    def leased_bytes(self, plane: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(l.nbytes for l in self._leases.values()
+                       if plane is None or l.plane == plane)
+
+    def available_bytes(self) -> int:
+        """Remaining post-HEADROOM budget — what a co-resident planner
+        may claim without over-committing the device."""
+        return max(self.budget_bytes - self.leased_bytes(), 0)
+
+    def train_limit_bytes(self, lease: Optional[Lease] = None) -> int:
+        """The remainder expressed as a LIMIT (pre-HEADROOM), for code
+        paths that re-apply HEADROOM themselves (``LGBM_TPU_HBM_BYTES``
+        consumers).  Int-floored so re-applying HEADROOM lands <= the
+        actual remainder.  ``lease`` adds a held training lease back in:
+        the training plane's envelope is its own lease plus the slack."""
+        grant = self.available_bytes()
+        if lease is not None:
+            with self._lock:
+                if lease.lease_id in self._leases:
+                    grant += lease.nbytes
+        return max(int(grant / HEADROOM), 1)
+
+    def table(self) -> list:
+        """The lease table, JSON-friendly (flight bundles / doctor
+        evidence / LedgerError messages)."""
+        with self._lock:
+            leases = sorted(self._leases.values())
+        return [{"lease_id": l.lease_id, "owner": l.owner,
+                 "plane": l.plane, "bytes": l.nbytes,
+                 "preemptible": l.preemptible} for l in leases]
+
+    def summary(self) -> dict:
+        """JSON-friendly totals for journals / telemetry."""
+        with self._lock:
+            leased = sum(l.nbytes for l in self._leases.values())
+            by_plane: dict = {}
+            for l in self._leases.values():
+                by_plane[l.plane] = by_plane.get(l.plane, 0) + l.nbytes
+            count = len(self._leases)
+        return {"limit_bytes": self.limit_bytes,
+                "limit_source": self.limit_source,
+                "budget_bytes": self.budget_bytes,
+                "leased_bytes": leased,
+                "available_bytes": max(self.budget_bytes - leased, 0),
+                "num_leases": count,
+                "leased_by_plane": by_plane}
+
+    # -- lease lifecycle ---------------------------------------------
+
+    def lease(self, owner: str, nbytes: int, plane: str = "train",
+              preemptible: bool = False) -> Lease:
+        """Admit a residency claim or raise ``LedgerError`` loudly."""
+        need = max(int(nbytes), 0)
+        with self._lock:
+            leased = sum(l.nbytes for l in self._leases.values())
+            if leased + need > self.budget_bytes:
+                denied = True
+                granted = None
+            else:
+                denied = False
+                granted = Lease(self._next_id, str(owner), str(plane),
+                                need, bool(preemptible))
+                self._leases[granted.lease_id] = granted
+                self._next_id += 1
+        if denied:
+            self._emit("deny", owner=str(owner), plane=str(plane),
+                       bytes=need)
+            raise LedgerError(
+                f"residency ledger: lease '{owner}' ({plane}) wants "
+                f"{need} bytes but only {self.available_bytes()} of the "
+                f"{self.budget_bytes}-byte budget remain "
+                f"(limit {self.limit_bytes}, source "
+                f"{self.limit_source}); held leases: {self.table()}")
+        self._emit("lease", owner=granted.owner, plane=granted.plane,
+                   bytes=granted.nbytes, lease_id=granted.lease_id)
+        return granted
+
+    def try_lease(self, owner: str, nbytes: int, plane: str = "train",
+                  preemptible: bool = False) -> Optional[Lease]:
+        """``lease`` that returns None instead of raising."""
+        try:
+            return self.lease(owner, nbytes, plane, preemptible)
+        except LedgerError:
+            return None
+
+    def release(self, lease) -> None:
+        """Return a lease's bytes to the budget (idempotent)."""
+        lid = getattr(lease, "lease_id", lease)
+        with self._lock:
+            gone = self._leases.pop(lid, None)
+        if gone is not None:
+            self._emit("release", owner=gone.owner, plane=gone.plane,
+                       bytes=gone.nbytes, lease_id=gone.lease_id)
+
+    def preempt(self, plane: str = "train") -> int:
+        """Evict every preemptible lease of ``plane``; returns the bytes
+        freed.  The co-resident scheduler marks training leases
+        preemptible, so a serving-side replan under pressure preempts
+        training residency — never the other way around (degrade tile
+        before degrading serving residency)."""
+        with self._lock:
+            victims = [l for l in self._leases.values()
+                       if l.plane == plane and l.preemptible]
+            for v in victims:
+                del self._leases[v.lease_id]
+        freed = sum(v.nbytes for v in victims)
+        if victims:
+            self._emit("preempt", plane=plane, freed_bytes=freed,
+                       victims=[v.owner for v in victims])
+        return freed
+
+    @contextmanager
+    def train_env(self, lease: Optional[Lease] = None):
+        """Pin ``LGBM_TPU_HBM_BYTES`` to the training plane's envelope
+        so every planner reached INSIDE ``engine.train`` (hist, stream,
+        model-batch) plans against remaining-HBM-plus-own-lease instead
+        of the whole device."""
+        key = "LGBM_TPU_HBM_BYTES"
+        prev = os.environ.get(key)
+        os.environ[key] = str(self.train_limit_bytes(lease))
+        try:
+            yield self
+        finally:
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+
+    # -- telemetry ---------------------------------------------------
+
+    def _emit(self, event: str, **extra) -> None:
+        s = self.summary()
+        from ..obs.trace import instant
+        instant("planner.ledger", event=event, **extra, **s)
+        from ..obs.metrics import global_registry
+        global_registry.gauge("ledger_budget_bytes").set(s["budget_bytes"])
+        global_registry.gauge("ledger_available_bytes").set(
+            s["available_bytes"])
+        for plane in ("serving", "train"):
+            global_registry.gauge(
+                "ledger_leased_bytes", labels={"plane": plane}).set(
+                    s["leased_by_plane"].get(plane, 0))
+
+
+# the process's co-residency ledger, when a coresident.Scheduler (or an
+# operator) installed one — the diagnose layer reads it for the
+# contention verdict's lease-table evidence
+_active_ledger: Optional[ResidencyLedger] = None
+_active_ledger_lock = threading.Lock()
+
+
+def set_active_ledger(ledger: Optional[ResidencyLedger]):
+    """Install ``ledger`` as the process's co-residency ledger; returns
+    the previous one (restore it when tearing down a scheduler)."""
+    global _active_ledger
+    with _active_ledger_lock:
+        prev = _active_ledger
+        _active_ledger = ledger
+    return prev
+
+
+def active_ledger() -> Optional[ResidencyLedger]:
+    return _active_ledger

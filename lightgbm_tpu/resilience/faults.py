@@ -64,8 +64,12 @@ SERVING_KINDS = ("delay", "nan", "error")
 # fast with DeviceLost).  ``rank=`` selects the device id; the 0-based
 # op index counts batch executions on that device.  wedge/vanish are
 # PERSISTENT: once fired the device stays down until the registry is
-# discarded — a replan, not a retry, is the recovery path.
-DEVICE_KINDS = ("wedge", "error", "vanish")
+# discarded — a replan, not a retry, is the recovery path.  ``delay``
+# sleeps arg/sec seconds (default 0.05) before the batch executes and
+# then SUCCEEDS — the latency-inflation shape (a contended device under
+# co-resident training) that brownout controllers must catch without a
+# single typed failure.
+DEVICE_KINDS = ("wedge", "error", "vanish", "delay")
 
 
 class FaultInjected(OSError):
@@ -280,6 +284,10 @@ class ChaosRegistry:
                 if s.kind in ("wedge", "vanish"):
                     with self._lock:
                         self._downed[did] = s.kind
+                elif s.kind == "delay":
+                    # latency inflation, not failure: the batch still
+                    # succeeds after the stall (brownout-detection shape)
+                    time.sleep(s.arg if s.arg else 0.05)
                 elif s.kind == "error":
                     raise FaultInjected(
                         errno.EIO,

@@ -153,6 +153,16 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "opt-in HTTP metrics port ('0' = ephemeral)", _OBS),
     _f("LIGHTGBM_TPU_METRICS_HOST", "127.0.0.1", "obs/http.py",
        "bind host for the HTTP metrics endpoint", _OBS),
+    # ------------------------------------------------- co-resident train+serve
+    _f("LGBM_TPU_CORESIDENT_CHUNK_CAP", "", "coresident/scheduler.py",
+       "macro-chunk cap ceiling for co-resident refreshes (default: the "
+       "LGBM_TPU_CHUNK cap)", _PERF),
+    _f("LGBM_TPU_CORESIDENT_THROTTLE_S", "0.02", "coresident/scheduler.py",
+       "host-side yield per engine consult while brownout-throttled "
+       "(seconds)", _PERF),
+    _f("LGBM_TPU_CORESIDENT_RECOVERY_S", "1.0", "coresident/scheduler.py",
+       "quiet time after the last breach ping before throttled/paused "
+       "training resumes at full cap (seconds)", _PERF),
     # ------------------------------------------------------ bench workload
     _f("BENCH_ROWS", "11000000", "bench.py",
        "full-stage training rows", _PERF),
@@ -225,6 +235,8 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "'1' skips the resilience stage", _PERF),
     _f("BENCH_SKIP_LIFECYCLE", "", "bench.py",
        "'1' skips the model-lifecycle stage", _PERF),
+    _f("BENCH_SKIP_CORESIDENT", "", "bench.py",
+       "'1' skips the co-resident train+serve stage", _PERF),
     _f("BENCH_SKIP_OBS", "", "bench.py",
        "'1' skips obs_dump/obs_doctor stages + the measured-MFU table",
        _OBS),

@@ -1,0 +1,652 @@
+"""Co-resident train+serve (lightgbm_tpu/coresident/ +
+ops/planner.py ResidencyLedger): one pod, whole lifecycle.
+
+The load-bearing claims:
+
+* every planner entry point leases from ONE per-device budget, so the
+  combined train+serve peak never exceeds it, tile size degrades before
+  serving residency, and an infeasible co-residency is a LOUD verdict
+  (``CoresidencyInfeasible`` carrying the lease table), never an OOM;
+* the engine's ``pause_control`` seam evicts full training state to a
+  checkpoint bundle and the paused+resumed refresh produces a model
+  BYTE-identical to the uninterrupted one;
+* brownout breaches throttle, then pause, then resume training through
+  the watchdog's windowed-p99 breach stream — and a refresh paused by
+  brownout does not storm the ``freshness:`` SLO (single rising-edge
+  dump, monotonic age gauge);
+* losing a device mid-co-residency drains the serving replicas AND
+  shrinks the training world in one coordinated replan, with a
+  ``coresident:device_lost`` flight bundle naming both planes.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.coresident import (CoresidencyInfeasible,
+                                     CoresidentConfig, PauseControl,
+                                     Scheduler)
+from lightgbm_tpu.engine import TrainingPaused
+from lightgbm_tpu.fleet import PodFleet
+from lightgbm_tpu.obs.flight import global_flight
+from lightgbm_tpu.obs.metrics import MetricsRegistry, global_registry
+from lightgbm_tpu.obs.watchdog import Watchdog, global_watchdog
+from lightgbm_tpu.ops.planner import (HEADROOM, FleetModelShape,
+                                      LedgerError, ResidencyLedger,
+                                      active_ledger, plan_fleet,
+                                      plan_histograms, set_active_ledger)
+from lightgbm_tpu.resilience.faults import ChaosRegistry, FaultSpec
+
+pytestmark = pytest.mark.coresident
+
+F = 8
+
+
+@pytest.fixture(autouse=True)
+def _flight_tmp(tmp_path, monkeypatch):
+    """Own flight dir + fresh dump budget per test (breach dumps are the
+    point here; the process cap must not starve later tests)."""
+    monkeypatch.setattr(global_flight, "_out_dir", str(tmp_path))
+    monkeypatch.setattr(global_flight, "dumps", 0)
+    monkeypatch.setattr(global_flight, "max_dumps", 1 << 20)
+    yield
+
+
+def _data(seed, n, f=F):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    return X, y
+
+
+def _dumps(sub=""):
+    # dump filenames sanitize the trigger (":" -> "_"), match likewise
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in sub)
+    try:
+        return [d for d in os.listdir(global_flight.out_dir())
+                if d.startswith("flight_") and safe in d]
+    except OSError:
+        return []
+
+
+PARAMS = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+
+
+# ======================================================== ResidencyLedger
+
+
+def test_ledger_lease_release_accounting():
+    lg = ResidencyLedger(limit_bytes=1_000_000)
+    assert lg.budget_bytes == int(1_000_000 * HEADROOM)
+    assert lg.available_bytes() == lg.budget_bytes
+    a = lg.lease("serve:m", 300_000, plane="serving")
+    b = lg.lease("refresh:m", 200_000, plane="train", preemptible=True)
+    assert lg.leased_bytes() == 500_000
+    assert lg.leased_bytes(plane="serving") == 300_000
+    assert lg.available_bytes() == lg.budget_bytes - 500_000
+    s = lg.summary()
+    assert s["num_leases"] == 2
+    assert s["leased_by_plane"] == {"serving": 300_000, "train": 200_000}
+    lg.release(b)
+    lg.release(b)                       # idempotent
+    assert lg.leased_bytes() == 300_000
+    lg.release(a.lease_id)              # release by id too
+    assert lg.leased_bytes() == 0
+    assert not lg.table()
+
+
+def test_ledger_denial_is_loud_with_lease_table():
+    lg = ResidencyLedger(limit_bytes=1_000_000)
+    lg.lease("serve:hot", 700_000, plane="serving")
+    with pytest.raises(LedgerError) as ei:
+        lg.lease("refresh:big", 500_000, plane="train")
+    msg = str(ei.value)
+    assert "serve:hot" in msg           # the lease table names the holder
+    assert "refresh:big" in msg
+    assert lg.try_lease("refresh:big", 500_000, plane="train") is None
+    # the denial did not corrupt accounting
+    assert lg.leased_bytes() == 700_000
+
+
+def test_ledger_preempt_evicts_only_preemptible():
+    lg = ResidencyLedger(limit_bytes=1_000_000)
+    lg.lease("serve:m", 300_000, plane="serving", preemptible=False)
+    lg.lease("refresh:a", 100_000, plane="train", preemptible=True)
+    lg.lease("refresh:b", 150_000, plane="train", preemptible=True)
+    freed = lg.preempt(plane="train")
+    assert freed == 250_000
+    assert lg.leased_bytes() == 300_000
+    assert [e["owner"] for e in lg.table()] == ["serve:m"]
+
+
+def test_ledger_gauges_published():
+    lg = ResidencyLedger(limit_bytes=2_000_000)
+    lease = lg.lease("serve:m", 500_000, plane="serving")
+    g = global_registry.to_dict()["gauges"]
+    assert g["ledger_budget_bytes"] == lg.budget_bytes
+    assert g["ledger_available_bytes"] == lg.available_bytes()
+    leased = [v for k, v in g.items()
+              if k.startswith("ledger_leased_bytes") and "serving" in k]
+    assert leased and leased[0] == 500_000
+    lg.release(lease)
+
+
+def test_active_ledger_registration():
+    prev = set_active_ledger(None)
+    try:
+        lg = ResidencyLedger(limit_bytes=1 << 20)
+        assert set_active_ledger(lg) is None
+        assert active_ledger() is lg
+    finally:
+        set_active_ledger(prev)
+
+
+# =============================================== planners lease the budget
+
+
+def test_plan_histograms_respects_ledger_remainder():
+    limit = 64 << 20
+    solo = plan_histograms(rows=200_000, features=28, num_bins=64,
+                           num_leaves=255, budget_bytes=limit)
+    assert solo.feasible
+    lg = ResidencyLedger(limit_bytes=limit)
+    lg.lease("serve:m", int(lg.budget_bytes * 0.7), plane="serving")
+    co = plan_histograms(rows=200_000, features=28, num_bins=64,
+                         num_leaves=255, ledger=lg)
+    # combined peak stays inside the ONE budget: the plan fits what the
+    # serving residency left over, degrading tile size — not serving
+    assert co.limit_source == "ledger"
+    if co.feasible:
+        assert co.predicted_peak_bytes <= lg.available_bytes()
+        assert co.predicted_peak_bytes + lg.leased_bytes() <= lg.budget_bytes
+        assert co.tile_rows <= (solo.tile_rows or 200_000)
+    # a ledger with nothing leased plans like the solo fake-budget path
+    free = plan_histograms(rows=200_000, features=28, num_bins=64,
+                           num_leaves=255,
+                           ledger=ResidencyLedger(limit_bytes=limit))
+    assert free.feasible
+    assert free.tile_rows == solo.tile_rows
+
+
+def test_plan_histograms_ledger_infeasible_is_verdict_not_oom():
+    lg = ResidencyLedger(limit_bytes=4 << 20)
+    lg.lease("serve:m", lg.budget_bytes - 1024, plane="serving")
+    plan = plan_histograms(rows=5_000_000, features=28, num_bins=64,
+                           num_leaves=255, ledger=lg)
+    assert not plan.feasible            # refused, loudly — nothing raised
+
+
+def test_plan_fleet_respects_ledger_remainder():
+    limit = 32 << 20
+    shapes = [FleetModelShape("hot", 400, 255, 256, F, buckets=(8, 64),
+                              weight=4.0),
+              FleetModelShape("cold", 400, 255, 256, F, buckets=(8, 64),
+                              weight=0.1, age_s=500.0)]
+    solo = plan_fleet(shapes, budget_bytes=limit)
+    lg = ResidencyLedger(limit_bytes=limit)
+    lg.lease("refresh:m", int(lg.budget_bytes * 0.9), plane="train")
+    co = plan_fleet(shapes, ledger=lg)
+    assert co.total_resident_bytes <= lg.available_bytes()
+    assert co.total_resident_bytes <= solo.total_resident_bytes
+    # training holding most of the device demotes residency, never serving
+    assert len([m for m in co.models if m.resident]) <= \
+        len([m for m in solo.models if m.resident])
+
+
+def test_plan_topology_with_per_device_ledgers():
+    from lightgbm_tpu.fleet.topology import plan_devices, plan_topology
+    devices = plan_devices(2, budget_bytes_per_device=32 << 20)
+    shapes = [FleetModelShape("m", 200, 63, 64, F, buckets=(8,))]
+    lg = ResidencyLedger(limit_bytes=32 << 20)
+    lg.lease("refresh:m", int(lg.budget_bytes * 0.95), plane="train")
+    topo = plan_topology(shapes, devices, ledgers={0: lg})
+    # device 0 plans against the ledger remainder; device 1 is untouched
+    assert topo.device_plans[0].total_resident_bytes <= \
+        lg.available_bytes()
+    assert topo.device_plans[1].total_resident_bytes >= \
+        topo.device_plans[0].total_resident_bytes
+
+
+# ================================================= engine pause seam
+
+
+class _PauseAt:
+    """Duck-typed pause_control: run at chunk cap 1, pause at iteration
+    ``at`` (None = never)."""
+
+    def __init__(self, at):
+        self.at = at
+        self.consults = 0
+
+    def consult(self, i):
+        self.consults += 1
+        return "pause" if self.at is not None and i >= self.at else "run"
+
+    def chunk_cap(self):
+        return 1
+
+
+def test_pause_resume_bit_parity(tmp_path):
+    X, y = _data(0, 1200)
+    params = dict(PARAMS)
+
+    ref = lgb.train(params, lgb.Dataset(X, label=y, free_raw_data=False),
+                    10, verbose_eval=False)
+
+    snap = str(tmp_path / "paused.txt")
+    with pytest.raises(TrainingPaused) as ei:
+        lgb.train(params, lgb.Dataset(X, label=y, free_raw_data=False),
+                  10, verbose_eval=False, snapshot_out=snap,
+                  pause_control=_PauseAt(4))
+    assert ei.value.iteration == 4
+    assert os.path.exists(ei.value.bundle_path)
+
+    resumed = lgb.train(params,
+                        lgb.Dataset(X, label=y, free_raw_data=False),
+                        10, verbose_eval=False, snapshot_out=snap,
+                        resume_from=ei.value.bundle_path,
+                        pause_control=_PauseAt(None))
+    assert resumed.current_iteration() == 10
+    assert resumed.model_to_string() == ref.model_to_string()
+
+
+def test_pause_is_not_a_failure_dump(tmp_path):
+    X, y = _data(1, 800)
+    snap_dir = tmp_path / "snap"       # keep bundles out of the flight dir
+    snap_dir.mkdir()
+    before = set(_dumps())
+    with pytest.raises(TrainingPaused):
+        lgb.train(dict(PARAMS),
+                  lgb.Dataset(X, label=y, free_raw_data=False), 8,
+                  verbose_eval=False,
+                  snapshot_out=str(snap_dir / "p.txt"),
+                  pause_control=_PauseAt(2))
+    # an ordered yield must not produce a forensic exception bundle
+    assert set(_dumps()) == before
+
+
+def test_pause_control_throttle_halves_chunk_cap():
+    pc = PauseControl(base_chunk_cap=16, throttle_delay_s=0.0)
+    assert pc.chunk_cap() == 16
+    assert pc.request_throttle()
+    assert pc.state == PauseControl.THROTTLE
+    assert pc.chunk_cap() == 8
+    assert not pc.request_throttle()            # already throttled
+    assert pc.request_pause()
+    assert pc.consult(0) == "pause"
+    assert not pc.request_throttle()            # pause never downgrades
+    assert pc.request_run()
+    assert pc.consult(1) == "run"
+    assert pc.consults == 2
+
+
+# ====================================== watchdog: windowed p99 + listeners
+
+
+def test_windowed_p99_clears_after_brownout():
+    wd = Watchdog()
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    wd.watch_histogram_p99("w", h, ceiling_ms=10.0, windowed=True)
+    assert wd.check_once() == []                # arming sweep
+    for _ in range(50):
+        h.observe(200.0)
+    assert any(s == "slo:w" for s, _ in wd.check_once())
+    assert "slo:w" in wd.active_breaches()
+    for _ in range(200):
+        h.observe(1.0)                          # traffic recovered
+    assert wd.check_once() == []
+    assert "slo:w" not in wd.active_breaches()  # cumulative would stick
+
+
+def test_breach_listeners_fire_on_every_occurrence():
+    wd = Watchdog()
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    calls = []
+    wd.add_breach_listener(lambda slo, ev, rising: calls.append(
+        (slo, rising)))
+    wd.watch_histogram_p99("w", h, ceiling_ms=10.0, windowed=True)
+    wd.check_once()
+    for sweep in range(3):
+        for _ in range(30):
+            h.observe(100.0)
+        wd.check_once()
+    assert [c for c in calls if c[0] == "slo:w"] == [
+        ("slo:w", True), ("slo:w", False), ("slo:w", False)]
+    wd.remove_breach_listener
+    # the persistent breach dumped ONE rising-edge bundle, not three
+    assert len(_dumps("slo:w")) == 1
+
+
+# ============================================== scheduler brownout machine
+
+
+def test_scheduler_brownout_throttle_pause_recover():
+    wd = Watchdog()
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    cfg = CoresidentConfig(brownout_fraction=0.6, escalate_s=0.05,
+                           recovery_s=0.05, poll_interval_s=0.01)
+    sched = Scheduler(ledger=ResidencyLedger(limit_bytes=1 << 30),
+                      config=cfg, watchdog=wd)
+    try:
+        wname = sched.guard_latency("m", h, slo_ms=100.0)
+        assert wname == "coresident:m"
+        # ceiling = 0.6 * SLO: throttling engages BEFORE the real SLO
+        assert wd._hists[wname][1] == pytest.approx(60.0)
+        wd.check_once()                          # arm the window
+        for _ in range(30):
+            h.observe(80.0)                      # > brownout, < SLO
+        wd.check_once()
+        assert sched.control.state == PauseControl.THROTTLE
+        assert sched.stats()["throttles"] == 1
+        time.sleep(0.06)                         # past escalate_s
+        for _ in range(30):
+            h.observe(80.0)
+        wd.check_once()
+        assert sched.control.state == PauseControl.PAUSE
+        assert sched.stats()["pauses"] == 1
+        time.sleep(0.06)                         # quiet past recovery_s
+        sched._tick()
+        assert sched.control.state == PauseControl.RUN
+    finally:
+        sched.close()
+    assert wname not in wd._hists               # close unhooks the guard
+
+
+def test_scheduler_negotiated_chunk_cap_shrinks_with_pressure():
+    wd = Watchdog()
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    cfg = CoresidentConfig(chunk_cap=32, brownout_p99_ms=100.0)
+    sched = Scheduler(ledger=ResidencyLedger(limit_bytes=1 << 30),
+                      config=cfg, watchdog=wd)
+    try:
+        sched.guard_latency("m", h)
+        assert sched.negotiate_chunk_cap() == 32     # no data: full cap
+        for _ in range(100):
+            h.observe(90.0)                          # ~90% of ceiling
+        cap = sched.negotiate_chunk_cap()
+        assert 1 <= cap <= 4                         # pow2, deep shrink
+        assert (cap & (cap - 1)) == 0
+    finally:
+        sched.close()
+
+
+def test_scheduler_refresh_infeasible_is_loud(tmp_path):
+    X, y = _data(2, 400)
+    lg = ResidencyLedger(limit_bytes=1 << 20)
+    lg.lease("serve:m", lg.budget_bytes - 512, plane="serving")
+    sched = Scheduler(ledger=lg, watchdog=Watchdog(),
+                      workdir=str(tmp_path))
+    try:
+        with pytest.raises(CoresidencyInfeasible) as ei:
+            sched.refresh("m", lgb.Dataset(X, label=y,
+                                           free_raw_data=False),
+                          PARAMS, 4)
+        assert "serve:m" in str(ei.value)       # lease table in the verdict
+    finally:
+        sched.close()
+    assert lg.leased_bytes(plane="train") == 0  # nothing leaked
+
+
+def test_scheduler_refresh_trains_and_marks_fresh(tmp_path):
+    X, y = _data(3, 1200)
+    wd = Watchdog()
+    sched = Scheduler(ledger=ResidencyLedger(limit_bytes=1 << 30),
+                      watchdog=wd, workdir=str(tmp_path))
+    try:
+        wd.watch_freshness("m")
+        booster, stats = sched.refresh(
+            "m", lgb.Dataset(X, label=y, free_raw_data=False), PARAMS, 6)
+        assert booster.current_iteration() == 6
+        assert stats["pauses"] == 0
+        age = wd.model_age_s("m")
+        assert age is not None and age < 60.0
+        # the training lease was released at completion
+        assert sched.ledger.leased_bytes() == 0
+    finally:
+        sched.close()
+
+
+def test_scheduler_refresh_rides_out_pause_byte_identical(tmp_path):
+    X, y = _data(4, 1200)
+    ref = lgb.train(dict(PARAMS),
+                    lgb.Dataset(X, label=y, free_raw_data=False), 8,
+                    verbose_eval=False)
+    wd = Watchdog()
+    cfg = CoresidentConfig(recovery_s=0.05, poll_interval_s=0.01,
+                           max_pause_s=30.0, chunk_cap=1)
+    sched = Scheduler(ledger=ResidencyLedger(limit_bytes=1 << 30),
+                      config=cfg, watchdog=wd, workdir=str(tmp_path))
+    fired = threading.Event()
+    orig_consult = sched.control.consult
+
+    def pausing_consult(i):
+        if i >= 3 and not fired.is_set():
+            fired.set()
+            sched.control.request_pause()       # brownout strikes once
+        return orig_consult(i)
+
+    sched.control.consult = pausing_consult
+
+    def unpause():
+        fired.wait(timeout=30)
+        time.sleep(0.05)
+        sched.control.request_run()
+
+    t = threading.Thread(target=unpause)
+    t.start()
+    try:
+        booster, stats = sched.refresh(
+            "m", lgb.Dataset(X, label=y, free_raw_data=False), PARAMS, 8)
+    finally:
+        t.join()
+        sched.close()
+    assert stats["pauses"] >= 1
+    assert booster.model_to_string() == ref.model_to_string()
+
+
+def test_paused_refresh_no_freshness_breach_storm():
+    wd = Watchdog()
+    wd.watch_freshness("fr", max_age_s=0.05)
+    wd.mark_fresh("fr")
+    time.sleep(0.08)                    # the refresh is paused: age grows
+    ages = []
+    for _ in range(4):
+        wd.check_once()
+        ages.append(global_registry.gauge(
+            "model_age_seconds", labels={"model": "fr"}).value)
+        time.sleep(0.02)
+    # one rising-edge dump despite four breaching sweeps — no storm
+    assert len(_dumps("freshness:fr")) == 1
+    assert ages == sorted(ages)         # age is monotonic across the pause
+    wd.mark_fresh("fr")                 # the resumed refresh completed
+    wd.check_once()
+    assert "freshness:fr" not in wd.active_breaches()
+    assert global_registry.gauge(
+        "model_age_seconds", labels={"model": "fr"}).value < ages[0]
+
+
+# ==================================================== dual-plane device loss
+
+
+@pytest.mark.chaos
+def test_device_loss_replans_both_planes(tmp_path, monkeypatch):
+    # the replan's apply_world mutates these OUTSIDE monkeypatch's
+    # bookkeeping (delenv on an absent var records nothing) — pin them
+    # so the shrunk world cannot leak into later tests
+    for k in ("LGBM_TPU_NUM_SLICES", "LGBM_TPU_SLICE_DEVICES"):
+        monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv(k, "")
+        monkeypatch.delenv(k)
+    X, y = _data(5, 1500)
+    deployed = lgb.train(dict(PARAMS),
+                         lgb.Dataset(X, label=y, free_raw_data=False), 4,
+                         verbose_eval=False)
+    fleet = PodFleet(devices=2, max_batch_rows=128)
+    fleet.add_model("live", deployed)
+    fleet.warm()
+    wd = Watchdog()
+    cfg = CoresidentConfig(recovery_s=0.05, poll_interval_s=0.01,
+                           chunk_cap=1, max_pause_s=30.0)
+    sched = Scheduler(fleet=fleet, ledger=ResidencyLedger(
+        limit_bytes=1 << 30), config=cfg, watchdog=wd,
+        world={"num_slices": 2, "devices_per_slice": 1},
+        workdir=str(tmp_path))
+    result = {}
+
+    def run_refresh():
+        result["out"] = sched.refresh(
+            "live", lgb.Dataset(X, label=y, free_raw_data=False),
+            PARAMS, 20, init_model=deployed, swap=True)
+
+    t = threading.Thread(target=run_refresh)
+    t.start()
+    try:
+        # wait until training is demonstrably mid-flight
+        deadline = time.monotonic() + 30
+        while sched.control.consults < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sched.control.consults >= 2
+        fleet.kill_device(0)            # ONE coordinated replan:
+        t.join(timeout=60)              # serving drains, training shrinks
+        assert not t.is_alive()
+        booster, stats = result["out"]
+        assert booster.current_iteration() == 24
+        # serving plane: survivor device serves the refreshed model
+        assert fleet.live_devices() == [1]
+        probe = X[:64]
+        served = fleet.predict("live", probe, timeout=120)
+        assert np.array_equal(served,
+                              booster.predict(probe, raw_score=True))
+        # training plane: the world shrank in the same replan
+        assert sched.world == {"num_slices": 1, "devices_per_slice": 1}
+        assert sched.stats()["device_losses"] == 1
+        # the flight bundle names BOTH planes' outcomes
+        bundles = _dumps("coresident:device_lost")
+        assert len(bundles) == 1
+        with open(os.path.join(global_flight.out_dir(), bundles[0])) as f:
+            blob = f.read()
+        assert "serving" in blob and "training" in blob
+    finally:
+        sched.close()
+        fleet.close()
+
+
+# =============================================== contention verdict + healthz
+
+
+def test_contention_verdict_from_brownout_counters():
+    from lightgbm_tpu.obs.diagnose import collect_signals, diagnose
+    reg = MetricsRegistry()
+    reg.counter("coresident_throttle_total").inc(3)
+    reg.counter("coresident_pause_total").inc(1)
+    reg.gauge("ledger_available_bytes").set(1000.0)
+    prev = set_active_ledger(None)
+    lg = ResidencyLedger(limit_bytes=1 << 20)
+    lease = lg.lease("serve:m", 1000, plane="serving")
+    set_active_ledger(lg)
+    try:
+        sig = collect_signals(registry=reg)
+        assert sig["coresident_throttle_total"] == 3
+        assert sig["coresident_pause_total"] == 1
+        assert sig["ledger_lease_table"][0]["owner"] == "serve:m"
+        verdicts = diagnose(sig)
+        names = [v.name for v in verdicts]
+        assert "contention" in names
+        v = verdicts[names.index("contention")]
+        assert v.evidence["coresident_throttle_total"] == 3
+        assert v.evidence["ledger_lease_table"][0]["owner"] == "serve:m"
+        assert 0.4 <= v.score <= 0.9
+    finally:
+        lg.release(lease)
+        set_active_ledger(prev)
+
+
+def test_no_contention_verdict_without_events():
+    from lightgbm_tpu.obs.diagnose import collect_signals, diagnose
+    sig = collect_signals(registry=MetricsRegistry())
+    assert "contention" not in [v.name for v in diagnose(sig)]
+
+
+@pytest.mark.obs
+def test_healthz_degrades_on_active_breach():
+    from lightgbm_tpu.obs.http import MetricsHTTPServer
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    srv = MetricsHTTPServer(registry=reg, port=0)
+    try:
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        assert urllib.request.urlopen(
+            f"{base}/healthz", timeout=5).read() == b"ok\n"
+        h.observe(500.0)
+        global_watchdog.watch_histogram_p99("hz_probe", h, ceiling_ms=1.0)
+        global_watchdog.check_once()
+        assert "slo:hz_probe" in global_watchdog.active_breaches()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=5)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["status"] == "degraded"
+        assert "slo:hz_probe" in body["breaches"]
+        global_watchdog.unwatch_histogram("hz_probe")
+        assert urllib.request.urlopen(
+            f"{base}/healthz", timeout=5).read() == b"ok\n"
+    finally:
+        global_watchdog.unwatch_histogram("hz_probe")
+        srv.stop()
+
+
+# =========================================================== chaos delay
+
+
+@pytest.mark.chaos
+def test_device_delay_inflates_latency_without_failures():
+    X, y = _data(6, 800)
+    deployed = lgb.train(dict(PARAMS),
+                         lgb.Dataset(X, label=y, free_raw_data=False), 4,
+                         verbose_eval=False)
+    chaos = ChaosRegistry([FaultSpec(site="device", kind="delay", at=i,
+                                     arg=0.05) for i in range(2, 6)])
+    fleet = PodFleet(devices=1, chaos=chaos, max_batch_rows=64)
+    fleet.add_model("live", deployed)
+    fleet.warm()
+    try:
+        lats = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            fleet.predict("live", X[:16], timeout=60)
+            lats.append(time.perf_counter() - t0)
+        assert max(lats) >= 0.05            # the stall is visible...
+        assert any("delay" in line for line in chaos.log)
+    finally:
+        fleet.close()                       # ...and nothing failed
+
+
+# ====================================================== smoke tool (slow)
+
+
+@pytest.mark.slow
+def test_coresident_smoke_tool(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    from coresident_smoke import run_smoke
+    summary = run_smoke(rows=2000, trees=6, refresh_trees=4, requests=60,
+                        directory=str(tmp_path))
+    assert not summary["failed"], json.dumps(summary["phase_ok"])
+    assert all(summary["phase_ok"].values())
+    co = summary["phases"]["coresidency"]
+    assert not co["untyped_failures"]
+    assert co["throttles"] > 0
+    assert co["served_bit_equal_refreshed"]

@@ -7,14 +7,16 @@ Joins measured signals (devprof MFU tables, compile-cache warmth,
 stream-probe overlap efficiency, straggler skew) with
 planner-predicted ones (per-tier ICI/DCN payload bytes, link models)
 and prints RANKED verdicts — "DCN-bound", "compile-bound",
-"input-bound", "straggler slice k", "kernel-underutilized" — each with
-the evidence behind it.  The LAST stdout line is one JSON summary (the
+"input-bound", "straggler slice k", "contention" (co-resident train vs
+serve fighting over the same devices; evidence carries the residency
+ledger's lease table + brownout throttle/pause counts), and
+"kernel-underutilized" — each with the evidence behind it.  The LAST stdout line is one JSON summary (the
 shape the bench journals as the ``obs_doctor`` stage).
 
 Usage:
     python tools/obs_doctor.py \
         [--journal bench_journal.json]   # banked bench stages
-        [--metrics bench_obs_metrics.json]  # registry snapshot file
+        [--metrics bench_out/bench_obs_metrics.json]  # registry snapshot
         [--json-only]                    # machine consumers
 Exit codes: 0 = diagnosed (whatever the verdict), 2 = input unreadable.
 """
@@ -83,7 +85,8 @@ def main():
                         "BENCH_JOURNAL",
                         os.path.join(REPO, "bench_journal.json")))
     ap.add_argument("--metrics",
-                    default=os.path.join(REPO, "bench_obs_metrics.json"))
+                    default=os.path.join(REPO, "bench_out",
+                                         "bench_obs_metrics.json"))
     ap.add_argument("--json-only", action="store_true")
     args = ap.parse_args()
     try:
