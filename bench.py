@@ -468,14 +468,18 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
             f"(untiled predicted {plan.untiled_peak_bytes / 1e9:.1f} GB "
             f"> budget {plan.budget_bytes / 1e9:.1f} GB)")
 
-    from lightgbm_tpu.utils.platform import (compile_cache_entries,
-                                             enable_compile_cache)
+    from lightgbm_tpu.utils.platform import (
+        compile_cache_entries, compile_cache_entries_by_family,
+        enable_compile_cache)
     # the reported dir must be the one the entries are counted in: with
     # LGBM_TPU_COMPILE_CACHE unset, the worker's JAX_COMPILATION_CACHE_DIR
-    # default is still an active cache
-    cache_dir = (enable_compile_cache()
+    # default is still an active cache.  family="train" scopes the
+    # warm-start verdict to TRAINING programs (JIT blobs only) — serving
+    # AOT exports in the same store no longer fake a warm training start
+    cache_dir = (enable_compile_cache(family="train")
                  or os.environ.get("JAX_COMPILATION_CACHE_DIR") or None)
     cache_before = compile_cache_entries(cache_dir)
+    cache_fam_before = compile_cache_entries_by_family()
 
     # structured tracing (lightgbm_tpu/obs/): with LIGHTGBM_TPU_TRACE set
     # the whole stage records phase spans (+ the engine/grower/serving
@@ -604,9 +608,15 @@ def run_bench(n, trees, leaves, max_bin, tag="", cancel=None,
         "compile_seconds": round(compile_seconds, 2),
         "compile_cache": {
             "dir": cache_dir,
+            # entries/warm_start are the TRAIN family's (the dir above is
+            # the family subdir); by_family breaks the whole store down
             "entries_before": cache_before,
             "entries_after": compile_cache_entries(cache_dir),
             "warm_start": bool(cache_dir) and cache_before > 0,
+            "entries_by_family_before": cache_fam_before,
+            "entries_by_family_after": compile_cache_entries_by_family(),
+            "warm_start_by_family": {
+                k: v > 0 for k, v in cache_fam_before.items()},
         },
         "bin_seconds": round(bin_seconds, 2),
         "holdout_auc": round(float(auc), 5),

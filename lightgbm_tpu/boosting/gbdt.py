@@ -178,12 +178,16 @@ class GBDT:
                             NamedSharding(self._mesh,
                                           P(self._feature_axis, None))))
         else:
-            key = ("serial",)
+            # n_pad keys the cache: the shape-bucket ladder can pad the
+            # serial row axis too (pads -> bin 0, masked everywhere)
+            key = ("serial", n_pad)
             self.binned = self._cached_device_binned(key)
             if self.binned is None:
+                src = self.train_set.host_binned()
+                if n_pad > n:
+                    src = np.pad(src, ((0, n_pad - n), (0, 0)))
                 self.binned = self._cache_device_binned(
-                    key, jnp.asarray(
-                        np.ascontiguousarray(self.train_set.host_binned().T)))
+                    key, jnp.asarray(np.ascontiguousarray(src.T)))
         self._row_valid = jnp.asarray(self._pad_rows_np(np.ones(n, np.float32)))
         if objective is not None:
             objective.init(self.train_set.metadata, self.num_data)
@@ -336,7 +340,19 @@ class GBDT:
         self._mesh = None
         self._data_axis = None
         self._feature_axis = None
-        self._n_pad = self.num_data
+        # shape-bucket ladder (ops/planner.py bucket_rows, docs/PERF.md):
+        # pad the row count up to the next ladder rung so nearby dataset
+        # sizes share ONE compiled training program (the jit caches key on
+        # n_pad).  Padded rows ride the existing machinery — row_mask 0,
+        # zero gradients, bagging always drops them — so trees are
+        # unchanged; integer (quantized) accumulation makes that exact,
+        # while f32 reduction trees can shift at ulp level, which is why
+        # the default is accelerator-only (LGBM_TPU_SHAPE_BUCKETS
+        # overrides either way).
+        from ..ops.planner import bucket_rows, shape_buckets_enabled
+        self._shape_buckets = shape_buckets_enabled()
+        self._n_pad = (bucket_rows(self.num_data) if self._shape_buckets
+                       else self.num_data)
         self._f_pad = self.train_set.binned_shape()[1]
         self._meta_dist = None
         self._row_perm = None      # [n_pad] padded-slot -> original row
@@ -390,10 +406,13 @@ class GBDT:
                 self._mesh = make_mesh(ndev, (DATA_AXIS,))
                 self._data_axis = DATA_AXIS
             if need_group:
-                # ranking: whole queries per shard (query-aligned layout)
+                # ranking: whole queries per shard (query-aligned layout;
+                # shape buckets don't apply — padding is query-driven)
                 self._build_query_sharding(ndev)
             else:
-                self._n_pad = pad_rows_to(self.num_data, ndev)
+                self._n_pad = pad_rows_to(
+                    bucket_rows(self.num_data) if self._shape_buckets
+                    else self.num_data, ndev)
         else:  # feature
             self._mesh = make_mesh(ndev, (FEATURE_AXIS,))
             self._feature_axis = FEATURE_AXIS
@@ -671,11 +690,18 @@ class GBDT:
         fused_ctx = (
             not cegb_enabled and vote_k == 0 and self._stream is None
             and self._feature_axis is None and forced_plan is None
-            and (self._mesh is None or self._data_axis is None)
-            and not self.config.monotone_constraints
             and not cc.extra_trees and bynode_cnt == 0
-            and not meta_fused.has_bundles
-            and not bool(meta_fused.is_categorical.any()))
+            and not meta_fused.has_bundles)
+        # categorical features, monotone constraints and data-parallel
+        # sharding all ride the fused arm now (the rounds grower's
+        # seam-split kernel + pick_fused_best's cat merge) — but the
+        # SERIAL grower only lifted monotone, so an explicit serial
+        # growth keeps its own narrower gate (grower.py applies it)
+        if self.config.tpu_tree_growth == "serial" \
+                and (bool(meta_fused.is_categorical.any())
+                     or (self._mesh is not None
+                         and self._data_axis is not None)):
+            fused_ctx = False
         want_fused = fused_ctx and (
             self.config.tpu_hist_method == "fused"
             or (self.config.tpu_hist_method == "auto" and on_accelerator()
@@ -696,11 +722,12 @@ class GBDT:
                 and not getattr(self, "_fused_warned", False):
             self._fused_warned = True
             log_warning(
-                "tpu_hist_method=fused applies to the numeric unsharded "
-                "case (no categorical features, EFB bundles, monotone "
-                "constraints, extra_trees, per-node column sampling, "
-                "CEGB, forced splits, or feature/voting sharding); "
-                "falling back to the staged kernel family")
+                "tpu_hist_method=fused does not apply to this "
+                "configuration (EFB bundles, extra_trees, per-node "
+                "column sampling, CEGB, forced splits, streaming, "
+                "feature/voting sharding — or categorical/data-parallel "
+                "under tpu_tree_growth=serial); falling back to the "
+                "staged kernel family")
         # resolve hist_method="auto" by MEASURING the kernel variants on
         # the live accelerator at the training shape (reference: the
         # GetShareStates col-vs-row timed probe, dataset.cpp:589-684);
@@ -827,6 +854,14 @@ class GBDT:
             int(self.hist_plan.predicted_peak_bytes))
         _obs_registry.gauge("train_hbm_budget_bytes").set(
             int(self.hist_plan.budget_bytes))
+        # shape-bucket ladder + autotune provenance: which rung the row
+        # axis landed on and whether the variant came from measurements
+        # (bench_diff gates election quality on these)
+        _obs_registry.gauge("train_rows_bucketed").set(int(self._n_pad))
+        _obs_registry.gauge("train_shape_buckets").set(
+            int(getattr(self, "_shape_buckets", False)))
+        _obs_registry.gauge("train_hist_elected_by").set(
+            self.hist_plan.elected_by)
         if nmach > 1:
             from ..ops.histogram import hist_payload_bytes
             _obs_registry.gauge("train_psum_payload_bytes").set(

@@ -27,6 +27,12 @@ drain).  Chunked training is bit-identical to per-iteration training —
 the scanned program composes the same ``iter_body`` — which
 tests/test_macro.py asserts byte-for-byte on saved model text.
 
+Compile-time note: every shape in the chunk program is keyed by
+``n_pad``, so with shape buckets on (``ops.planner.bucket_rows``;
+docs/PERF.md "shape buckets") nearby dataset sizes land on the same
+rung and REUSE one compiled chunk program instead of building a fresh
+one per exact row count.
+
 Memory: the chunk program composes ``iter_body`` over the booster's
 ``grower_cfg``, so the HBM budget plan (ops/planner.py ``tile_rows`` /
 ``hist_pack``, chosen at ``_build_jit_fns`` time with per-shard rows)

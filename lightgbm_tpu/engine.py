@@ -76,7 +76,7 @@ def train(params: dict, train_set: Dataset, num_boost_round: int = 100,
     full state and raises ``TrainingPaused`` — docs/PERF.md co-residency.
     """
     from .utils.platform import enable_compile_cache
-    enable_compile_cache()
+    enable_compile_cache(family="train")
     # active observability (docs/OBSERVABILITY.md): the env-gated SLO
     # sentry + metrics HTTP endpoint, and run context for any forensic
     # bundle this training might have to dump
@@ -604,7 +604,7 @@ def cv(params: dict, train_set: Dataset, num_boost_round: int = 100,
     fall back to serial stepping with a logged warning.
     """
     from .utils.platform import enable_compile_cache
-    enable_compile_cache()
+    enable_compile_cache(family="train")
     params = dict(params)
     if fobj is not None:
         # custom objective: no built-in objective, hence no default metric

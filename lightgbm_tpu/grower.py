@@ -648,14 +648,19 @@ def _grow_tree_traced(
     # smaller child's bins in VMEM, derives the sibling from the parent
     # arena in-kernel and scans both children's gains before writing
     # back only the smaller-child histogram (the subtraction cache's
-    # input) + [2, F] per-feature-best tuples.  Applies to the numeric
-    # common case; every other mode keeps the staged family (same
-    # trees: the scan is ops.split.numeric_feature_scan either way).
+    # input) + [2, F] per-feature-best tuples.  Monotone constraints
+    # ride into the in-kernel scan (the bound propagation is hoisted
+    # above the kernel call — it only needs the parent's cached sums);
+    # every other special mode keeps the staged family (same trees: the
+    # scan is ops.split.numeric_feature_scan either way).  The rounds
+    # grower additionally lifts the categorical and data-parallel gates
+    # (grower_rounds.py — the seam-split kernel); this serial arm exists
+    # for mode completeness and the parity suite.
     use_fused = (cfg.hist_method == "fused" and axis_name is None
                  and feature_axis_name is None and not voting
                  and not cegb_enabled and cfg.n_forced == 0
                  and not meta.has_bundles and not has_cat
-                 and monotone_constraints is None and not use_rng)
+                 and not use_rng)
     if use_fused:
         from .ops.fused import fused_frontier_splits, pick_fused_best
         from .ops.histogram import _vals_t, _vals_t_int
@@ -1162,6 +1167,34 @@ def _grow_tree_traced(
         leaf_sh = c.leaf_sh.at[leaf].set(lh).at[new_leaf].set(rh)
         leaf_cnt = c.leaf_cnt.at[leaf].set(lc).at[new_leaf].set(rc)
 
+        # -- monotone bound propagation (reference: UpdateConstraints,
+        # monotone_constraints.hpp:44 — children inherit the parent's
+        # bounds, and a numerical split on a constrained feature pins
+        # the midpoint of the clamped child outputs between them).
+        # Computed BEFORE the histogram section: it needs only the
+        # committed split's sums, and the fused megakernel's in-kernel
+        # scan consumes the children's bounds.
+        leaf_min, leaf_max = c.leaf_min, c.leaf_max
+        if use_mc:
+            p_min, p_max = leaf_min[leaf], leaf_max[leaf]
+            l_out = jnp.clip(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
+                                         hp.max_delta_step), p_min, p_max)
+            r_out = jnp.clip(leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
+                                         hp.max_delta_step), p_min, p_max)
+            mid = (l_out + r_out) * 0.5
+            mc_f = mc_full[feat]      # feat is a GLOBAL feature index
+            upd = (~ncat) & (mc_f != 0)
+            l_min = jnp.where(upd & (mc_f < 0), jnp.maximum(p_min, mid), p_min)
+            l_max = jnp.where(upd & (mc_f > 0), jnp.minimum(p_max, mid), p_max)
+            r_min = jnp.where(upd & (mc_f > 0), jnp.maximum(p_min, mid), p_min)
+            r_max = jnp.where(upd & (mc_f < 0), jnp.minimum(p_max, mid), p_max)
+            leaf_min = leaf_min.at[leaf].set(l_min).at[new_leaf].set(r_min)
+            leaf_max = leaf_max.at[leaf].set(l_max).at[new_leaf].set(r_max)
+            bounds_l = (l_min, l_max)
+            bounds_r = (r_min, r_max)
+        else:
+            bounds_l = bounds_r = None
+
         # -- histograms: masked pass for smaller child, subtraction for sibling
         left_smaller = lc <= rc
         small_leaf = jnp.where(left_smaller, leaf, new_leaf)
@@ -1175,11 +1208,16 @@ def _grow_tree_traced(
             # smaller-child histogram (ops/fused.py)
             csums = jnp.stack([jnp.stack([lg, rg]), jnp.stack([lh, rh]),
                                jnp.stack([lc, rc])])            # [3, 2]
+            f_bounds = ((jnp.stack([bounds_l[0], bounds_r[0]]),
+                         jnp.stack([bounds_l[1], bounds_r[1]]))
+                        if use_mc else None)
             seg1, fused_best = fused_frontier_splits(
                 binned_t, fused_vals, jnp.where(small_member, 0, 1), 1,
                 Bg, csums, left_smaller[None], parent_hist[None],
                 num_bin, missing_type, default_bin, hp,
                 quant_scales=fused_scales,
+                monotone_constraints=(mc_full if use_mc else None),
+                child_bounds=f_bounds,
                 feat_tile=(cfg.fused_feat_tile or None),
                 block_rows=(cfg.fused_block_rows or None),
                 tile_rows=tile)
@@ -1202,31 +1240,6 @@ def _grow_tree_traced(
         hist_l = jnp.where(left_smaller, small_hist, large_hist)
         hist_r = jnp.where(left_smaller, large_hist, small_hist)
         hist = c.hist.at[leaf].set(hist_l).at[new_leaf].set(hist_r)
-
-        # -- monotone bound propagation (reference: UpdateConstraints,
-        # monotone_constraints.hpp:44 — children inherit the parent's
-        # bounds, and a numerical split on a constrained feature pins
-        # the midpoint of the clamped child outputs between them)
-        leaf_min, leaf_max = c.leaf_min, c.leaf_max
-        if use_mc:
-            p_min, p_max = leaf_min[leaf], leaf_max[leaf]
-            l_out = jnp.clip(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
-                                         hp.max_delta_step), p_min, p_max)
-            r_out = jnp.clip(leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
-                                         hp.max_delta_step), p_min, p_max)
-            mid = (l_out + r_out) * 0.5
-            mc_f = mc_full[feat]      # feat is a GLOBAL feature index
-            upd = (~ncat) & (mc_f != 0)
-            l_min = jnp.where(upd & (mc_f < 0), jnp.maximum(p_min, mid), p_min)
-            l_max = jnp.where(upd & (mc_f > 0), jnp.minimum(p_max, mid), p_max)
-            r_min = jnp.where(upd & (mc_f > 0), jnp.maximum(p_min, mid), p_min)
-            r_max = jnp.where(upd & (mc_f < 0), jnp.minimum(p_max, mid), p_max)
-            leaf_min = leaf_min.at[leaf].set(l_min).at[new_leaf].set(r_min)
-            leaf_max = leaf_max.at[leaf].set(l_max).at[new_leaf].set(r_max)
-            bounds_l = (l_min, l_max)
-            bounds_r = (r_min, r_max)
-        else:
-            bounds_l = bounds_r = None
 
         # -- best splits for the two children.  Keys derive from NODE
         # IDENTITY (parent node, side) — not application order — so the

@@ -157,12 +157,16 @@ def _grow_tree_rounds_traced(
     # the smaller-child histograms (the cache's subtraction input) and
     # the [2K, F] best tuples — the staged pipeline's [K,ch,F,B] segment
     # output + [2K,ch,F,B] scan re-read round-trip never touches HBM.
-    # Numeric common case only; anything else falls back to the staged
-    # family (same trees: the scan body is shared —
-    # ops.split.numeric_feature_scan).
-    use_fused = (cfg.hist_method == "fused" and axis_name is None
-                 and not meta.has_bundles and not has_cat
-                 and not use_mc and not use_rng)
+    # Sharded training runs the SEAM-SPLIT form of the same kernel
+    # (accumulate → psum of only the smaller-child hists → sibling-derive
+    # + scan on the reduced arena); categorical columns accumulate in the
+    # same arena (their numeric tuples are overridden by the shared cat
+    # scan in pick_fused_best's merge) and monotone constraints/bounds
+    # ride into the in-kernel scan.  Only EFB bundles and per-node
+    # randomness still fall back to the staged family (same trees: the
+    # scan body is shared — ops.split.numeric_feature_scan).
+    use_fused = (cfg.hist_method == "fused"
+                 and not meta.has_bundles and not use_rng)
     # fused u32 column records for the arena's single gather (sorted-path
     # only: gather cost scales with element count — pack_cols_u32; the
     # quantized record fuses (gq, hq, member) into ONE word, Wb+1 vs
@@ -219,11 +223,26 @@ def _grow_tree_rounds_traced(
     if use_rng and rng_key is None:
         rng_key = jax.random.PRNGKey(0)
     if use_fused:
-        from .ops.fused import fused_frontier_splits, pick_fused_best
+        from .ops.fused import (fused_frontier_accumulate,
+                                fused_frontier_splits, fused_sibling_scan,
+                                pick_fused_best, shared_frontier_enabled)
         from .ops.histogram import _vals_t, _vals_t_int
+        from .ops.split import feature_best_splits
         fused_vals = (_vals_t_int(q_grad, q_hess, row_mask > 0) if quant
                       else _vals_t(grad, hess, row_mask))
         fused_scales = (g_scale, h_scale) if quant else None
+        fused_ftile = cfg.fused_feat_tile or None
+        fused_brows = cfg.fused_block_rows or None
+        # static categorical column index set for pick_fused_best's merge
+        cat_idx = (tuple(int(i) for i, v in
+                         enumerate(meta.is_categorical) if v)
+                   if has_cat else None)
+        # the shared frontier program (docs/PERF.md): on the sharded seam
+        # the ROOT histogram rides the SAME accumulate program as every
+        # level (slot 0 = all member rows), so one Mosaic kernel serves
+        # root + levels and the compile ladder shrinks by one program
+        use_shared_root = (axis_name is not None
+                           and shared_frontier_enabled())
 
     # ---- per-leaf best-split search, vmapped over all L slots ----------
     def leaf_key(parent, side):
@@ -278,18 +297,31 @@ def _grow_tree_rounds_traced(
 
     if quant:
         member = row_mask > 0
-        root_hist = psum_quant_hist(
-            build_histogram_int(binned_t, q_grad, q_hess, member, Bg,
-                                method=cfg.hist_method, levels=q_levels,
-                                tile_rows=tile),
-            axis_name, rows_global, cfg.quant_bins, hierarchical=hier_rd)
+        if use_fused and use_shared_root:
+            root_local = fused_frontier_accumulate(
+                binned_t, fused_vals, jnp.where(member, 0, KCAP), KCAP,
+                Bg, feat_tile=fused_ftile, block_rows=fused_brows,
+                tile_rows=tile)[0]
+        else:
+            root_local = build_histogram_int(
+                binned_t, q_grad, q_hess, member, Bg,
+                method=cfg.hist_method, levels=q_levels, tile_rows=tile)
+        root_hist = psum_quant_hist(root_local, axis_name, rows_global,
+                                    cfg.quant_bins, hierarchical=hier_rd)
         root_sg = psum_(jnp.sum(jnp.where(member, q_grad, 0).astype(
             jnp.int32))).astype(jnp.float32) * g_scale
         root_sh = psum_(jnp.sum(jnp.where(member, q_hess, 0).astype(
             jnp.int32))).astype(jnp.float32) * h_scale
         root_cnt = psum_(jnp.sum(member.astype(jnp.float32)))
     else:
-        root_hist = psum_(hist_fn(binned_t, grad, hess, row_mask))
+        if use_fused and use_shared_root:
+            root_local = fused_frontier_accumulate(
+                binned_t, fused_vals, jnp.where(row_mask > 0, 0, KCAP),
+                KCAP, Bg, feat_tile=fused_ftile, block_rows=fused_brows,
+                tile_rows=tile)[0]
+        else:
+            root_local = hist_fn(binned_t, grad, hess, row_mask)
+        root_hist = psum_(root_local)
         root_sg = psum_(jnp.sum(grad * row_mask))
         root_sh = psum_(jnp.sum(hess * row_mask))
         root_cnt = psum_(jnp.sum(row_mask))
@@ -589,15 +621,77 @@ def _grow_tree_rounds_traced(
             csums = jnp.stack([jnp.concatenate([lg_, rg_]),
                                jnp.concatenate([lh_, rh_]),
                                jnp.concatenate([lc_, rc_])])   # [3, 2K]
-            seg, nfb = fused_frontier_splits(
-                binned_t, fused_vals, slot, KCAP, Bg, csums,
-                small_left[idl], ph, num_bin, missing_type, default_bin,
-                hp, quant_scales=fused_scales,
-                feat_tile=(cfg.fused_feat_tile or None),
-                block_rows=(cfg.fused_block_rows or None),
-                tile_rows=tile)
+            if use_mc:
+                bl_min, bl_max, br_min, br_max = child_bounds(c)
+                f_bounds = (jnp.concatenate([bl_min[idl], br_min[idl]]),
+                            jnp.concatenate([bl_max[idl], br_max[idl]]))
+            else:
+                f_bounds = None
+            if axis_name is None:
+                seg, nfb = fused_frontier_splits(
+                    binned_t, fused_vals, slot, KCAP, Bg, csums,
+                    small_left[idl], ph, num_bin, missing_type,
+                    default_bin, hp, quant_scales=fused_scales,
+                    monotone_constraints=mc_j, child_bounds=f_bounds,
+                    feat_tile=fused_ftile, block_rows=fused_brows,
+                    tile_rows=tile)
+            else:
+                # THE COLLECTIVE SEAM (sharded data-parallel): gains are
+                # not summable across shards but the smaller-child hists
+                # are — accumulate LOCALLY in the VMEM arena, reduce
+                # exactly those [K, ch, G, Bg] hists over the (possibly
+                # tiered) data axes, then sibling-derive + scan the
+                # REDUCED arena in the standalone epilogue kernel.  The
+                # reduction routing is byte-identical to the staged arm's
+                # (psum_quant_hist / _psum), and integer accumulation is
+                # associative, so sharded fused == sharded staged
+                # bit-for-bit in quantized mode.
+                seg_local = fused_frontier_accumulate(
+                    binned_t, fused_vals, slot, KCAP, Bg,
+                    feat_tile=fused_ftile, block_rows=fused_brows,
+                    tile_rows=tile)
+                if quant:
+                    seg = psum_quant_hist(seg_local, axis_name,
+                                          rows_global, cfg.quant_bins,
+                                          hierarchical=hier_rd)
+                else:
+                    seg = psum_(seg_local)
+                nfb = fused_sibling_scan(
+                    seg, csums, num_bin, missing_type, default_bin, hp,
+                    small_left=small_left[idl], parent_hist=ph,
+                    quant_scales=fused_scales,
+                    monotone_constraints=mc_j, child_bounds=f_bounds,
+                    feat_tile=fused_ftile)
+            if has_cat:
+                # categorical merge: the arena accumulated the cat
+                # columns too (same segment reduction) — derive the
+                # children's cat slices from the cached parents, rescale
+                # (the slice's default count factor is bit-identical to
+                # the full hist's: integer hess totals match across
+                # features), and run the SHARED cat scan; the tuples
+                # override the kernel's numeric ones in the pick below.
+                ci = jnp.asarray(cat_idx, jnp.int32)
+                sm_c = seg[:, :, ci, :]
+                ph_c = ph[:, :, ci, :]
+                slc = small_left[idl][:, None, None, None]
+                hl_c = jnp.where(slc, sm_c, ph_c - sm_c)
+                chc = jnp.concatenate([hl_c, ph_c - hl_c])  # [2K,ch,Fc,B]
+                if quant:
+                    chc = quant_rescale_hist(chc, g_scale, h_scale,
+                                             csums[2])
+                nb_c, mt_c, db_c = (num_bin[ci], missing_type[ci],
+                                    default_bin[ci])
+                ic_c = is_cat[ci]
+                cat_fb = jax.vmap(
+                    lambda hh, sg_, sh_, cn_: feature_best_splits(
+                        hh, sg_, sh_, cn_, nb_c, mt_c, db_c, ic_c, hp,
+                        has_categorical=True))(
+                    chc, csums[0], csums[1], csums[2])
+            else:
+                cat_fb = None
             res = pick_fused_best(nfb, csums[0], csums[1], csums[2],
-                                  feature_mask=feature_mask)
+                                  feature_mask=feature_mask,
+                                  cat_best=cat_fb, cat_idx=cat_idx)
             if cfg.max_depth > 0:
                 dd = jnp.concatenate([depth_c, depth_c])
                 res = res._replace(gain=jnp.where(

@@ -309,7 +309,7 @@ def train_many(
     bundles resume bit-identically through ``train(resume_from=...)``.
     """
     from ..utils.platform import enable_compile_cache
-    enable_compile_cache()
+    enable_compile_cache(family="train")
     if isinstance(params_list, dict):
         params_list = expand_param_grid(params_list)
     if not params_list:

@@ -227,7 +227,11 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
     the Pallas-megakernel work (ROADMAP item 2) is steered by; the
     ``*/fused`` rows are that megakernel itself (ops/fused.py: histogram
     build + in-VMEM split scan in one program — the acceptance figure is
-    its MFU against the staged rows at the same shape).  The
+    its MFU against the staged rows at the same shape); the
+    ``*/fused_sharded_{flat,hier}`` rows are its collective-seam form —
+    accumulate-only kernel, data-axis psum (identity off-mesh), sibling
+    derive + scan kernel — the program pair the data-parallel growers
+    actually run.  The
     ``f32/scatter_batched8`` row is the model-axis plane
     (lightgbm_tpu/multi/): the same scatter build vmapped over 8
     lane-stacked gradient vectors against ONE shared binned matrix —
@@ -243,6 +247,7 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
     from ..ops import fused as FU
     from ..ops import histogram as H
     from ..ops.split import SplitHyperparams
+    from ..parallel import collectives as PC
 
     rng = np.random.RandomState(seed)
     n, F, B = int(rows), int(features), int(num_bins)
@@ -296,6 +301,25 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
             "f32/fused": lambda b, g, h, m: FU.fused_segment_splits(
                 b, H._vals_t(g, h, m), slot, slots, B, slot_sums,
                 nb_v, z_v, z_v, hp, tile_rows=tile),
+            # sharded-seam rows (ops/fused.py collective seam): fused
+            # accumulate -> data-axis psum -> fused sibling scan.  Off a
+            # mesh the psum is identity, so these measure the two kernel
+            # halves the sharded path actually runs; flat vs hierarchical
+            # differ only in the reduction routing a real mesh would take
+            # (parallel/collectives.py), kept as separate rows so on-mesh
+            # captures land in distinct keys.
+            "f32/fused_sharded_flat": lambda b, g, h, m:
+                FU.fused_sibling_scan(
+                    PC.psum_tiered(FU.fused_frontier_accumulate(
+                        b, H._vals_t(g, h, m), slot, slots, B,
+                        tile_rows=tile), None),
+                    slot_sums, nb_v, z_v, z_v, hp),
+            "f32/fused_sharded_hier": lambda b, g, h, m:
+                FU.fused_sibling_scan(
+                    PC.psum_tiered(FU.fused_frontier_accumulate(
+                        b, H._vals_t(g, h, m), slot, slots, B,
+                        tile_rows=tile), None, hierarchical=True),
+                    slot_sums, nb_v, z_v, z_v, hp),
         }
         if quant:
             ms.update({
@@ -317,6 +341,21 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
                         slot_sums, nb_v, z_v, z_v, hp,
                         quant_scales=(jnp.float32(0.25), jnp.float32(0.5)),
                         tile_rows=tile),
+                "quant/fused_sharded_flat": lambda b, g, h, m:
+                    FU.fused_sibling_scan(
+                        H.psum_quant_hist(FU.fused_frontier_accumulate(
+                            b, H._vals_t_int(gq, hq, member), slot, slots,
+                            B, tile_rows=tile), None, n, B),
+                        slot_sums, nb_v, z_v, z_v, hp,
+                        quant_scales=(jnp.float32(0.25), jnp.float32(0.5))),
+                "quant/fused_sharded_hier": lambda b, g, h, m:
+                    FU.fused_sibling_scan(
+                        H.psum_quant_hist(FU.fused_frontier_accumulate(
+                            b, H._vals_t_int(gq, hq, member), slot, slots,
+                            B, tile_rows=tile), None, n, B,
+                            hierarchical=True),
+                        slot_sums, nb_v, z_v, z_v, hp,
+                        quant_scales=(jnp.float32(0.25), jnp.float32(0.5))),
             })
         return ms
 

@@ -161,6 +161,15 @@ def collect_signals(registry=None, stages: Optional[dict] = None) -> dict:
     except Exception:  # noqa: BLE001
         sig.setdefault("ici_gbps", 100.0)
         sig.setdefault("dcn_gbps", 6.25)
+    # the autotuner's most recent election: the kernel-underutilized
+    # verdict names the measured-best variant as its concrete cure
+    try:
+        from ..ops.planner import autotune_last
+        al = autotune_last()
+        if al:
+            sig["autotune_last"] = al
+    except Exception:  # noqa: BLE001
+        pass
     return sig
 
 
@@ -270,15 +279,31 @@ def diagnose(signals: dict) -> List[Verdict]:
     mfu = s.get("mfu_measured_best")
     if mfu is not None and _num(mfu) < MFU_HEALTHY_FLOOR and not out:
         mfu = _num(mfu)
+        ev = {"mfu_measured_best": mfu, "floor": MFU_HEALTHY_FLOOR}
+        cure = ("batch boosters over a model axis or widen the fused "
+                "frontier")
+        al = s.get("autotune_last")
+        if isinstance(al, dict) and al.get("measured_variant"):
+            # the autotuner already knows the concrete cure: the variant
+            # its stopwatch ranked fastest for this shape-bucket
+            ev["measured_best_variant"] = al["measured_variant"]
+            ev["elected_variant"] = al.get("elected_variant")
+            ev["autotune_key"] = al.get("key")
+            if al["measured_variant"] != al.get("elected_variant"):
+                cure = (f"run the measured-best kernel variant "
+                        f"{al['measured_variant']!r} (autotuner store, "
+                        f"bucket {al.get('key')}) — the election "
+                        f"declined it, so fix the context that blocked "
+                        "it (VMEM budget / hist_method force / "
+                        "LGBM_TPU_FUSED)")
         out.append(Verdict(
             "kernel-underutilized",
             min(0.3 + (MFU_HEALTHY_FLOOR - mfu) / MFU_HEALTHY_FLOOR * 0.4,
                 0.7),
             f"best measured kernel MFU {mfu:.5f} (< {MFU_HEALTHY_FLOOR})"
             " with no specific bottleneck: per-level work is too small "
-            "for the MXU — batch boosters over a model axis or widen "
-            "the fused frontier",
-            {"mfu_measured_best": mfu, "floor": MFU_HEALTHY_FLOOR}))
+            f"for the MXU — {cure}",
+            ev))
 
     if not out:
         return [Verdict("healthy", 1.0,

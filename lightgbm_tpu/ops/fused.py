@@ -24,28 +24,48 @@ of that move:
   scratch (``sibling = parent − smaller``), the quantized arena is
   rescaled (``quant_rescale_hist``'s formulas, kept in lockstep), and
   the per-feature cumulative-sum gain scan runs — BOTH missing-direction
-  sweeps, the L1/L2 thresholds — via ``ops.split.numeric_feature_scan``,
-  the SAME function the staged pipeline calls, so fused == staged
-  per-feature-best tuples are bit-identical by construction given
-  bit-identical histograms (exactly the case for the integer family:
-  int32 accumulation is associative).
+  sweeps, the L1/L2 thresholds, the monotone clamp when constraints
+  ride along — via ``ops.split.numeric_feature_scan``, the SAME function
+  the staged pipeline calls, so fused == staged per-feature-best tuples
+  are bit-identical by construction given bit-identical histograms
+  (exactly the case for the integer family: int32 accumulation is
+  associative).
 - Writeback per level is the tiny ``[children, F]`` per-feature-best
   tuple set (gain, bin, direction, left sums) plus the one smaller-child
   histogram the growers' subtraction cache needs — the staged pipeline's
   extra hist-cache read for the scan (and the sibling's write+read) never
   happens.  ``hist_scan_traffic_bytes`` is the accounting twin.
 
-Scope: the numeric-feature scan (the common case — the growers gate the
-fused arm off for categorical features, EFB bundles, monotone
-constraints, per-node randomness, CEGB/forced splits and sharded axes,
-falling back to the staged family; ``hist_method=auto`` elects fused
-only when ``ops.planner.plan_fused`` proves the VMEM arena fits).
-"One HBM pass per LEVEL" is the rounds grower's contract (one kernel
-per frontier round); the serial grower's fused arm streams the full
-matrix once per SPLIT with no leaf compaction — it exists for mode
-completeness and the parity suite, so ``auto`` only elects fused where
-the rounds grower runs (explicit ``hist_method=fused`` still honors a
-forced ``tpu_tree_growth=serial``).
+**The collective seam** (sharded training): gains are NOT summable
+across data shards, but the smaller-child histograms are — so the
+megakernel splits into ``fused_frontier_accumulate`` (the accumulate
+half, emitting the LOCAL ``[K, ch, F, B]`` arena straight from VMEM)
+→ one tiered ``psum``/``psum_int_tiered`` of exactly those hists over
+ICI/DCN (``parallel/collectives.py``) → ``fused_sibling_scan`` (the
+epilogue half: sibling-derive + rescale + gain scan on the REDUCED
+arena).  Both halves run the verbatim code paths of the combined
+kernel (``_accumulate_tile`` / ``_derive_and_scan``), so sharded fused
+== sharded staged stays bit-identical for the integer family, and the
+staged ``[L, ch, F, B]`` HBM scan round-trip disappears from the
+data-parallel path too — only hists cross the wire.
+
+Scope: numeric AND categorical features (per-category stats are the
+same segment reduction — the kernel accumulates every column and the
+growers override the in-kernel numeric tuples on categorical columns
+with the shared ``feature_best_splits`` cat scan via
+``pick_fused_best``'s merge), with or without monotone constraints
+(the constraint vector rides as a fourth meta row and the per-child
+output bounds as a ``[2, NC]`` input into the in-kernel scan).  The
+growers still gate the fused arm off for EFB bundles and per-node
+randomness (extra_trees / by-node column sampling), falling back to
+the staged family; ``hist_method=auto`` elects fused only when
+``ops.planner.plan_fused`` proves the VMEM arena fits.  "One HBM pass
+per LEVEL" is the rounds grower's contract (one kernel per frontier
+round); the serial grower's fused arm streams the full matrix once per
+SPLIT with no leaf compaction — it exists for mode completeness and
+the parity suite, so ``auto`` only elects fused where the rounds
+grower runs (explicit ``hist_method=fused`` still honors a forced
+``tpu_tree_growth=serial``).
 
 Off-accelerator the whole family runs under
 ``pl.pallas_call(..., interpret=True)`` so tier-1's ``JAX_PLATFORMS=cpu``
@@ -64,8 +84,8 @@ from jax import lax
 
 from .histogram import _pad_rows, on_accelerator, resolve_tile_rows
 from .split import (K_MIN_SCORE, MAX_CAT_WORDS, NumericFeatureBest,
-                    SplitHyperparams, SplitResult, numeric_feature_scan,
-                    quant_rescale_hist)
+                    PerFeatureBest, SplitHyperparams, SplitResult,
+                    numeric_feature_scan, quant_rescale_hist)
 
 # row-tile (VMEM block) and feature-block defaults; the planner's
 # plan_fused() picks per-shape values against the VMEM budget
@@ -86,10 +106,46 @@ def hist_scan_traffic_bytes(num_candidates: int, num_features: int,
     are written+read through the cache (K·ch·F·B each way).  Fused scans
     in VMEM and derives siblings in-kernel, so exactly this term drops;
     ``tools/hist_probe.py --fused`` journals it next to the measured
-    ``bytes_accessed`` delta."""
+    ``bytes_accessed`` delta.  The SHARDED seam keeps the same drop: the
+    psum moves only the ``[K, ch, F, B]`` smaller-child arena the staged
+    sharded arm already moves, while the scan re-read + sibling
+    write/read still never touch HBM."""
     ch = 2 if quant else 3
     cell = ch * num_features * num_bins * 4
     return num_candidates * cell * 4          # 2K scan reads + K write + K read
+
+
+def _derive_and_scan(small, sums_k, meta_rows, hp,
+                     parent=None, s_is_left_vec=None, scales=None,
+                     mono=None, bounds=None):
+    """The megakernel epilogue body, shared VERBATIM by the combined
+    kernel and ``fused_sibling_scan`` (the post-collective half of the
+    sharded seam) so their tuples cannot diverge.
+
+    ``small`` [K, ch, Ft, B]; ``parent`` None | [K, ch, Ft, B];
+    ``s_is_left_vec`` None | [K] i32; ``sums_k`` [3, NC];
+    ``meta_rows`` (num_bin, missing, default) [Ft] rows; ``mono``
+    None | [Ft] i32; ``bounds`` None | ([NC], [NC]) per-child output
+    clamp.  Returns ``NumericFeatureBest`` [NC, Ft]."""
+    if parent is not None:
+        s_is_left = (s_is_left_vec != 0)[:, None, None, None]
+        h_left = jnp.where(s_is_left, small, parent - small)
+        h_right = parent - h_left
+        ch_hist = jnp.concatenate([h_left, h_right], axis=0)
+    else:
+        ch_hist = small
+    sg, sh, cnt = sums_k[0], sums_k[1], sums_k[2]
+    if scales is not None:
+        # the SHARED rescale body (batched over children; its default
+        # count factor reads the block's FIRST feature — any feature's
+        # bins partition the child's rows, so the integer total equals
+        # the staged feature-0 total bit-for-bit)
+        hist3 = quant_rescale_hist(ch_hist, scales[0], scales[1], cnt)
+    else:
+        hist3 = ch_hist
+    return numeric_feature_scan(
+        hist3, sg, sh, cnt, meta_rows[0], meta_rows[1], meta_rows[2], hp,
+        monotone_constraints=mono, leaf_output_bounds=bounds)
 
 
 def _fused_call(
@@ -98,21 +154,26 @@ def _fused_call(
     slot: jax.Array,              # [n] i32 in [0, K]; K = dropped
     num_slots: int,
     num_bins: int,
-    child_sums: jax.Array,        # [3, NC] f32 (sum_grad, sum_hess, count)
-    meta_vecs: tuple,             # (num_bin, missing_type, default_bin) [F]
-    hp: SplitHyperparams,
+    child_sums: Optional[jax.Array],  # [3, NC] f32 (sum_g, sum_h, count)
+    meta_vecs: Optional[tuple],   # (num_bin, missing_type, default_bin) [F]
+    hp: Optional[SplitHyperparams],
     small_left: Optional[jax.Array] = None,   # [K] bool (with parent)
     parent_hist: Optional[jax.Array] = None,  # [K, ch, F, B]
     quant_scales: Optional[tuple] = None,     # (g_scale, h_scale) traced
+    monotone_constraints: Optional[jax.Array] = None,  # [F] i32
+    child_bounds: Optional[tuple] = None,     # ([NC], [NC]) output clamp
     feat_tile: Optional[int] = None,
     block_rows: Optional[int] = None,
     tile_rows: Optional[int] = None,
     interpret: Optional[bool] = None,
+    with_scan: bool = True,
 ):
     """One megakernel invocation; returns ``(slot_hist [K, ch, F, B],
     NumericFeatureBest [NC, F])`` with NC = 2K (parent mode: children are
     [left 0..K-1, right K..2K-1]) or K (leaf mode: the slot histograms
-    themselves are scanned)."""
+    themselves are scanned).  ``with_scan=False`` drops the epilogue and
+    its inputs entirely — the accumulate half of the collective seam —
+    and returns only the histogram."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -124,8 +185,10 @@ def _fused_call(
     B = int(num_bins)
     with_parent = parent_hist is not None
     NC = 2 * K if with_parent else K
-    if quant and quant_scales is None:
+    if with_scan and quant and quant_scales is None:
         raise ValueError("quantized fused kernel needs quant_scales")
+    has_mono = with_scan and monotone_constraints is not None
+    has_bounds = with_scan and child_bounds is not None
 
     if feat_tile is None or block_rows is None:
         from .planner import plan_fused
@@ -151,14 +214,6 @@ def _fused_call(
     vt = jnp.pad(vals_t, ((0, 0), (0, n_pad - n))) if n_pad != n else vals_t
     st = jnp.pad(slot.astype(jnp.int32), (0, n_pad - n),
                  constant_values=K)[None, :]               # [1, n_pad]
-    num_bin_v, missing_v, default_v = meta_vecs
-    meta = jnp.stack([jnp.asarray(num_bin_v, jnp.int32),
-                      jnp.asarray(missing_v, jnp.int32),
-                      jnp.asarray(default_v, jnp.int32)])  # [3, F]
-    if F_pad != F:
-        # padded features: num_bin 0 -> every bin invalid -> gain -inf
-        meta = jnp.pad(meta, ((0, 0), (0, F_pad - F)))
-    sums = jnp.asarray(child_sums, jnp.float32)            # [3, NC]
     nf_blocks = F_pad // Ft
     nt = n_pad // C
 
@@ -174,15 +229,34 @@ def _fused_call(
                                      lambda j, i: (0, 0, j, 0)))
         in_arrays.append(small_left.astype(jnp.int32)[None, :])  # [1, K]
         in_specs.append(pl.BlockSpec((1, K), lambda j, i: (0, 0)))
-    in_arrays.append(sums)
-    in_specs.append(pl.BlockSpec((3, NC), lambda j, i: (0, 0)))
-    in_arrays.append(meta)
-    in_specs.append(pl.BlockSpec((3, Ft), lambda j, i: (0, j)))
-    if quant:
-        in_arrays.append(jnp.stack([jnp.asarray(quant_scales[0], jnp.float32),
-                                    jnp.asarray(quant_scales[1],
-                                                jnp.float32)])[None, :])
-        in_specs.append(pl.BlockSpec((1, 2), lambda j, i: (0, 0)))
+    if with_scan:
+        num_bin_v, missing_v, default_v = meta_vecs
+        meta_rows = [jnp.asarray(num_bin_v, jnp.int32),
+                     jnp.asarray(missing_v, jnp.int32),
+                     jnp.asarray(default_v, jnp.int32)]
+        if has_mono:
+            meta_rows.append(jnp.asarray(monotone_constraints, jnp.int32))
+        meta = jnp.stack(meta_rows)                        # [3|4, F]
+        if F_pad != F:
+            # padded features: num_bin 0 -> every bin invalid -> gain -inf
+            meta = jnp.pad(meta, ((0, 0), (0, F_pad - F)))
+        R = int(meta.shape[0])
+        sums = jnp.asarray(child_sums, jnp.float32)        # [3, NC]
+        in_arrays.append(sums)
+        in_specs.append(pl.BlockSpec((3, NC), lambda j, i: (0, 0)))
+        in_arrays.append(meta)
+        in_specs.append(pl.BlockSpec((R, Ft), lambda j, i: (0, j)))
+        if quant:
+            in_arrays.append(
+                jnp.stack([jnp.asarray(quant_scales[0], jnp.float32),
+                           jnp.asarray(quant_scales[1],
+                                       jnp.float32)])[None, :])
+            in_specs.append(pl.BlockSpec((1, 2), lambda j, i: (0, 0)))
+        if has_bounds:
+            in_arrays.append(jnp.stack(
+                [jnp.asarray(child_bounds[0], jnp.float32),
+                 jnp.asarray(child_bounds[1], jnp.float32)]))   # [2, NC]
+            in_specs.append(pl.BlockSpec((2, NC), lambda j, i: (0, 0)))
 
     def kernel(*refs):
         it = iter(refs)
@@ -191,16 +265,18 @@ def _fused_call(
         s_ref = next(it)
         p_ref = next(it) if with_parent else None
         sl_ref = next(it) if with_parent else None
-        sum_ref = next(it)
-        m_ref = next(it)
-        sc_ref = next(it) if quant else None
+        sum_ref = next(it) if with_scan else None
+        m_ref = next(it) if with_scan else None
+        sc_ref = next(it) if with_scan and quant else None
+        bd_ref = next(it) if has_bounds else None
         hist_ref = next(it)
-        gn_ref = next(it)
-        th_ref = next(it)
-        dl_ref = next(it)
-        lg_ref = next(it)
-        lh_ref = next(it)
-        lc_ref = next(it)
+        if with_scan:
+            gn_ref = next(it)
+            th_ref = next(it)
+            dl_ref = next(it)
+            lg_ref = next(it)
+            lh_ref = next(it)
+            lc_ref = next(it)
         acc = next(it)
 
         i = pl.program_id(1)
@@ -209,84 +285,58 @@ def _fused_call(
         def _init():
             acc[...] = jnp.zeros_like(acc)
 
-        # ---- accumulate: slot-expanded one-hot matmul on this tile ----
-        blk = b_ref[...].astype(jnp.int32)                 # [Ft, C]
-        sl = s_ref[0, :]                                   # [C]
-        iota_s = lax.broadcasted_iota(jnp.int32, (K, C), 0)
-        oh_s = sl[None, :] == iota_s                       # [K, C]
-        v = v_ref[...]                                     # [ch, C]
-        iota_b = lax.broadcasted_iota(jnp.int32, (C, Ft, B), 2)
-        ohb = blk.T[:, :, None] == iota_b                  # [C, Ft, B]
-        if quant:
-            lhs = (v[:, None, :] * oh_s[None].astype(jnp.int8)
-                   ).reshape(ch * K, C)
-            part = lax.dot(lhs, ohb.astype(jnp.int8).reshape(C, Ft * B),
-                           preferred_element_type=jnp.int32)
-        else:
-            lhs = (v[:, None, :] * oh_s[None].astype(jnp.float32)
-                   ).reshape(ch * K, C)
-            part = lax.dot(lhs, ohb.astype(jnp.float32).reshape(C, Ft * B),
-                           precision=lax.Precision.HIGHEST,
-                           preferred_element_type=jnp.float32)
-        acc[...] += part
+        _accumulate_tile(acc, b_ref, v_ref, s_ref, K, Ft, B, ch, quant)
 
         # ---- epilogue after the last tile: derive + scan in VMEM ----
         @pl.when(i == nt - 1)
         def _epilogue():
             small = acc[...].reshape(ch, K, Ft, B).transpose(1, 0, 2, 3)
             hist_ref[...] = small
-            if with_parent:
-                parent = p_ref[...]
-                s_is_left = (sl_ref[0, :] != 0)[:, None, None, None]
-                h_left = jnp.where(s_is_left, small, parent - small)
-                h_right = parent - h_left
-                ch_hist = jnp.concatenate([h_left, h_right], axis=0)
-            else:
-                ch_hist = small
-            sums_k = sum_ref[...]
-            sg, sh, cnt = sums_k[0], sums_k[1], sums_k[2]
-            if quant:
-                # the SHARED rescale body (batched over children; its
-                # default count factor reads the block's FIRST feature —
-                # any feature's bins partition the child's rows, so the
-                # integer total equals the staged feature-0 total
-                # bit-for-bit)
-                hist3 = quant_rescale_hist(ch_hist, sc_ref[0, 0],
-                                           sc_ref[0, 1], cnt)
-            else:
-                hist3 = ch_hist
-            res = numeric_feature_scan(
-                hist3, sg, sh, cnt, m_ref[0, :], m_ref[1, :], m_ref[2, :],
-                hp)
-            gn_ref[...] = res.gain
-            th_ref[...] = res.threshold
-            dl_ref[...] = res.default_left.astype(jnp.int32)
-            lg_ref[...] = res.left_sum_grad
-            lh_ref[...] = res.left_sum_hess
-            lc_ref[...] = res.left_count
+            if with_scan:
+                res = _derive_and_scan(
+                    small, sum_ref[...],
+                    (m_ref[0, :], m_ref[1, :], m_ref[2, :]), hp,
+                    parent=p_ref[...] if with_parent else None,
+                    s_is_left_vec=sl_ref[0, :] if with_parent else None,
+                    scales=(sc_ref[0, 0], sc_ref[0, 1]) if quant else None,
+                    mono=m_ref[3, :] if has_mono else None,
+                    bounds=(bd_ref[0, :], bd_ref[1, :]) if has_bounds
+                    else None)
+                gn_ref[...] = res.gain
+                th_ref[...] = res.threshold
+                dl_ref[...] = res.default_left.astype(jnp.int32)
+                lg_ref[...] = res.left_sum_grad
+                lh_ref[...] = res.left_sum_hess
+                lc_ref[...] = res.left_count
 
+    hist_spec = pl.BlockSpec((K, ch, Ft, B), lambda j, i: (0, 0, j, 0))
+    hist_shape = jax.ShapeDtypeStruct((K, ch, F_pad, B), acc_dtype)
     tuple_spec = pl.BlockSpec((NC, Ft), lambda j, i: (0, j))
-    out = pl.pallas_call(
-        kernel,
-        grid=(nf_blocks, nt),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((K, ch, Ft, B), lambda j, i: (0, 0, j, 0)),
-            tuple_spec, tuple_spec, tuple_spec, tuple_spec, tuple_spec,
-            tuple_spec,
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((K, ch, F_pad, B), acc_dtype),
+    if with_scan:
+        out_specs = [hist_spec] + [tuple_spec] * 6
+        out_shape = [
+            hist_shape,
             jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # gain
             jax.ShapeDtypeStruct((NC, F_pad), jnp.int32),     # threshold
             jax.ShapeDtypeStruct((NC, F_pad), jnp.int32),     # default_left
             jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # left_sum_grad
             jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # left_sum_hess
             jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),   # left_count
-        ],
+        ]
+    else:
+        out_specs = [hist_spec]
+        out_shape = [hist_shape]
+    out = pl.pallas_call(
+        kernel,
+        grid=(nf_blocks, nt),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((ch * K, Ft * B), acc_dtype)],
         interpret=_interp(interpret),
     )(*in_arrays)
+    if not with_scan:
+        return out[0][:, :, :F, :]
     hist, gain, thr, dl, lgs, lhs_, lcs = out
     best = NumericFeatureBest(
         gain=gain[:, :F], threshold=thr[:, :F],
@@ -294,6 +344,192 @@ def _fused_call(
         left_sum_grad=lgs[:, :F], left_sum_hess=lhs_[:, :F],
         left_count=lcs[:, :F])
     return hist[:, :, :F, :], best
+
+
+def _accumulate_tile(acc, b_ref, v_ref, s_ref, K, Ft, B, ch, quant):
+    """One row tile of the slot-expanded one-hot matmul, accumulated
+    into the VMEM arena — the accumulate half of the megakernel, shared
+    verbatim by the combined kernel and ``fused_frontier_accumulate``."""
+    blk = b_ref[...].astype(jnp.int32)                 # [Ft, C]
+    C = blk.shape[1]
+    sl = s_ref[0, :]                                   # [C]
+    iota_s = lax.broadcasted_iota(jnp.int32, (K, C), 0)
+    oh_s = sl[None, :] == iota_s                       # [K, C]
+    v = v_ref[...]                                     # [ch, C]
+    iota_b = lax.broadcasted_iota(jnp.int32, (C, Ft, B), 2)
+    ohb = blk.T[:, :, None] == iota_b                  # [C, Ft, B]
+    if quant:
+        lhs = (v[:, None, :] * oh_s[None].astype(jnp.int8)
+               ).reshape(ch * K, C)
+        part = lax.dot(lhs, ohb.astype(jnp.int8).reshape(C, Ft * B),
+                       preferred_element_type=jnp.int32)
+    else:
+        lhs = (v[:, None, :] * oh_s[None].astype(jnp.float32)
+               ).reshape(ch * K, C)
+        part = lax.dot(lhs, ohb.astype(jnp.float32).reshape(C, Ft * B),
+                       precision=lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+    acc[...] += part
+
+
+def fused_frontier_accumulate(
+    binned_t: jax.Array,
+    vals_t: jax.Array,
+    slot: jax.Array,
+    num_slots: int,
+    num_bins: int,
+    feat_tile: Optional[int] = None,
+    block_rows: Optional[int] = None,
+    tile_rows: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """The accumulate HALF of the collective seam: build the K
+    smaller-child (or slot) histograms in the VMEM arena and emit them —
+    no scan, no parent.  Returns ``hist [K, ch, F, B]`` (int32 when
+    ``vals_t`` is int8, f32 otherwise).
+
+    Sharded training runs THIS program per shard, reduces exactly its
+    output over the data axes (``psum_int_tiered`` / tiered ``psum``),
+    then hands the reduced arena to ``fused_sibling_scan`` — gains stay
+    local, only hists cross the wire.  One program also serves every
+    frontier level AND the root (slot 0 = all member rows): the shared
+    frontier program of the compile-time ladder (docs/PERF.md)."""
+    return _fused_call(
+        binned_t, vals_t, slot, num_slots, num_bins, None, None, None,
+        feat_tile=feat_tile, block_rows=block_rows, tile_rows=tile_rows,
+        interpret=interpret, with_scan=False)
+
+
+def fused_sibling_scan(
+    small_hist: jax.Array,         # [K, ch, F, B] REDUCED smaller-child hists
+    child_sums: jax.Array,         # [3, NC] (NC = 2K parent mode, K leaf)
+    num_bin: jax.Array,
+    missing_type: jax.Array,
+    default_bin: jax.Array,
+    hp: SplitHyperparams,
+    small_left: Optional[jax.Array] = None,   # [K] bool (parent mode)
+    parent_hist: Optional[jax.Array] = None,  # [K, ch, F, B]
+    quant_scales: Optional[tuple] = None,
+    monotone_constraints: Optional[jax.Array] = None,  # [F] i32
+    child_bounds: Optional[tuple] = None,     # ([NC], [NC]) output clamp
+    feat_tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> NumericFeatureBest:
+    """The scan HALF of the collective seam: sibling-derive + rescale +
+    gain scan over the ALREADY-REDUCED arena, one feature block per grid
+    step, all in VMEM.  The body is ``_derive_and_scan`` — the verbatim
+    epilogue of the combined kernel — so seam-split tuples equal combined
+    tuples bit-for-bit given equal histograms."""
+    from jax.experimental import pallas as pl
+
+    quant = jnp.issubdtype(small_hist.dtype, jnp.integer)
+    if quant and quant_scales is None:
+        raise ValueError("quantized fused sibling scan needs quant_scales")
+    K, ch, F, B = (int(d) for d in small_hist.shape)
+    with_parent = parent_hist is not None
+    NC = 2 * K if with_parent else K
+    has_mono = monotone_constraints is not None
+    has_bounds = child_bounds is not None
+    if feat_tile is None:
+        from .planner import plan_fused
+        fp = plan_fused(K, B, bool(quant), with_parent=with_parent)
+        feat_tile = fp["feat_tile"] if fp else 1
+    Ft = max(1, min(int(feat_tile), F))
+    F_pad = _pad_rows(F, Ft)
+    acc_dtype = jnp.int32 if quant else jnp.float32
+
+    small = small_hist.astype(acc_dtype)
+    if F_pad != F:
+        small = jnp.pad(small, ((0, 0), (0, 0), (0, F_pad - F), (0, 0)))
+    meta_rows = [jnp.asarray(num_bin, jnp.int32),
+                 jnp.asarray(missing_type, jnp.int32),
+                 jnp.asarray(default_bin, jnp.int32)]
+    if has_mono:
+        meta_rows.append(jnp.asarray(monotone_constraints, jnp.int32))
+    meta = jnp.stack(meta_rows)
+    if F_pad != F:
+        meta = jnp.pad(meta, ((0, 0), (0, F_pad - F)))
+    R = int(meta.shape[0])
+
+    in_arrays = [small]
+    in_specs = [pl.BlockSpec((K, ch, Ft, B), lambda j: (0, 0, j, 0))]
+    if with_parent:
+        parent = parent_hist.astype(acc_dtype)
+        if F_pad != F:
+            parent = jnp.pad(parent,
+                             ((0, 0), (0, 0), (0, F_pad - F), (0, 0)))
+        in_arrays.append(parent)
+        in_specs.append(pl.BlockSpec((K, ch, Ft, B), lambda j: (0, 0, j, 0)))
+        in_arrays.append(small_left.astype(jnp.int32)[None, :])
+        in_specs.append(pl.BlockSpec((1, K), lambda j: (0, 0)))
+    in_arrays.append(jnp.asarray(child_sums, jnp.float32))
+    in_specs.append(pl.BlockSpec((3, NC), lambda j: (0, 0)))
+    in_arrays.append(meta)
+    in_specs.append(pl.BlockSpec((R, Ft), lambda j: (0, j)))
+    if quant:
+        in_arrays.append(
+            jnp.stack([jnp.asarray(quant_scales[0], jnp.float32),
+                       jnp.asarray(quant_scales[1], jnp.float32)])[None, :])
+        in_specs.append(pl.BlockSpec((1, 2), lambda j: (0, 0)))
+    if has_bounds:
+        in_arrays.append(jnp.stack(
+            [jnp.asarray(child_bounds[0], jnp.float32),
+             jnp.asarray(child_bounds[1], jnp.float32)]))
+        in_specs.append(pl.BlockSpec((2, NC), lambda j: (0, 0)))
+
+    def kernel(*refs):
+        it = iter(refs)
+        sm_ref = next(it)
+        p_ref = next(it) if with_parent else None
+        sl_ref = next(it) if with_parent else None
+        sum_ref = next(it)
+        m_ref = next(it)
+        sc_ref = next(it) if quant else None
+        bd_ref = next(it) if has_bounds else None
+        gn_ref = next(it)
+        th_ref = next(it)
+        dl_ref = next(it)
+        lg_ref = next(it)
+        lh_ref = next(it)
+        lc_ref = next(it)
+
+        res = _derive_and_scan(
+            sm_ref[...], sum_ref[...],
+            (m_ref[0, :], m_ref[1, :], m_ref[2, :]), hp,
+            parent=p_ref[...] if with_parent else None,
+            s_is_left_vec=sl_ref[0, :] if with_parent else None,
+            scales=(sc_ref[0, 0], sc_ref[0, 1]) if quant else None,
+            mono=m_ref[3, :] if has_mono else None,
+            bounds=(bd_ref[0, :], bd_ref[1, :]) if has_bounds else None)
+        gn_ref[...] = res.gain
+        th_ref[...] = res.threshold
+        dl_ref[...] = res.default_left.astype(jnp.int32)
+        lg_ref[...] = res.left_sum_grad
+        lh_ref[...] = res.left_sum_hess
+        lc_ref[...] = res.left_count
+
+    tuple_spec = pl.BlockSpec((NC, Ft), lambda j: (0, j))
+    out = pl.pallas_call(
+        kernel,
+        grid=(F_pad // Ft,),
+        in_specs=in_specs,
+        out_specs=[tuple_spec] * 6,
+        out_shape=[
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.int32),
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.int32),
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),
+            jax.ShapeDtypeStruct((NC, F_pad), jnp.float32),
+        ],
+        interpret=_interp(interpret),
+    )(*in_arrays)
+    gain, thr, dl, lgs, lhs_, lcs = out
+    return NumericFeatureBest(
+        gain=gain[:, :F], threshold=thr[:, :F],
+        default_left=dl[:, :F].astype(bool),
+        left_sum_grad=lgs[:, :F], left_sum_hess=lhs_[:, :F],
+        left_count=lcs[:, :F])
 
 
 def fused_segment_splits(
@@ -308,6 +544,8 @@ def fused_segment_splits(
     default_bin: jax.Array,
     hp: SplitHyperparams,
     quant_scales: Optional[tuple] = None,
+    monotone_constraints: Optional[jax.Array] = None,
+    child_bounds: Optional[tuple] = None,
     feat_tile: Optional[int] = None,
     block_rows: Optional[int] = None,
     tile_rows: Optional[int] = None,
@@ -321,7 +559,9 @@ def fused_segment_splits(
     return _fused_call(
         binned_t, vals_t, slot, num_slots, num_bins, slot_sums,
         (num_bin, missing_type, default_bin), hp,
-        quant_scales=quant_scales, feat_tile=feat_tile,
+        quant_scales=quant_scales,
+        monotone_constraints=monotone_constraints,
+        child_bounds=child_bounds, feat_tile=feat_tile,
         block_rows=block_rows, tile_rows=tile_rows, interpret=interpret)
 
 
@@ -340,6 +580,8 @@ def fused_frontier_splits(
     default_bin: jax.Array,
     hp: SplitHyperparams,
     quant_scales: Optional[tuple] = None,
+    monotone_constraints: Optional[jax.Array] = None,
+    child_bounds: Optional[tuple] = None,
     feat_tile: Optional[int] = None,
     block_rows: Optional[int] = None,
     tile_rows: Optional[int] = None,
@@ -355,18 +597,51 @@ def fused_frontier_splits(
         binned_t, vals_t, slot, num_slots, num_bins, child_sums,
         (num_bin, missing_type, default_bin), hp,
         small_left=small_left, parent_hist=parent_hist,
-        quant_scales=quant_scales, feat_tile=feat_tile,
+        quant_scales=quant_scales,
+        monotone_constraints=monotone_constraints,
+        child_bounds=child_bounds, feat_tile=feat_tile,
         block_rows=block_rows, tile_rows=tile_rows, interpret=interpret)
 
 
 def pick_fused_best(best: NumericFeatureBest, sum_grad, sum_hess, num_data,
-                    feature_mask: Optional[jax.Array] = None) -> SplitResult:
+                    feature_mask: Optional[jax.Array] = None,
+                    cat_best: Optional[PerFeatureBest] = None,
+                    cat_idx=None) -> SplitResult:
     """argmax over features of fused per-feature-best tuples — the
     numeric twin of ``ops.split.pick_best_feature`` (ties -> smaller
     feature index), vectorized over the leading children axis.  The
     feature mask applies here (outside the kernel): masking gains after
-    the scan is exactly what ``feature_best_splits`` does inside."""
+    the scan is exactly what ``feature_best_splits`` does inside.
+
+    Categorical merge (the lifted gate): the kernel accumulates EVERY
+    column — per-category stats are the same segment reduction — but its
+    in-kernel NUMERIC scan is meaningless on categorical columns, so the
+    growers run the shared ``feature_best_splits`` cat scan on just the
+    categorical slice of the derived child histograms and pass it here as
+    ``cat_best`` (fields [..., Fc]) with the static column indices
+    ``cat_idx``.  Scattering those tuples over the numeric ones before
+    the argmax reproduces ``feature_best_splits``' own
+    ``jnp.where(is_categorical, cat, numeric)`` merge and
+    ``pick_best_feature``'s tie order exactly."""
     gain = best.gain
+    thr = best.threshold
+    dl = best.default_left
+    blg_f = best.left_sum_grad
+    blh_f = best.left_sum_hess
+    blc_f = best.left_count
+    F = gain.shape[-1]
+    is_cat = jnp.zeros(gain.shape, bool)
+    bitset = jnp.zeros(gain.shape + (MAX_CAT_WORDS,), jnp.uint32)
+    if cat_best is not None:
+        ci = jnp.asarray(cat_idx, jnp.int32)
+        gain = gain.at[..., ci].set(cat_best.gain)
+        thr = thr.at[..., ci].set(cat_best.threshold.astype(thr.dtype))
+        dl = dl.at[..., ci].set(cat_best.default_left.astype(dl.dtype))
+        blg_f = blg_f.at[..., ci].set(cat_best.left_sum_grad)
+        blh_f = blh_f.at[..., ci].set(cat_best.left_sum_hess)
+        blc_f = blc_f.at[..., ci].set(cat_best.left_count)
+        is_cat = is_cat.at[..., ci].set(cat_best.is_categorical)
+        bitset = bitset.at[..., ci, :].set(cat_best.cat_bitset)
     if feature_mask is not None:
         gain = jnp.where(feature_mask.astype(bool), gain, K_MIN_SCORE)
     f = jnp.argmax(gain, axis=-1).astype(jnp.int32)
@@ -374,19 +649,22 @@ def pick_fused_best(best: NumericFeatureBest, sum_grad, sum_hess, num_data,
     def sel(a):
         return jnp.take_along_axis(a, f[..., None], -1)[..., 0]
 
-    blg = sel(best.left_sum_grad)
-    blh = sel(best.left_sum_hess)
-    blc = sel(best.left_count)
+    blg = sel(blg_f)
+    blh = sel(blh_f)
+    blc = sel(blc_f)
     return SplitResult(
         gain=sel(gain), feature=f,
-        threshold=sel(best.threshold),
-        default_left=sel(best.default_left),
+        threshold=sel(thr),
+        default_left=sel(dl),
         left_sum_grad=blg, left_sum_hess=blh, left_count=blc,
         right_sum_grad=jnp.asarray(sum_grad) - blg,
         right_sum_hess=jnp.asarray(sum_hess) - blh,
         right_count=jnp.asarray(num_data).astype(jnp.float32) - blc,
-        is_categorical=jnp.zeros(f.shape, bool),
-        cat_bitset=jnp.zeros(f.shape + (MAX_CAT_WORDS,), jnp.uint32))
+        is_categorical=sel(is_cat),
+        cat_bitset=jnp.take_along_axis(
+            bitset, f[..., None, None],
+            -2)[..., 0, :] if cat_best is not None else
+        jnp.zeros(f.shape + (MAX_CAT_WORDS,), jnp.uint32))
 
 
 # one-time per-backend verdict: does the fused megakernel COMPILE AND
@@ -447,6 +725,22 @@ def fused_kernel_verified() -> bool:
                                    sums[1], sums[2], nb, zero, zero, hp)
         ok = ok and bool(np.allclose(np.asarray(best.gain),
                                      np.asarray(ref.gain), equal_nan=True))
+        # the seam halves ride the same backend verdict: accumulate-only
+        # must reproduce the combined kernel's arena, and the standalone
+        # scan the combined kernel's tuples
+        acc_only = jax.jit(
+            lambda b, v, s: fused_frontier_accumulate(
+                b, v, s, K, B, feat_tile=2, block_rows=128))(
+                    binned, vals, slot)
+        ok = ok and bool(np.allclose(np.asarray(acc_only),
+                                     np.asarray(hist), rtol=1e-4,
+                                     atol=1e-3))
+        scan_only = jax.jit(
+            lambda hh, su: fused_sibling_scan(
+                hh, su, nb, zero, zero, hp, feat_tile=2))(hist, sums)
+        ok = ok and bool(np.allclose(np.asarray(scan_only.gain),
+                                     np.asarray(best.gain),
+                                     equal_nan=True))
     except Exception:
         ok = False
     _FUSED_PROBE[backend] = ok
@@ -464,3 +758,12 @@ def fused_enabled_env() -> bool:
     """LGBM_TPU_FUSED=0 drops the fused arm (compile-cost bisect hook,
     mirroring LGBM_TPU_SEGHIST / LGBM_TPU_ROUTER)."""
     return os.environ.get("LGBM_TPU_FUSED") != "0"
+
+
+def shared_frontier_enabled() -> bool:
+    """LGBM_TPU_SHARED_FRONTIER=0 turns off the shared frontier program
+    (the sharded fused root riding the SAME ``fused_frontier_accumulate``
+    program as every level — slot 0 = all member rows — so one Mosaic
+    kernel serves root + levels and the compile ladder shrinks by one
+    program; docs/PERF.md "shared frontier programs")."""
+    return os.environ.get("LGBM_TPU_SHARED_FRONTIER") != "0"

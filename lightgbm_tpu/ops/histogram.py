@@ -79,7 +79,11 @@ def resolve_hist_method(method: str, quantized: bool = False) -> str:
     ops/fused.py) resolves to itself in BOTH families — the growers gate
     where it actually applies and this module's plain-histogram entry
     points (``build_histogram*``) map it to the staged auto kernel,
-    since a bare histogram has no split scan to fuse.
+    since a bare histogram has no split scan to fuse.  The growers'
+    refusal set has shrunk: categorical features, monotone constraints
+    and data-parallel sharding now run fused (the collective seam);
+    only EFB bundles, per-node randomness and feature/voting sharding
+    still force the staged family.
     """
     if method == "fused":
         return "fused"

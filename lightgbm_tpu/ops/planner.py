@@ -41,6 +41,7 @@ an operator-tuned chunk count.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 from contextlib import contextmanager
@@ -115,6 +116,9 @@ class HistPlan(NamedTuple):
     fused_block_rows: int = 0   # rows per double-buffered tile DMA
     fused_vmem_bytes: int = 0   # predicted VMEM arena bytes at that shape
     vmem_limit_bytes: int = 0   # VMEM limit the fused election ran against
+    elected_by: str = "analytic"   # "analytic" | "measured" (autotuner)
+    measured_variant: str = ""  # store's best for this bucket ("" = cold)
+    autotune_key: str = ""      # shape-bucket key the election ran under
 
     def summary(self) -> dict:
         """JSON-friendly form for bench journals / telemetry."""
@@ -136,6 +140,9 @@ class HistPlan(NamedTuple):
             "fused_block_rows": self.fused_block_rows,
             "fused_vmem_bytes": self.fused_vmem_bytes,
             "vmem_limit_bytes": self.vmem_limit_bytes,
+            "elected_by": self.elected_by,
+            "measured_variant": self.measured_variant,
+            "autotune_key": self.autotune_key,
         }
 
 
@@ -360,6 +367,216 @@ def _tile_override():
         return None
 
 
+# ======================================================================
+# Compile-time war, part 1: shape-bucket ladders.  Every distinct row
+# count is a distinct XLA program, so a pipeline of nearby dataset sizes
+# recompiles everything from scratch each time.  Padding training rows
+# up to a coarse ladder rung (the serving-bucket trick from predict,
+# applied to training) makes nearby sizes share ONE compiled program;
+# padded rows ride the existing row_mask machinery (mask 0, zero
+# grad/hess) so sums and counts are untouched.
+# ======================================================================
+
+# smallest ladder rung: below this, compile time dwarfs any pad waste,
+# so every tiny fit shares a single program shape
+MIN_BUCKET_ROWS = 4096
+
+
+def shape_buckets_enabled() -> bool:
+    """LGBM_TPU_SHAPE_BUCKETS: "0" off, "1" on, unset = accelerators
+    only.  CPU defaults OFF so golden-model tests keep exact row counts
+    (f32 reduction trees change with padding; quantized paths do not)."""
+    v = os.environ.get("LGBM_TPU_SHAPE_BUCKETS", "").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return False
+    if v in ("1", "on", "true", "yes"):
+        return True
+    from .histogram import on_accelerator
+    return on_accelerator()
+
+
+def bucket_rows(n: int) -> int:
+    """Smallest ladder rung >= n; rungs are {2^k, 1.5 * 2^k}.
+
+    Two rungs per octave bounds pad waste at 50% (just past a power of
+    two) while keeping the distinct-program count logarithmic in the
+    row-count range.
+    """
+    n = max(int(n), 1)
+    if n <= MIN_BUCKET_ROWS:
+        return MIN_BUCKET_ROWS
+    base = 1 << (n.bit_length() - 1)        # 2^k <= n
+    for rung in (base, base + (base >> 1), base << 1):
+        if rung >= n:
+            return rung
+    return base << 1                        # unreachable
+
+
+# ======================================================================
+# Compile-time war, part 2 — measured election: the autotuner.  The
+# analytic models above answer "does it fit"; only a stopwatch answers
+# "which variant is FASTEST here".  tools/hist_probe.py and bench record
+# measured sec/level per (shape-bucket, variant) from
+# obs.devprof.measure_program into an atomic JSON store beside the
+# persistent compile cache; plan_histograms then elects the kernel
+# variant (and the fused kernel's {feat_tile, block_rows}) from
+# measurements when they exist, keeping the analytic model as the
+# cold-start fallback.  A corrupt, stale or version-mismatched store is
+# ALWAYS a miss, never a crash.
+# ======================================================================
+
+AUTOTUNE_STORE_VERSION = 1
+_AUTOTUNE_STORE_FILE = "hist_timings.json"
+# election outcomes since process start (or last reset):
+#   hit  = a valid measurement keyed this shape and drove the election
+#   miss = no usable measurement (cold start / stale name / bad context)
+#   flip = a hit elected a DIFFERENT variant than the analytic model
+_AUTOTUNE_STATS = {"hits": 0, "misses": 0, "flips": 0}
+# the most recent election's full story — obs/diagnose.py feeds the
+# kernel-underutilized verdict its concrete cure from here
+_AUTOTUNE_LAST: dict = {}
+_AUTOTUNE_LOCK = threading.Lock()
+
+
+def autotune_enabled() -> bool:
+    """LGBM_TPU_AUTOTUNE != "0" (default on; measurements only steer an
+    election when the store actually holds some)."""
+    return os.environ.get("LGBM_TPU_AUTOTUNE", "").strip().lower() \
+        not in ("0", "off", "false", "no")
+
+
+def autotune_dir():
+    """Directory of the measured-timings store, or None (analytic-only).
+
+    ``LGBM_TPU_AUTOTUNE_DIR`` wins; otherwise an ``autotune/`` sibling
+    inside the persistent compile-cache dir — the measurements describe
+    the same machine the cached programs were compiled for, so they
+    share a home and a lifetime.
+    """
+    d = os.environ.get("LGBM_TPU_AUTOTUNE_DIR", "").strip()
+    if d:
+        return None if d.lower() in ("0", "off", "none") else d
+    cc = os.environ.get("LGBM_TPU_COMPILE_CACHE", "").strip() \
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR", "").strip()
+    if cc and cc.lower() not in ("0", "off", "none"):
+        return os.path.join(cc, "autotune")
+    return None
+
+
+def shape_bucket_key(rows: int, features: int, num_bins: int,
+                     quant: bool, round_width: int) -> str:
+    """Store key: the shape-bucket a measurement generalizes over.
+
+    Rows go through ``bucket_rows`` so a 1.05M-row run reuses the
+    1M-bucket measurement — exact-shape keys would never warm up.
+    """
+    return (f"r{bucket_rows(rows)}-f{int(features)}-b{int(num_bins)}"
+            f"-q{int(bool(quant))}-w{int(round_width)}")
+
+
+def _autotune_path(path=None):
+    d = path or autotune_dir()
+    return os.path.join(d, _AUTOTUNE_STORE_FILE) if d else None
+
+
+def _load_autotune_store(path=None) -> dict:
+    """{key: {variant: {"seconds": s, "params": {...}}}} — {} on ANY
+    problem: missing file, corrupt JSON, wrong version, wrong shape."""
+    p = _autotune_path(path)
+    if not p:
+        return {}
+    try:
+        with open(p, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) \
+                or doc.get("version") != AUTOTUNE_STORE_VERSION:
+            return {}
+        entries = doc.get("entries")
+        return entries if isinstance(entries, dict) else {}
+    except Exception:
+        return {}
+
+
+def record_timing(rows: int, features: int, num_bins: int, quant: bool,
+                  round_width: int, variant: str, seconds: float,
+                  params=None, path=None):
+    """Bank one measured (shape-bucket, variant) timing; returns the
+    store file path, or None when no store dir is configured.
+
+    Read-merge-write under the process lock, landed via
+    ``file_io.write_atomic`` so a crashed writer can never leave a torn
+    store for the next election to trip over.
+    """
+    p = _autotune_path(path)
+    if not p:
+        return None
+    from ..utils.file_io import write_atomic
+    key = shape_bucket_key(rows, features, num_bins, quant, round_width)
+    with _AUTOTUNE_LOCK:
+        entries = _load_autotune_store(path)
+        slot = dict(entries.get(key) or {})
+        slot[str(variant)] = {"seconds": float(seconds),
+                              "params": dict(params or {})}
+        entries[key] = slot
+        write_atomic(p, json.dumps(
+            {"version": AUTOTUNE_STORE_VERSION, "entries": entries},
+            indent=1, sort_keys=True))
+    return p
+
+
+def measured_election(rows, features, num_bins, quant, round_width,
+                      path=None):
+    """Fastest measured variant for this shape-bucket, or None (cold).
+
+    Returns {"key", "variant", "seconds", "params"}; a malformed entry
+    inside an otherwise-good slot is skipped, not fatal.
+    """
+    key = shape_bucket_key(rows, features, num_bins, quant, round_width)
+    slot = _load_autotune_store(path).get(key)
+    if not isinstance(slot, dict):
+        return None
+    best_v, best = None, None
+    for v, rec in slot.items():
+        try:
+            s = float(rec["seconds"])
+        except Exception:
+            continue
+        if s > 0 and (best is None or s < best["seconds"]):
+            params = rec.get("params")
+            best_v = str(v)
+            best = {"seconds": s,
+                    "params": params if isinstance(params, dict) else {}}
+    if best_v is None:
+        return None
+    return {"key": key, "variant": best_v, **best}
+
+
+def autotune_counters(reset: bool = False) -> dict:
+    """Election-outcome counters {hits, misses, flips} since last reset."""
+    with _AUTOTUNE_LOCK:
+        out = dict(_AUTOTUNE_STATS)
+        if reset:
+            for k in _AUTOTUNE_STATS:
+                _AUTOTUNE_STATS[k] = 0
+    return out
+
+
+def autotune_last() -> dict:
+    """The most recent election's story (diagnose's cure feed)."""
+    with _AUTOTUNE_LOCK:
+        return dict(_AUTOTUNE_LAST)
+
+
+def _adoptable_methods(quant: bool):
+    """Measured staged variants plan_histograms may promote directly to
+    ``hist_method`` (must be names resolve_hist_method accepts for the
+    family; dispatch-level names like "sorted" steer via the family
+    verdict "staged" instead)."""
+    if quant:
+        return ("matmul_int8", "scatter_int")
+    return ("matmul", "matmul_f32", "scatter", "pallas")
+
+
 def plan_histograms(
     rows: int,
     features: int,
@@ -420,6 +637,65 @@ def plan_histograms(
         fp = plan_fused(kcap, num_bins, quant, with_parent=True,
                         vmem_bytes=vmem_bytes)
     variant = "fused" if fp is not None else _resolved_variant(method, quant)
+    analytic_variant = variant
+    elected_by, measured_variant, autotune_key = "analytic", "", ""
+    if autotune_enabled() and method == "auto":
+        # measured election: adopt the store's fastest variant for this
+        # shape-bucket when it is valid IN CONTEXT — fused only if the
+        # VMEM election ran and passed, staged names only within the
+        # right kernel family; anything else is a stale name → a miss.
+        autotune_key = shape_bucket_key(rows, features, num_bins, quant,
+                                        round_width)
+        m = measured_election(rows, features, num_bins, quant, round_width)
+        adopted = False
+        if m is not None:
+            measured_variant = m["variant"]
+            if measured_variant == "fused":
+                if fp is not None:
+                    adopted = True
+                    ft = int(m["params"].get("feat_tile") or 0)
+                    br = int(m["params"].get("block_rows") or 0)
+                    if ft > 0 and br > 0:
+                        # measured {feat_tile, block_rows} override the
+                        # analytic walk — but only if they still fit the
+                        # VMEM model (a store written on a bigger core
+                        # must not OOM this one)
+                        kcap = max(min(int(round_width),
+                                       int(num_leaves) - 1), 1)
+                        need = fused_vmem_bytes(kcap, num_bins, ft, br,
+                                                quant, True)
+                        lim = int(vmem_bytes if vmem_bytes is not None
+                                  else vmem_limit_bytes())
+                        if need <= int(lim * VMEM_HEADROOM):
+                            fp = {"feat_tile": ft, "block_rows": br,
+                                  "vmem_bytes": need,
+                                  "vmem_limit_bytes": lim}
+            elif measured_variant == "staged":
+                # family-level verdict: the staged arm measured faster
+                # than the fused kernel here — decline fused even when
+                # its arena fits
+                adopted = True
+                fp = None
+                variant = _resolved_variant("auto", quant)
+            elif measured_variant in _adoptable_methods(quant):
+                adopted = True
+                fp = None
+                variant = measured_variant
+        elected = "fused" if fp is not None else variant
+        with _AUTOTUNE_LOCK:
+            if adopted:
+                _AUTOTUNE_STATS["hits"] += 1
+                elected_by = "measured"
+                if elected != analytic_variant:
+                    _AUTOTUNE_STATS["flips"] += 1
+            else:
+                _AUTOTUNE_STATS["misses"] += 1
+            _AUTOTUNE_LAST.clear()
+            _AUTOTUNE_LAST.update(
+                key=autotune_key, analytic_variant=analytic_variant,
+                measured_variant=measured_variant or None,
+                measured_seconds=(m or {}).get("seconds"),
+                elected_by=elected_by, elected_variant=elected)
     narrow = bool(quant and quant_psum_narrow(rows * machines, quant_bins))
     # the fused grower never hoists the pack_cols_u32 record arena (it
     # gathers nothing), so its plan must not charge — or report — it
@@ -447,7 +723,9 @@ def plan_histograms(
             fused_feat_tile=fp["feat_tile"] if fp else 0,
             fused_block_rows=fp["block_rows"] if fp else 0,
             fused_vmem_bytes=fp["vmem_bytes"] if fp else 0,
-            vmem_limit_bytes=fp["vmem_limit_bytes"] if fp else 0)
+            vmem_limit_bytes=fp["vmem_limit_bytes"] if fp else 0,
+            elected_by=elected_by, measured_variant=measured_variant,
+            autotune_key=autotune_key)
 
     if forced is not None:
         if forced == 0 or forced >= rows:
@@ -509,6 +787,12 @@ def apply_plan(cfg, rows: int, features: int, accel: Optional[bool] = None,
                 f"(limit {vmem_limit_bytes()} bytes; LGBM_TPU_VMEM_BYTES "
                 "overrides); falling back to the staged kernel family")
         cfg = cfg._replace(hist_method="auto")
+    elif (plan.elected_by == "measured" and cfg.hist_method == "auto"
+          and plan.variant in _adoptable_methods(cfg.quant)):
+        # measured election of a staged POINT kernel: promote it so the
+        # dispatch sites run what the stopwatch picked, not what "auto"
+        # resolves to ("staged"/"sorted" family verdicts stay on auto)
+        cfg = cfg._replace(hist_method=plan.variant)
     return cfg, plan
 
 

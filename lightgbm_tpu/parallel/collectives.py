@@ -4,7 +4,12 @@ The reference's ``Network`` layer (src/network/) moves histogram payloads
 over ONE transport; a TPU pod has TWO with a ~10-50x bandwidth gap
 between them: the intra-slice ICI torus and the cross-host DCN
 (PAPER.md §2.6).  Every reduction in the sharded growers routes through
-this module so one policy decides how a payload crosses the ladder:
+this module so one policy decides how a payload crosses the ladder —
+including the fused megakernel's collective seam (ops/fused.py): the
+sharded fused path accumulates smaller-child hists in VMEM, reduces
+exactly those through these tiers, and scans the reduced arena
+in-kernel, so only hists ever cross the wire and the routing (hence the
+integer-payload bit-pattern) is identical to the staged arm's:
 
 - **flat** — one ``lax.psum`` over every data axis at once (the XLA
   runtime picks the schedule).  Correct everywhere; on a multi-slice

@@ -117,17 +117,21 @@ def make_sharded_grower(
             "group layout (GBDT._build_group_sharding); train through the "
             "engine (lgb.train with tree_learner=feature) or disable "
             "bundling for this standalone grower")
-    if cfg.hist_method == "fused":
-        # recorded design exclusion: the fused megakernel scans LOCAL
-        # histograms in VMEM, but exact data-parallel training must psum
-        # the GLOBAL histogram before any gain is computed (gains are not
-        # summable across shards) — so sharded growth stays on the staged
-        # family.  The growers would gate this off anyway; resolving here
-        # keeps the planner's variant model honest too.
+    if cfg.hist_method == "fused" and feature_axis:
+        # recorded design exclusion: under FEATURE sharding each shard
+        # owns different columns and the winner is elected by a pmax
+        # gather over per-shard SplitResults — the fused kernel's
+        # in-kernel scan + writeback layout doesn't ride that exchange,
+        # so feature-parallel growth stays on the staged family.  DATA
+        # sharding keeps fused: the rounds grower splits the kernel at
+        # the collective seam (accumulate → psum of the smaller-child
+        # hists → sibling-derive + scan on the reduced arena,
+        # grower_rounds.py) — gains never cross the wire, exactly like
+        # the staged arm.
         from ..utils.log import log_info
-        log_info("hist_method=fused is a single-shard arm (the in-kernel "
-                 "gain scan needs the global histogram); sharded growth "
-                 "uses the staged kernel family")
+        log_info("hist_method=fused is not a feature-parallel arm (the "
+                 "winner exchange moves SplitResults, not histograms); "
+                 "feature-sharded growth uses the staged kernel family")
         cfg = cfg._replace(hist_method="auto")
     row_spec = P(data_axis) if data_axis else P()
     binned_spec = (P(feature_axis, data_axis) if feature_axis
@@ -146,7 +150,8 @@ def make_sharded_grower(
             # binned_t here is already the device slice
             from ..ops.planner import apply_plan
             run_cfg, plan = apply_plan(cfg, int(binned_t.shape[1]),
-                                       int(binned_t.shape[0]))
+                                       int(binned_t.shape[0]),
+                                       fused_ok=(feature_axis is None))
             if not plan.feasible:
                 from ..utils.log import log_warning
                 log_warning(

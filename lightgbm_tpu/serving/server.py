@@ -151,6 +151,11 @@ class Server:
             raise ValueError("pass either config or keyword overrides")
         self.config = config
         self.metrics = MetricsRegistry()
+        # serving publishes its own warmth gauges (family-keyed, counted
+        # off the AOT export store) so training's cold-start bar stays
+        # attributable (utils/platform.py)
+        from ..utils.platform import enable_compile_cache
+        enable_compile_cache(family="serving")
         self.ladder = BucketLadder(config.min_bucket_rows,
                                    config.max_batch_rows)
         self.programs = ProgramRegistry(self.metrics,

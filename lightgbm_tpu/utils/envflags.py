@@ -51,6 +51,19 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
     # ------------------------------------------------ kernel/planner gates
     _f("LGBM_TPU_FUSED", "1", "ops/fused.py",
        "fused histogram->split megakernel eligibility ('0' disables)", _PERF),
+    _f("LGBM_TPU_SHARED_FRONTIER", "1", "ops/fused.py",
+       "sharded fused training reuses ONE accumulate program for root "
+       "and every level ('0' disables)", _PERF),
+    _f("LGBM_TPU_AUTOTUNE", "1", "ops/planner.py",
+       "measured-timings kernel election ('0' = analytic model only)",
+       _PERF),
+    _f("LGBM_TPU_AUTOTUNE_DIR", "", "ops/planner.py",
+       "measured-timings store dir (default: <compile cache>/autotune)",
+       _PERF),
+    _f("LGBM_TPU_SHAPE_BUCKETS", "", "ops/planner.py",
+       "pad training rows to ladder rungs so nearby sizes share one "
+       "compiled program ('1' on, '0' off; default: accelerators only)",
+       _PERF),
     _f("LGBM_TPU_SEGHIST", "", "ops/histogram.py",
        "force a histogram kernel family, bypassing the planner", _PERF),
     _f("LGBM_TPU_TABLE_MATMUL", "", "ops/histogram.py",

@@ -80,7 +80,7 @@ def _cache_dir() -> str:
     return os.path.join(_repo_root(), ".jax_cache", _host_tag())
 
 
-def enable_compile_cache(path=None):
+def enable_compile_cache(path=None, family=None):
     """Wire the persistent XLA compilation cache for THIS process.
 
     ``LGBM_TPU_COMPILE_CACHE=<dir>`` (or an explicit ``path``) points the
@@ -91,6 +91,19 @@ def enable_compile_cache(path=None):
     and a no-op when neither the env var nor ``path`` is set (the
     JAX_COMPILATION_CACHE_DIR env route still works independently).
 
+    ``family`` ("train", "serving", ...) keys the warmth GAUGES by
+    program family so the cold-start bar is attributable: before this,
+    ``compile_cache.entries_before`` counted training XLA JIT blobs and
+    serving AOT exports (``<dir>/serving``, fleet/aot.py) in one
+    number, and a serving-only prior run made a training cold start
+    report ``warm_start=true``.  The JIT blob pool itself stays ONE
+    shared directory (XLA keys blobs by program hash, so planes cannot
+    collide — and moving the pool would cold-start every existing
+    cache); attribution is by entry CLASS: the train family's warmth
+    counts JIT blobs only, the serving family's counts its AOT export
+    store, and the reserved subtrees (``serving/``, ``autotune/``)
+    never inflate another family's count.
+
     Returns the active cache dir, or None when disabled.
     """
     d = path or os.environ.get("LGBM_TPU_COMPILE_CACHE", "").strip()
@@ -99,13 +112,25 @@ def enable_compile_cache(path=None):
     try:
         os.makedirs(d, exist_ok=True)
         # cache warmth on the unified registry: entries found at wiring
-        # time discriminate cold vs warm starts (docs/OBSERVABILITY.md)
+        # time discriminate cold vs warm starts (docs/OBSERVABILITY.md),
+        # keyed by family when one is named so the cold-start bar is
+        # attributable
         try:
             from ..obs.metrics import global_registry
             entries = compile_cache_entries(d)
             global_registry.gauge("compile_cache_entries_at_init").set(
                 entries)
             global_registry.gauge("compile_cache_warm").set(entries > 0)
+            if family:
+                fam_entries = entries
+                if family == "serving":
+                    fam_entries = compile_cache_entries_by_family(d).get(
+                        "serving_aot", 0)
+                global_registry.gauge(
+                    f"compile_cache_entries_at_init:{family}").set(
+                        fam_entries)
+                global_registry.gauge(
+                    f"compile_cache_warm:{family}").set(fam_entries > 0)
         except Exception:
             pass
         import jax
@@ -124,17 +149,60 @@ def enable_compile_cache(path=None):
         return None
 
 
+# reserved non-JIT subtrees of the cache dir: the serving AOT export
+# store (fleet/aot.py) and the autotuner's timing store (ops/planner.py)
+# live BESIDE the XLA blob pool and must never count as JIT warmth
+_CACHE_RESERVED_SUBDIRS = ("serving", "autotune")
+
+
 def compile_cache_entries(path=None):
-    """Number of banked cache files under the active cache dir (0 when
-    disabled/missing) — bench.py's cold-vs-warm discriminator."""
+    """Number of banked XLA JIT blobs under the active cache dir (0 when
+    disabled/missing) — bench.py's cold-vs-warm discriminator.
+
+    Counts the JIT pool ONLY: the reserved ``serving/`` (AOT exports)
+    and ``autotune/`` (timing store) subtrees are excluded, so a
+    serving-only or probe-only prior run can no longer make a training
+    cold start report warm (the family-attribution bugfix)."""
     d = path or os.environ.get("LGBM_TPU_COMPILE_CACHE", "").strip() \
         or os.environ.get("JAX_COMPILATION_CACHE_DIR", "").strip()
     if not d or not os.path.isdir(d):
         return 0
     try:
-        return sum(len(files) for _, _, files in os.walk(d))
+        total = 0
+        for root, dirs, files in os.walk(d):
+            if root == d:
+                dirs[:] = [s for s in dirs
+                           if s not in _CACHE_RESERVED_SUBDIRS]
+            total += len(files)
+        return total
     except OSError:
         return 0
+
+
+def compile_cache_entries_by_family(path=None):
+    """Entry counts under the active cache dir, keyed by what each entry
+    IS: ``jit`` for the shared XLA blob pool, ``serving_aot`` for the
+    exported-program store (``<dir>/serving``, fleet/aot.py) and
+    ``autotune`` for the planner's measured-timings store.  {} when the
+    cache is disabled or missing — the attributable form of
+    ``compile_cache_entries`` the bench journals per stage."""
+    d = path or os.environ.get("LGBM_TPU_COMPILE_CACHE", "").strip() \
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR", "").strip()
+    if not d or d.lower() in ("0", "off", "none") or not os.path.isdir(d):
+        return {}
+
+    def count(p):
+        try:
+            return sum(len(files) for _, _, files in os.walk(p))
+        except OSError:
+            return 0
+
+    out = {"jit": compile_cache_entries(d)}
+    for name, key in (("serving", "serving_aot"), ("autotune", "autotune")):
+        sub = os.path.join(d, name)
+        if os.path.isdir(sub):
+            out[key] = count(sub)
+    return out
 
 
 def force_cpu_inprocess(n_devices: int = 8) -> None:

@@ -295,27 +295,55 @@ def test_fused_engine_f32_predictions_close():
                                rtol=1e-4, atol=1e-6)
 
 
-def test_fused_gate_falls_back_for_unsupported_modes():
-    """Contexts outside the fused arm (categorical features, monotone
-    constraints, extra_trees) must warn/fall back and still train."""
+def test_fused_gate_lifted_monotone_and_categorical():
+    """Monotone constraints and categorical features now RIDE the fused
+    arm (monotone bounds thread into the in-kernel scan; per-category
+    stats are the same segment reduction + pick_fused_best's cat merge)
+    — the grower config must KEEP hist_method=fused and still train.
+    Contexts genuinely outside the arm (extra_trees' per-node
+    randomness) still warn/fall back."""
     rng = np.random.RandomState(8)
     X = rng.randn(800, 5).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float32)
-    ds = lgb.Dataset(X, label=y)
-    bst = lgb.train(
-        dict(objective="binary", num_leaves=7, verbose=-1,
-             tpu_hist_method="fused",
-             monotone_constraints=[1, 0, 0, 0, 0]),
-        ds, num_boost_round=3)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.Booster(
+        params=dict(objective="binary", num_leaves=7, verbosity=-1,
+                    tpu_hist_method="fused",
+                    monotone_constraints=[1, 0, 0, 0, 0]),
+        train_set=ds)
+    assert bst.boosting.grower_cfg.hist_method == "fused"
+    for _ in range(3):
+        bst.update()
     assert bst.num_trees() == 3
-    # categorical gate
+    # categorical rides the fused arm under the rounds grower
     Xc = np.column_stack([rng.randint(0, 6, 800), X[:, 1:]]).astype(
         np.float32)
-    ds2 = lgb.Dataset(Xc, label=y, categorical_feature=[0])
-    bst2 = lgb.train(dict(objective="binary", num_leaves=7, verbose=-1,
-                          tpu_hist_method="fused"),
-                     ds2, num_boost_round=3)
+    ds2 = lgb.Dataset(Xc, label=y, categorical_feature=[0],
+                      free_raw_data=False)
+    bst2 = lgb.Booster(
+        params=dict(objective="binary", num_leaves=7, verbosity=-1,
+                    tpu_hist_method="fused", tpu_tree_growth="rounds"),
+        train_set=ds2)
+    assert bst2.boosting.grower_cfg.hist_method == "fused"
+    for _ in range(3):
+        bst2.update()
     assert bst2.num_trees() == 3
+    # the SERIAL grower keeps its narrower gate for categorical
+    bst3 = lgb.Booster(
+        params=dict(objective="binary", num_leaves=7, verbosity=-1,
+                    tpu_hist_method="fused", tpu_tree_growth="serial"),
+        train_set=lgb.Dataset(Xc, label=y, categorical_feature=[0],
+                              free_raw_data=False))
+    assert bst3.boosting.grower_cfg.hist_method != "fused"
+    # extra_trees stays a genuine fallback (per-node randomized bins)
+    bst4 = lgb.Booster(
+        params=dict(objective="binary", num_leaves=7, verbosity=-1,
+                    tpu_hist_method="fused", extra_trees=True),
+        train_set=lgb.Dataset(X, label=y, free_raw_data=False))
+    assert bst4.boosting.grower_cfg.hist_method != "fused"
+    for _ in range(2):
+        bst4.update()
+    assert bst4.num_trees() == 2
 
 
 def test_fused_auto_elects_on_accelerator(monkeypatch):
@@ -395,10 +423,12 @@ def test_fused_apply_plan_threading():
     assert not plan3.fused and cfg3.hist_method == "auto"
 
 
-def test_fused_sharded_grower_downgrades():
-    """make_sharded_grower resolves hist_method=fused to the staged
-    family (the in-kernel scan needs the global histogram) and the
-    payload accounting helpers stay in lockstep with the writeback."""
+def test_fused_sharded_grower_data_keeps_feature_downgrades():
+    """DATA sharding now KEEPS hist_method=fused (the rounds grower
+    splits the kernel at the collective seam, grower_rounds.py); only
+    FEATURE sharding resolves fused to the staged family (the winner
+    exchange moves SplitResults, not histograms).  The payload
+    accounting helpers stay in lockstep with the writeback."""
     from lightgbm_tpu.parallel.learners import fused_best_payload_bytes
     assert fused_best_payload_bytes(28) == 6 * 28 * 4
     assert FU.hist_scan_traffic_bytes(8, 28, 64) == 8 * 3 * 28 * 64 * 4 * 4
@@ -422,6 +452,121 @@ def test_fused_sharded_grower_downgrades():
             np.ones(n, np.float32))
         tree, leaf_id = grower(bt, gg, hh, mm)
         assert int(tree.num_leaves) >= 2
+
+
+def test_fused_seam_halves_equal_combined():
+    """The collective seam (grower_rounds.py's sharded arm): accumulate
+    → identity reduce → standalone sibling-derive+scan must reproduce
+    the single-program ``fused_frontier_splits`` exactly — quant
+    BIT-identical (hist and every best-tuple field), f32 scan-exact —
+    with monotone constraints and child bounds threaded through both."""
+    n, F, B, K = 2500, 6, 16, 3
+    binned, g, h, w, slot = _data(seed=5, n=n, F=F, B=B, K=K)
+    member = w > 0
+    nb = jnp.full((F,), B, jnp.int32)
+    zz = jnp.zeros((F,), jnp.int32)
+    mono = jnp.asarray([1, -1, 0, 0, 1, 0], jnp.int32)
+    NC = 2 * K
+    bounds = (jnp.full((NC,), -4.0, jnp.float32),
+              jnp.full((NC,), 4.0, jnp.float32))
+    small_left = jnp.asarray([True, False, True])
+
+    # quant: parent = small-child rows plus extra rows (a real histogram)
+    gq, hq, gs, hs = H.quantize_gradients(g, h, w, 8, jax.random.PRNGKey(3))
+    slot_w = jnp.where(member, slot, K)
+    rng = np.random.RandomState(6)
+    extra = jnp.asarray(
+        np.where((np.asarray(slot_w) == K) & (rng.rand(n) < 0.5),
+                 rng.randint(0, K, n), K), jnp.int32)
+    slot_parent = jnp.where(slot_w < K, slot_w, extra)
+    parent = H.segment_histogram_int(binned, gq, hq, member, slot_parent,
+                                     K, B, levels=H.quant_levels(8))
+    small = H.segment_histogram_int(binned, gq, hq, member, slot_w, K, B,
+                                    levels=H.quant_levels(8))
+    h_left = jnp.where(small_left[:, None, None, None], small,
+                       parent - small)
+    children = jnp.concatenate([h_left, parent - h_left])
+    csums = jnp.stack([
+        children[:, 0].sum((-1, -2)).astype(jnp.float32) / F * gs,
+        children[:, 1].sum((-1, -2)).astype(jnp.float32) / F * hs,
+        children[:, 1, 0, :].sum(-1).astype(jnp.float32)])
+    vals = H._vals_t_int(gq, hq, member)
+    fh_c, fb_c = FU.fused_frontier_splits(
+        binned, vals, slot_w, K, B, csums, small_left, parent,
+        nb, zz, zz, _HP, quant_scales=(gs, hs),
+        monotone_constraints=mono, child_bounds=bounds)
+    # the seam: local accumulate, (identity) collective, epilogue scan
+    from lightgbm_tpu.parallel.collectives import psum_int_tiered
+    acc = FU.fused_frontier_accumulate(binned, vals, slot_w, K, B)
+    acc = psum_int_tiered(acc, None)          # unsharded degenerate tier
+    fb_s = FU.fused_sibling_scan(
+        acc, csums, nb, zz, zz, _HP, small_left=small_left,
+        parent_hist=parent, quant_scales=(gs, hs),
+        monotone_constraints=mono, child_bounds=bounds)
+    assert np.array_equal(np.asarray(acc), np.asarray(fh_c))
+    assert np.array_equal(np.asarray(acc), np.asarray(small))
+    for name in fb_c._fields:
+        assert np.array_equal(np.asarray(getattr(fb_s, name)),
+                              np.asarray(getattr(fb_c, name))), name
+
+    # f32 twin (same seam, float arena): scan parity is exact because
+    # both arms scan the SAME reduced histogram with the shared body
+    smallf = H.segment_histogram(binned, g, h, w, slot_w, K, B)
+    parentf = H.segment_histogram(binned, g, h, w, slot_parent, K, B)
+    h_lf = jnp.where(small_left[:, None, None, None], smallf,
+                     parentf - smallf)
+    chf = jnp.concatenate([h_lf, parentf - h_lf])
+    csf = jnp.stack([chf[:, 0].sum((-1, -2)) / F,
+                     chf[:, 1].sum((-1, -2)) / F,
+                     chf[:, 2].sum((-1, -2)) / F])
+    valsf = H._vals_t(g, h, w)
+    fh_cf, fb_cf = FU.fused_frontier_splits(
+        binned, valsf, slot_w, K, B, csf, small_left, parentf,
+        nb, zz, zz, _HP, monotone_constraints=mono, child_bounds=bounds)
+    from lightgbm_tpu.parallel.collectives import psum_tiered
+    accf = psum_tiered(FU.fused_frontier_accumulate(
+        binned, valsf, slot_w, K, B), None)
+    fb_sf = FU.fused_sibling_scan(
+        accf, csf, nb, zz, zz, _HP, small_left=small_left,
+        parent_hist=parentf, monotone_constraints=mono,
+        child_bounds=bounds)
+    np.testing.assert_allclose(np.asarray(accf), np.asarray(fh_cf),
+                               rtol=1e-5, atol=2e-3)
+    sg, fg = np.asarray(fb_cf.gain), np.asarray(fb_sf.gain)
+    finite = np.isfinite(sg) & np.isfinite(fg)
+    assert (np.isfinite(sg) == np.isfinite(fg)).all()
+    np.testing.assert_allclose(fg[finite], sg[finite], rtol=1e-4)
+
+
+def test_fused_monotone_scan_matches_staged():
+    """The lifted monotone gate: the in-kernel scan with constraints +
+    child bounds must equal the shared ``numeric_feature_scan`` given
+    the same arguments — bit-identical on the kernel's own hists."""
+    n, F, B, K = 2000, 5, 16, 3
+    binned, g, h, w, slot = _data(seed=7, n=n, F=F, B=B, K=K)
+    seg_ref = H.segment_histogram(binned, g, h, w, slot, K, B)
+    sums = _slot_sums(seg_ref)
+    nb = jnp.full((F,), B, jnp.int32)
+    zz = jnp.zeros((F,), jnp.int32)
+    mono = jnp.asarray([1, -1, 0, 1, -1], jnp.int32)
+    bounds = (jnp.full((K,), -2.0, jnp.float32),
+              jnp.full((K,), 2.0, jnp.float32))
+    fh, fb = FU.fused_segment_splits(
+        binned, H._vals_t(g, h, w), slot, K, B, sums, nb, zz, zz, _HP,
+        monotone_constraints=mono, child_bounds=bounds)
+    ref = numeric_feature_scan(fh, sums[0], sums[1], sums[2], nb, zz, zz,
+                               _HP, monotone_constraints=mono,
+                               leaf_output_bounds=bounds)
+    for name in ref._fields:
+        assert np.array_equal(np.asarray(getattr(fb, name)),
+                              np.asarray(getattr(ref, name))), name
+    # constraints actually bit: the constrained election must differ
+    # from the unconstrained scan somewhere (gain or threshold)
+    fb_un = FU.fused_segment_splits(
+        binned, H._vals_t(g, h, w), slot, K, B, sums, nb, zz, zz, _HP)[1]
+    assert (not np.array_equal(np.asarray(fb_un.gain), np.asarray(fb.gain))
+            or not np.array_equal(np.asarray(fb_un.threshold),
+                                  np.asarray(fb.threshold)))
 
 
 def test_fused_probe_json():

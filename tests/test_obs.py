@@ -391,11 +391,14 @@ def test_devprof_histogram_table_small():
                                     slots=4, reps=1, quant=True)
     keys = [k for k in t if "/" in k]
     # the full family x {f32, quant} x {untiled, tiled}, incl. the
-    # Pallas rows (bin-only VPU kernel + fused megakernel) and the
-    # 8-lane model-axis row (f32/scatter_batched8)
-    assert len(keys) == 26
+    # Pallas rows (bin-only VPU kernel + fused megakernel), the 8-lane
+    # model-axis row (f32/scatter_batched8) and the collective-seam
+    # rows (accumulate → {flat, hierarchical} reduce → sibling scan)
+    assert len(keys) == 34
     for fam in ("f32/pallas", "f32/fused", "quant/fused",
-                "f32/scatter_batched8"):
+                "f32/scatter_batched8", "f32/fused_sharded_flat",
+                "f32/fused_sharded_hier", "quant/fused_sharded_flat",
+                "quant/fused_sharded_hier"):
         assert f"{fam}/untiled" in t and f"{fam}/tiled" in t
     for k in keys:
         v = t[k]

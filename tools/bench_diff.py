@@ -56,6 +56,13 @@ THRESHOLDS = {
     "auc": ("higher_abs", 0.005),
     "ndcg10": ("higher_abs", 0.005),
     "mfu_histogram_lower_bound": ("higher", 2.0),
+    # autotuner election quality (hist_probe stage, ``autotune.*``):
+    # fewer store hits or more misses/flips than the baseline run means
+    # the measured-election path lost warmth or the analytic model and
+    # the stopwatch started disagreeing — both worth failing loudly
+    "autotune_hits": ("higher", 1.5),
+    "autotune_misses": ("lower", 1.5),
+    "autotune_flips": ("lower", 1.5),
 }
 # a tiny absolute floor below which timing ratios are noise, not signal
 ABS_FLOOR = {"compile_seconds": 0.5, "bin_seconds": 0.5, "elapsed": 1.0}

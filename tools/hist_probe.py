@@ -33,6 +33,16 @@ sec/level, HBM ``bytes_accessed`` from the compiler's cost model
 accounting drop (``hist_scan_traffic_bytes``: the [ch, F, B] scan
 re-read + sibling write/read the fused kernel never performs).
 
+Autotune column (``--autotune``, default on; ``--no-autotune`` skips;
+needs the fused column): banks the measured staged/fused sec-per-level
+into the planner's timing store (ops/planner.py autotuner), then runs
+the kernel election cold and warm so the journal shows the
+analytic-elected vs measured-elected variant side by side with the
+sec/level backing each, names the winner, and reports
+``autotune_{hits,misses,flips}`` for bench_diff's election-quality gate.
+Reports ``skipped`` when no store dir is configured
+(``LGBM_TPU_AUTOTUNE_DIR`` / ``LGBM_TPU_COMPILE_CACHE``).
+
 The LAST stdout line is a single JSON object so bench.py's worker can
 bank it as a stage (``stage: hist_probe``, wired next to
 ``dispatch_probe``; ``BENCH_SKIP_HIST_PROBE=1`` skips the stage).
@@ -188,8 +198,62 @@ def fused_probe(binned_t, grad, hess, ones, B, reps, leaves=255,
     return out
 
 
+def autotune_probe(fused_result, rows, features, B, leaves) -> dict:
+    """--autotune column: analytic-elected vs measured-elected variant.
+
+    Feeds the fused column's measured staged/fused sec-per-level into
+    the planner's persistent timing store (``record_timing``), running
+    the election BEFORE the write (cold start or a prior run's
+    measurements) and AFTER it (guaranteed warm), so the probe reports
+    what the analytic model picks, what the stopwatch picks, the
+    sec/level behind each, and the hit/miss/flip counters the bench
+    stage journals for ``bench_diff``'s election-quality gate.
+    """
+    from lightgbm_tpu.ops import planner as P
+
+    out = {"enabled": P.autotune_enabled(), "store_dir": P.autotune_dir()}
+    if not (P.autotune_enabled() and P.autotune_dir()):
+        out["skipped"] = ("no autotune store configured: set "
+                          "LGBM_TPU_AUTOTUNE_DIR or LGBM_TPU_COMPILE_CACHE")
+        return out
+    staged = fused_result.get("staged", {})
+    fus = fused_result.get("fused", {})
+    if "error" in staged or "error" in fus:
+        out["skipped"] = "staged or fused arm did not run"
+        return out
+    P.autotune_counters(reset=True)
+    cold = P.plan_histograms(rows, features, B, num_leaves=leaves,
+                             method="auto", fused_ok=True)
+    P.record_timing(rows, features, B, False, 128, "staged",
+                    staged["sec_per_level"])
+    P.record_timing(rows, features, B, False, 128, "fused",
+                    fus["sec_per_level"],
+                    params={"feat_tile": cold.fused_feat_tile,
+                            "block_rows": cold.fused_block_rows}
+                    if cold.fused else None)
+    warm = P.plan_histograms(rows, features, B, num_leaves=leaves,
+                             method="auto", fused_ok=True)
+    last = P.autotune_last()
+    counters = P.autotune_counters()
+    sec = {"staged": staged["sec_per_level"], "fused": fus["sec_per_level"]}
+    out.update({
+        "shape_bucket": warm.autotune_key,
+        "analytic_variant": last.get("analytic_variant"),
+        "measured_variant": last.get("measured_variant"),
+        "elected_by": warm.elected_by,
+        "elected_variant": last.get("elected_variant"),
+        "winner": min(sec, key=sec.get),
+        "sec_per_level": sec,
+        "autotune_hits": counters["hits"],
+        "autotune_misses": counters["misses"],
+        "autotune_flips": counters["flips"],
+    })
+    return out
+
+
 def run_probe(rows=1_000_000, features=28, max_bin=63, quant_bins=4,
-              leaves=255, reps=5, tiles=None, fused=True) -> dict:
+              leaves=255, reps=5, tiles=None, fused=True,
+              autotune=True) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -274,6 +338,12 @@ def run_probe(rows=1_000_000, features=28, max_bin=63, quant_bins=4,
             fb = binned_t[:, :200_000]
             fg, fh, fo = grad[:200_000], hess[:200_000], ones[:200_000]
         out["fused"] = fused_probe(fb, fg, fh, fo, B, reps, leaves=leaves)
+        # the autotune column keys the store by the shape the stopwatch
+        # actually measured (the capped one off-accelerator)
+        out["fused"]["rows_measured"] = int(fb.shape[1])
+        if autotune:
+            out["autotune"] = autotune_probe(
+                out["fused"], int(fb.shape[1]), features, B, leaves)
 
     out.update({
         "reps": reps,
@@ -314,12 +384,18 @@ def main():
                     default=True,
                     help="fused megakernel vs staged column (default on; "
                          "--no-fused skips)")
+    ap.add_argument("--autotune", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="measured-vs-analytic election column (default "
+                         "on; needs --fused and a configured timing "
+                         "store; --no-autotune skips)")
     args = ap.parse_args()
     tiles = None
     if args.tile_sweep:
         tiles = [max(int(v), 0) for v in args.tile_sweep.split(",") if v]
     out = run_probe(args.rows, args.features, args.max_bin, args.quant_bins,
-                    args.leaves, args.reps, tiles=tiles, fused=args.fused)
+                    args.leaves, args.reps, tiles=tiles, fused=args.fused,
+                    autotune=args.autotune)
     print(json.dumps(out))
     return 0
 
