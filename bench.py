@@ -91,6 +91,17 @@ host+HBM verdict banks as the "stream_plan" stage and the run
 journals planner-predicted vs measured peaks on BOTH memories;
 LGBM_TPU_STREAM / LGBM_TPU_STREAM_BLOCK_ROWS / LGBM_TPU_HOST_BYTES
 steer the election);
+inference kernels (ops/predict_kernels.py): BENCH_SKIP_PREDICT_PROBE=1
+skips the traversal micro-bench (tools/predict_probe.py: while vs fori
+vs fused sec/Mrow + measured MFU/BW, the plan_predict election cold and
+warm against the autotune store's "p-..." family, serving bit-parity;
+accelerators raise below the 3x-vs-while bar at 1M rows),
+BENCH_SKIP_BULK_SCORE=1 skips the bulk offline-scoring stage
+(tools/bulk_score.py: a BENCH_BULK_ROWS-row — default 10M — synthetic
+blockstore streamed through the AOT bulk bucket with per-block score
+commits and a resume-after-kill byte-identity drill;
+LGBM_TPU_PREDICT_KERNEL / LGBM_TPU_PREDICT_CHUNK /
+LGBM_TPU_PREDICT_EPILOGUE steer the predict election itself);
 BENCH_SKIP_SWEEP=1 skips the batched model-axis sweep micro-bench
 (tools/sweep_probe.py: the SAME macro-chunk body solo vs vmapped at
 B in {2,4,8} heterogeneous lanes over one shared binned matrix —
@@ -1267,6 +1278,20 @@ def tpu_worker():
                             max_bin=MAX_BIN, leaves=LEAVES)
         run_stage("hist_probe", _hist)
 
+    # inference-kernel micro-bench (tools/predict_probe.py): while vs
+    # fori vs fused traversal sec/Mrow + measured MFU/BW, the planner's
+    # variant election cold/warm against the "p-..." autotune family,
+    # and the serving bit-parity check; on accelerators the probe raises
+    # below the 3x-vs-while bar at 1M rows, and errors are never
+    # journaled so a failed probe retries
+    if os.environ.get("BENCH_SKIP_PREDICT_PROBE") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+
+        def _predict_probe():
+            from predict_probe import run_probe as predict_run
+            return predict_run(rows=min(N, 1_000_000), features=F)
+        run_stage("predict_probe", _predict_probe)
+
     # out-of-core block-pump micro-bench (tools/stream_probe.py):
     # blocks/sec, device_put overlap efficiency, host-RSS peak vs the
     # two-level planner's prediction — cheap, banked early; errors are
@@ -1399,6 +1424,24 @@ def tpu_worker():
                 trees=int(os.environ.get("BENCH_STREAM_TREES", 3)),
                 leaves=min(LEAVES, 63), max_bin=MAX_BIN),
             key=f"stream@{stream_n}", budget_floor=1500)
+
+    # bulk offline scoring (data/score.py via tools/bulk_score.py): the
+    # blockstore pump pointed at inference — a >=10M-row synthetic set
+    # streamed through the one AOT bulk bucket, scores banked with
+    # per-block manifest commits, plus the crash drill (partial run,
+    # resume, byte-identical blocks).  The drill raises on any miss, so
+    # failed runs are never journaled; rows/sec/device and the
+    # predicted-vs-measured peaks on both memories are the banked
+    # numbers bench_diff gates on.
+    if os.environ.get("BENCH_SKIP_BULK_SCORE") != "1":
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        bulk_n = int(os.environ.get("BENCH_BULK_ROWS", 10_000_000))
+
+        def _bulk():
+            from bulk_score import run_bulk
+            return run_bulk(rows=bulk_n, features=F)
+        run_stage("bulk_score", _bulk, key=f"bulk_score@{bulk_n}",
+                  budget_floor=900)
 
     # MSLR-side benchmark (lambdarank + NDCG@10, BASELINE.md) with the
     # leftover budget — strictly after the headline number is banked

@@ -1,0 +1,397 @@
+"""Bulk offline scoring: the blockstore pump pointed at inference.
+
+Streamed training (data/stream.py) reads checksummed feature blocks
+through a double-buffered ``BlockPump`` to FOLD histograms; this module
+drives the same pump through fixed-shape ROUTING programs to score
+datasets that dwarf both memories — ROADMAP item 5(c)'s billion-row
+offline pass, the symmetric twin of out-of-core training:
+
+- **input** is a finalized ``BlockStore`` of raw ``[F, rows]`` float32
+  feature blocks (sha256-verified on read, torn writes surface loudly);
+- **programs** are the ONE block-sized bucket of the serving AOT family
+  (``fleet.aot.make_bulk_program``): a resumed run deserializes instead
+  of re-tracing, so a crash costs no recompile on restart;
+- **output** banks per-block ``[K, rows]`` float64 raw scores through a
+  ``ScoreSink`` whose manifest is atomically REWRITTEN after every
+  block — each rewrite is a commit point, so resume-after-kill skips
+  exactly the banked blocks and reproduces the rest byte-identically
+  (scores come off the same program + the serving epilogue, and f64
+  leaf accumulation is per-row independent — block boundaries cannot
+  change a single bit);
+- **placement** shards blocks across ``fleet.topology`` devices
+  ICI-before-DCN (``plan_block_shards``): the home slice fills first in
+  round-robin, spillover crosses the slow tier last — PV-Tree's
+  elect-before-you-ship rule applied to batch work distribution.
+
+Serving bit-parity contract: a banked block equals
+``DeviceForest.predict_raw_padded`` on the same rows exactly — the
+scorer routes through the SAME traversal program family and the SAME
+probed epilogue (device leaf-sum only where the one-time bit-exactness
+probe passed, ``predict.gather_leaf_sum`` on the host otherwise).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.metrics import global_registry as _obs_registry
+from ..obs.trace import instant as _instant, span as _span
+from ..utils.file_io import write_atomic
+from ..utils.log import log_info, log_warning
+from .blockstore import BlockStore
+from .stream import BlockPump, host_rss_peak_bytes
+
+SCORE_FORMAT = "lgbm_tpu.scorestore.v1"
+SCORE_MANIFEST = "score_manifest.json"
+
+
+class ScoreSinkError(RuntimeError):
+    """A score block's bytes do not match its manifest checksum, or an
+    existing sink's geometry contradicts the requested run."""
+
+
+def _sha256(buf) -> str:
+    return hashlib.sha256(buf).hexdigest()
+
+
+class ScoreSink:
+    """Directory of ``scores_NNNNN.bin`` float64 ``[K, rows]`` blocks
+    under an atomically rewritten manifest.
+
+    The write protocol inverts the BlockStore's: there the manifest is a
+    single commit point at ``finalize`` (a half-spilled store is
+    worthless), here every block is independently valuable, so
+    ``write_block`` lands the block file atomically and THEN rewrites
+    the whole manifest atomically — after a kill at any instant, the
+    manifest names exactly the blocks whose bytes are fully on disk, and
+    ``open_or_create`` on the same path resumes by skipping them.
+    """
+
+    def __init__(self, path: str, meta: dict):
+        self.path = str(path)
+        self.num_rows = int(meta["num_rows"])
+        self.num_class = int(meta["num_class"])
+        self.block_rows = int(meta["block_rows"])
+        self.num_blocks = int(meta["num_blocks"])
+        self.model_digest = str(meta["model_digest"])
+        self._blocks: Dict[int, dict] = {
+            int(k): v for k, v in meta.get("blocks", {}).items()}
+
+    @classmethod
+    def open_or_create(cls, path: str, num_rows: int, num_class: int,
+                       block_rows: int, num_blocks: int,
+                       model_digest: str) -> "ScoreSink":
+        """Open an existing sink (validating that it belongs to THIS
+        run's geometry and model — resuming someone else's scores would
+        silently interleave two models) or create an empty one."""
+        mp = os.path.join(path, SCORE_MANIFEST)
+        if os.path.exists(mp):
+            try:
+                with open(mp) as fh:
+                    meta = json.load(fh)
+            except (OSError, ValueError) as e:
+                raise ScoreSinkError(
+                    f"unreadable score manifest at {mp}: {e}") from e
+            if meta.get("format") != SCORE_FORMAT:
+                raise ScoreSinkError(
+                    f"{mp}: unknown score-sink format "
+                    f"{meta.get('format')!r}")
+            want = {"num_rows": int(num_rows), "num_class": int(num_class),
+                    "block_rows": int(block_rows),
+                    "num_blocks": int(num_blocks),
+                    "model_digest": str(model_digest)}
+            got = {k: (str(meta.get(k)) if k == "model_digest"
+                       else int(meta.get(k, -1))) for k in want}
+            if got != want:
+                raise ScoreSinkError(
+                    f"{mp}: existing sink disagrees with this run "
+                    f"(sink {got}, run {want}) — choose a fresh output "
+                    "directory or delete the stale one")
+            return cls(path, meta)
+        os.makedirs(path, exist_ok=True)
+        sink = cls(path, {
+            "num_rows": int(num_rows), "num_class": int(num_class),
+            "block_rows": int(block_rows), "num_blocks": int(num_blocks),
+            "model_digest": str(model_digest), "blocks": {}})
+        sink._write_manifest()
+        return sink
+
+    # -- manifest ----------------------------------------------------------
+
+    def _write_manifest(self) -> None:
+        write_atomic(os.path.join(self.path, SCORE_MANIFEST), json.dumps({
+            "format": SCORE_FORMAT, "num_rows": self.num_rows,
+            "num_class": self.num_class, "block_rows": self.block_rows,
+            "num_blocks": self.num_blocks,
+            "model_digest": self.model_digest,
+            "blocks": {str(k): self._blocks[k]
+                       for k in sorted(self._blocks)},
+        }, indent=1))
+
+    def banked(self) -> set:
+        """Block indices whose scores are committed on disk."""
+        return set(self._blocks)
+
+    @property
+    def complete(self) -> bool:
+        return len(self._blocks) == self.num_blocks
+
+    def nbytes(self) -> int:
+        return sum(int(b["size"]) for b in self._blocks.values())
+
+    # -- blocks ------------------------------------------------------------
+
+    def write_block(self, i: int, scores: np.ndarray) -> None:
+        """Bank block ``i``'s ``[K, rows]`` float64 scores: atomic block
+        file first, atomic manifest rewrite second (the commit point)."""
+        scores = np.ascontiguousarray(scores, np.float64)
+        if scores.ndim != 2 or scores.shape[0] != self.num_class:
+            raise ValueError(
+                f"expected [{self.num_class}, rows] scores for block {i}, "
+                f"got {scores.shape}")
+        raw = scores.tobytes()
+        name = f"scores_{int(i):05d}.bin"
+        write_atomic(os.path.join(self.path, name), raw)
+        self._blocks[int(i)] = {
+            "file": name, "rows": int(scores.shape[1]),
+            "sha256": _sha256(raw), "size": len(raw)}
+        self._write_manifest()
+
+    def read_block(self, i: int) -> np.ndarray:
+        """Block ``i`` as ``[K, rows]`` float64, checksum-verified."""
+        b = self._blocks.get(int(i))
+        if b is None:
+            raise ScoreSinkError(f"score block {i} is not banked")
+        fp = os.path.join(self.path, b["file"])
+        with open(fp, "rb") as fh:
+            raw = fh.read()
+        if len(raw) != int(b["size"]) or _sha256(raw) != b["sha256"]:
+            raise ScoreSinkError(
+                f"{fp}: checksum mismatch — the score bank is corrupt; "
+                "delete the block (or the sink) and re-run to re-score")
+        return np.frombuffer(raw, np.float64).reshape(
+            self.num_class, int(b["rows"])).copy()
+
+
+def plan_block_shards(num_blocks: int, devices: Sequence) -> Tuple[int, ...]:
+    """Assign each block a ``DeviceSpec.device_id`` round-robin in
+    ICI-before-DCN order: the coordinator's slice (the first device's)
+    fills first, remote slices take spillover last — the bulk analogue
+    of the serving router's device-local-first dispatch."""
+    devices = tuple(devices)
+    if not devices:
+        raise ValueError("plan_block_shards needs at least one device")
+    home = devices[0].slice_id
+    order = sorted(devices, key=lambda d: (d.slice_id != home,
+                                           d.slice_id, d.device_id))
+    return tuple(order[i % len(order)].device_id
+                 for i in range(max(int(num_blocks), 0)))
+
+
+class BulkScorer:
+    """Stream a feature BlockStore through one fixed-shape routing
+    program and bank raw scores with crash-resume (module docstring).
+
+    ``device_forest`` is a ``predict.DeviceForest`` (any precision /
+    variant — the program is its AOT export arm, so the scores are the
+    variant-independent routing verdict).  ``devices`` defaults to the
+    single local device; multi-device runs pass ``plan_devices(n)`` and
+    score only the blocks ``plan_block_shards`` assigns to
+    ``local_device_id`` — every participant resumes into the SAME sink,
+    whose per-block manifest commits make concurrent banking safe to
+    interleave at block granularity.
+    """
+
+    def __init__(self, device_forest, store: BlockStore, sink_path: str,
+                 num_class: int = 1, devices=None,
+                 local_device_id: int = 0, aot_store=None,
+                 ledger=None, digest: Optional[str] = None):
+        if store.dtype != np.dtype(np.float32):
+            raise ValueError(
+                f"bulk scoring expects a float32 feature store, got "
+                f"{store.dtype}")
+        self.dev = device_forest
+        self.store = store
+        self.sink_path = str(sink_path)
+        self.K = max(int(num_class), 1)
+        if devices is None:
+            from ..fleet.topology import plan_devices
+            devices = plan_devices(1)
+        self.devices = tuple(devices)
+        self.local_device_id = int(local_device_id)
+        self.aot_store = aot_store
+        self.ledger = ledger
+        if digest is None:
+            from ..serving.registry import forest_digest
+            digest = forest_digest(device_forest.forest)
+        self.digest = str(digest)
+
+    # -- device programs ---------------------------------------------------
+
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..fleet.aot import make_bulk_program
+        F = int(self.store.num_cols)
+        br = int(self.store.block_rows)
+        program, source = make_bulk_program(
+            self.dev, F, br, self.digest, self.aot_store)
+
+        # feature blocks arrive device-resident as [F, rows]; the routing
+        # program wants the padded row-major [block_rows, F] bucket shape
+        def prep(xb):
+            X = xb.T.astype(jnp.float32)
+            pad = br - X.shape[0]
+            return jnp.pad(X, ((0, pad), (0, 0))) if pad else X
+
+        return program, source, jax.jit(prep)
+
+    def _score_block(self, leaves_dev, rows: int) -> np.ndarray:
+        """Serving epilogue on one block's [T, block_rows] leaves: device
+        f32 sum only where the DeviceForest's one-time probe proved it
+        bit-exact, host f64 gather otherwise — predict_raw_padded's exact
+        decision, so banked scores == serving scores bit for bit."""
+        if self.dev.leaf_value is not None and \
+                self.dev._epilogue_verified(self.K):
+            raw = np.asarray(self.dev._leaf_sum_jit(leaves_dev, self.K),
+                             np.float64)
+            return raw[:, :rows]
+        from ..predict import gather_leaf_sum
+        leaves = np.asarray(leaves_dev)[:, :rows]
+        return gather_leaf_sum(self.dev.forest, leaves, self.K)
+
+    # -- residency ---------------------------------------------------------
+
+    def _predicted_peaks(self) -> Tuple[int, int]:
+        """(device, host) peak-byte predictions from the planner's byte
+        models: routing planes + one bucket program on device; the pump's
+        read-ahead window + one score block on host."""
+        from ..ops import planner as _planner
+        f = self.dev.forest
+        F = int(self.store.num_cols)
+        br = int(self.store.block_rows)
+        accel = None
+        dp = _planner.predict_forest_bytes(
+            num_trees=int(f.num_trees),
+            nodes_dim=int(f.split_feature.shape[1]),
+            leaves_dim=int(f.leaf_value.shape[1]),
+            precision=self.dev.precision,
+            cat_words=int(f.cat_words.size), accel=accel,
+            routing_only=self.dev.routing_only)
+        dp += _planner.predict_program_bytes(
+            num_trees=int(f.num_trees), bucket_rows=br, features=F,
+            accel=accel)
+        hp = 3 * F * br * 4 + self.K * br * 8
+        return int(dp), int(hp)
+
+    # -- the run -----------------------------------------------------------
+
+    def run(self, max_blocks: Optional[int] = None) -> dict:
+        """Score every un-banked block assigned to this device; returns
+        a stats dict.  ``max_blocks`` caps the number of blocks banked
+        THIS call (the crash-injection seam the resume tests kill at) —
+        a capped run exits cleanly with the sink partially committed,
+        exactly the state a SIGKILL between manifest rewrites leaves."""
+        import jax
+
+        nb = int(self.store.num_blocks)
+        sink = ScoreSink.open_or_create(
+            self.sink_path, int(self.store.num_rows), self.K,
+            int(self.store.block_rows), nb, self.digest)
+        shards = plan_block_shards(nb, self.devices)
+        mine = [i for i in range(nb) if shards[i] == self.local_device_id]
+        banked = sink.banked()
+        todo = [i for i in mine if i not in banked]
+        skipped = len(mine) - len(todo)
+        if max_blocks is not None:
+            todo = todo[:max(int(max_blocks), 0)]
+
+        program, source, prep = self._build_programs()
+        pred_dev, pred_host = self._predicted_peaks()
+        lease = None
+        if self.ledger is not None:
+            lease = self.ledger.try_lease(
+                f"bulk:{self.digest}", pred_dev, plane="serving")
+            if lease is None:
+                log_warning(
+                    "bulk scorer: residency ledger denied a "
+                    f"{pred_dev}-byte serving lease; scoring anyway — "
+                    "expect HBM pressure against the co-resident planes")
+
+        _instant("bulk.plan", blocks=nb, mine=len(mine), skipped=skipped,
+                 todo=len(todo), program=source,
+                 predicted_device_peak_bytes=pred_dev,
+                 predicted_host_peak_bytes=pred_host)
+        rows_scored = 0
+        blocks_scored = 0
+        t0 = time.perf_counter()
+        try:
+            with _span("bulk.run", blocks=len(todo)):
+                for i, start, rows, xb in self._pump_blocks(todo):
+                    with _span("bulk.block", block=i, rows=rows):
+                        leaves = program(prep(xb))
+                        raw = self._score_block(leaves, rows)
+                        sink.write_block(i, raw)
+                    _obs_registry.counter("bulk_blocks_total").inc()
+                    rows_scored += int(rows)
+                    blocks_scored += 1
+        finally:
+            if lease is not None:
+                self.ledger.release(lease)
+        elapsed = max(time.perf_counter() - t0, 1e-9)
+
+        measured_dev = 0
+        try:
+            ms = jax.local_devices()[0].memory_stats() or {}
+            measured_dev = int(ms.get("peak_bytes_in_use", 0))
+        except Exception:  # noqa: BLE001 — CPU backends have no stats
+            pass
+        rps = rows_scored / elapsed
+        stats = {
+            "rows_scored": rows_scored,
+            "blocks_scored": blocks_scored,
+            "skipped_blocks": skipped,
+            "total_blocks": nb,
+            "complete": sink.complete,
+            "seconds": elapsed,
+            "rows_per_sec": rps,
+            "bulk_rows_per_sec_per_device": rps / max(len(self.devices), 1),
+            "num_devices": len(self.devices),
+            "program_source": source,
+            "predicted_device_peak_bytes": pred_dev,
+            "predicted_host_peak_bytes": pred_host,
+            "measured_device_peak_bytes": measured_dev,
+            "measured_host_peak_bytes": host_rss_peak_bytes(),
+        }
+        log_info(
+            f"bulk scorer: {blocks_scored} blocks / {rows_scored} rows in "
+            f"{elapsed:.2f}s ({rps / 1e6:.3f} Mrow/s, {skipped} banked "
+            f"blocks skipped, program={source})")
+        return stats
+
+    def _pump_blocks(self, todo: List[int]):
+        """Yield ``(index, start, rows, device_block)`` for ``todo``.
+
+        A fresh full scan rides the double-buffered ``BlockPump``
+        (read-ahead overlaps H2D with compute); a resume/sharded subset
+        reads exactly its own blocks instead — re-pumping banked blocks
+        just to discard them would re-pay their disk+H2D bytes.
+        """
+        import jax
+        if len(todo) == self.store.num_blocks:
+            yield from BlockPump(self.store)
+            return
+        buf = np.empty((self.store.num_cols, self.store.block_rows),
+                       self.store.dtype)
+        for i in todo:
+            start, rows = self.store.block_bounds(i)
+            view = self.store.read_block(i, out=buf)
+            _obs_registry.counter("stream_blocks_total").inc()
+            yield i, start, rows, jax.device_put(np.ascontiguousarray(view))

@@ -111,9 +111,14 @@ class AOTStore:
         import jax
         import jax.numpy as jnp
         from jax import export as jax_export
+        # a fused-variant forest keeps a fixed-trip fori twin as its
+        # export arm (bit-identical leaves, serializes cleanly — Pallas
+        # kernels do not); plain variants export their own jit
+        fn = getattr(device_forest, "_leaves_export",
+                     device_forest._leaves_jit)
         n = 0
         for b in sorted({int(b) for b in buckets}):
-            exp = jax_export.export(device_forest._leaves_jit)(
+            exp = jax_export.export(fn)(
                 jax.ShapeDtypeStruct((b, int(features)), jnp.float32))
             self.save_leaves(digest, b, exp)
             n += 1
@@ -171,3 +176,35 @@ def make_aot_program(store: "AOTStore", model, bucket_rows: int):
 
     run.aot = True
     return run
+
+
+def make_bulk_program(device_forest, features: int, block_rows: int,
+                      digest: str, store: Optional["AOTStore"] = None):
+    """Fixed-shape routing program for the bulk scorer (data/score.py):
+    ``[block_rows, F] f32 -> [T, block_rows] i32`` leaves, at the bulk
+    pipeline's ONE block-sized bucket.
+
+    Tries the AOT store first (compile-free start, same bit-parity story
+    as serving buckets); on a miss it exports the bucket so the NEXT run
+    — a resumed crash included — restores instead of re-tracing, and
+    serves this run with the freshly restored program.  Export is
+    best-effort: any failure falls back to the live jit, never fails the
+    scoring run.  Returns ``(callable, source)``, source in
+    {"aot", "jit"}.
+    """
+    if store is not None:
+        fn = store.load_leaves(digest, block_rows)
+        if fn is not None:
+            return fn, "aot"
+        try:
+            os.makedirs(store.root, exist_ok=True)
+            store.export_device_forest(device_forest, features,
+                                       [block_rows], digest)
+            fn = store.load_leaves(digest, block_rows)
+            if fn is not None:
+                return fn, "aot"
+        except Exception as e:  # noqa: BLE001 — export is best-effort
+            log_warning(f"bulk AOT export failed ({type(e).__name__}: "
+                        f"{str(e)[:120]}); scoring with the live jit")
+    return getattr(device_forest, "_leaves_export",
+                   device_forest._leaves_jit), "jit"

@@ -376,3 +376,59 @@ def histogram_utilization_table(rows: int = 200_000, features: int = 28,
             except Exception as e:  # unsupported variant on this backend
                 out[key] = {"error": str(e)[:160]}
     return out
+
+
+def predict_utilization_table(device_forest, rows: int = 200_000,
+                              reps: int = 2, num_class: int = 1,
+                              seed: int = 0) -> dict:
+    """Measured per-traversal-variant utilization table for the predict
+    family (ops/predict_kernels.py): {while, fori, fused[, fused_scores]}
+    -> ``measure_program`` dicts over one synthetic ``[rows, F]`` batch.
+
+    The histogram table above steers the training-kernel war; this is
+    its inference twin — the compiler-counted FLOPs/bytes behind the
+    ``predict_probe`` bench stage's sec/Mrow trendline.  ``device_forest``
+    is a ``predict.DeviceForest`` (any precision — the variants all read
+    its quantized planes); ``fused_scores`` adds the in-kernel leaf-sum
+    epilogue row when the forest carries leaf values and the tree count
+    divides by ``num_class``.  A variant unsupported on the backend
+    reports ``{"error": ...}`` instead of failing the table.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import predict_kernels as PK
+
+    f = device_forest.forest
+    F = int(np.asarray(f.split_feature).max(initial=0)) + 1
+    rng = np.random.RandomState(seed)
+    X = jnp.asarray(rng.randn(int(rows), F), jnp.float32)
+    tile = int(getattr(device_forest, "tile_rows", 512)) or 512
+    K = max(int(num_class), 1)
+
+    variants = {
+        "while": lambda x: PK.leaves_while(device_forest, x),
+        "fori": lambda x: PK.leaves_fori(device_forest, x),
+        "fused": lambda x: PK.fused_traverse(device_forest, x, tile),
+    }
+    if (device_forest.leaf_value is not None
+            and int(f.num_trees) % K == 0):
+        variants["fused_scores"] = lambda x: PK.fused_traverse(
+            device_forest, x, tile, K, emit_scores=True)
+
+    device = None
+    try:
+        device = jax.devices()[0]
+    except Exception:
+        pass
+    out = {"rows": int(rows), "features": F,
+           "num_trees": int(f.num_trees), "tile_rows": tile,
+           "elected_variant": getattr(device_forest, "variant", "while")}
+    for name, fn in variants.items():
+        try:
+            out[name] = measure_program(jax.jit(fn), (X,), reps=reps,
+                                        device=device)
+        except Exception as e:  # unsupported variant on this backend
+            out[name] = {"error": str(e)[:160]}
+    return out

@@ -1812,3 +1812,287 @@ def set_active_ledger(ledger: Optional[ResidencyLedger]):
 
 def active_ledger() -> Optional[ResidencyLedger]:
     return _active_ledger
+
+
+# ======================================================================
+# Inference kernel + chunk election: plan_predict.  The predict path's
+# analogue of plan_histograms — byte models answer "does it fit", the
+# measured-timings store (a new "p-..." key namespace in the SAME
+# hist_timings.json) answers "which traversal variant is fastest", and
+# LGBM_TPU_PREDICT_KERNEL is the bisect gate over the whole election.
+# ======================================================================
+
+PREDICT_VARIANTS = ("while", "fori", "fused")
+# largest device chunk the election will reach for (a ladder rung; the
+# per-call chunk still shrinks to bucket_rows(n) for small batches)
+MAX_PREDICT_CHUNK_ROWS = 1 << 20
+# fused-traversal row-tile ladder (widest VMEM-resident tile first)
+FUSED_PREDICT_TILES = (2048, 1024, 512, 256, 128)
+
+
+def _predict_kernel_override():
+    """LGBM_TPU_PREDICT_KERNEL: pin the traversal variant, bypassing
+    measured and analytic election (the bisect gate)."""
+    v = os.environ.get("LGBM_TPU_PREDICT_KERNEL", "").strip().lower()
+    return v if v in PREDICT_VARIANTS else None
+
+
+def _predict_chunk_override():
+    """LGBM_TPU_PREDICT_CHUNK: pin the predict chunk size."""
+    v = os.environ.get("LGBM_TPU_PREDICT_CHUNK", "").strip()
+    if not v:
+        return None
+    try:
+        n = int(float(v))
+    except ValueError:
+        return None
+    return max(n, 8) if n > 0 else None
+
+
+def predict_bucket_key(rows: int, features: int, num_trees: int,
+                       num_class: int, precision: str) -> str:
+    """Store key of the predict autotune family — prefixed "p-" so it
+    can never collide with histogram shape-bucket keys in the shared
+    store file."""
+    return (f"p-r{bucket_rows(max(int(rows), 1))}-f{int(features)}"
+            f"-t{int(num_trees)}-k{max(int(num_class), 1)}-{precision}")
+
+
+def record_predict_timing(rows, features, num_trees, num_class, precision,
+                          variant, seconds, params=None, path=None):
+    """Bank one measured (predict shape-bucket, variant) timing in the
+    shared store; returns the store path or None (no store dir).  Same
+    read-merge-write-atomic discipline as ``record_timing``."""
+    p = _autotune_path(path)
+    if not p:
+        return None
+    from ..utils.file_io import write_atomic
+    key = predict_bucket_key(rows, features, num_trees, num_class, precision)
+    with _AUTOTUNE_LOCK:
+        entries = _load_autotune_store(path)
+        slot = dict(entries.get(key) or {})
+        slot[str(variant)] = {"seconds": float(seconds),
+                              "params": dict(params or {})}
+        entries[key] = slot
+        write_atomic(p, json.dumps(
+            {"version": AUTOTUNE_STORE_VERSION, "entries": entries},
+            indent=1, sort_keys=True))
+    return p
+
+
+def measured_predict_election(rows, features, num_trees, num_class,
+                              precision, path=None):
+    """Fastest measured traversal variant for this predict bucket, or
+    None (cold).  Unknown variant names (a store written by a future
+    version) are skipped, not adopted."""
+    key = predict_bucket_key(rows, features, num_trees, num_class, precision)
+    slot = _load_autotune_store(path).get(key)
+    if not isinstance(slot, dict):
+        return None
+    best_v, best = None, None
+    for v, rec in slot.items():
+        if str(v) not in PREDICT_VARIANTS:
+            continue
+        try:
+            s = float(rec["seconds"])
+        except Exception:
+            continue
+        if s > 0 and (best is None or s < best["seconds"]):
+            params = rec.get("params")
+            best_v = str(v)
+            best = {"seconds": s,
+                    "params": params if isinstance(params, dict) else {}}
+    if best_v is None:
+        return None
+    return {"key": key, "variant": best_v, **best}
+
+
+def predict_fused_vmem_bytes(num_trees: int, nodes_dim: int, features: int,
+                             tile_rows: int, cat_words: int = 0,
+                             leaves_dim: int = 0, num_class: int = 1,
+                             emit_scores: bool = False) -> int:
+    """Predicted VMEM bytes of one fused-traversal grid step
+    (ops/predict_kernels.py): the nine resident [T, I] forest planes +
+    bitset words, the double-buffered [tile, F] input window, the
+    [T, tile] node state with its gather transients, and the output
+    block (leaf plane, or the [K, tile] score block plus the resident
+    leaf-value plane in score mode).  Deliberately simple — the right
+    ORDER for the fits/doesn't verdict, like ``fused_vmem_bytes``."""
+    T = max(int(num_trees), 1)
+    I = max(int(nodes_dim), 1)
+    F = max(int(features), 1)
+    C = max(int(tile_rows), 8)
+    K = max(int(num_class), 1)
+    planes = 9 * T * I * 4 + max(int(cat_words), 1) * 4
+    x = 2 * C * F * 4
+    state = 6 * T * C * 4
+    if emit_scores:
+        out = K * C * 4 + T * max(int(leaves_dim), 1) * 4
+    else:
+        out = T * C * 4
+    return planes + x + state + out
+
+
+def plan_predict_fused_tile(num_trees, nodes_dim, features, cat_words=0,
+                            leaves_dim=0, num_class=1, emit_scores=False,
+                            vmem_bytes=None):
+    """Largest fused row tile whose VMEM prediction fits, or None when
+    no ladder rung does (the election then stays on ``fori``)."""
+    limit = int(vmem_bytes if vmem_bytes is not None else vmem_limit_bytes())
+    budget = int(limit * VMEM_HEADROOM)
+    for c in FUSED_PREDICT_TILES:
+        need = predict_fused_vmem_bytes(num_trees, nodes_dim, features, c,
+                                        cat_words, leaves_dim, num_class,
+                                        emit_scores)
+        if need <= budget:
+            return {"tile_rows": c, "vmem_bytes": need,
+                    "vmem_limit_bytes": limit}
+    return None
+
+
+def elect_predict_chunk(num_trees, nodes_dim, leaves_dim, features,
+                        precision="f32", cat_words=0, routing_only=False,
+                        accel=None, budget=None) -> int:
+    """Largest ladder rung whose forest + per-chunk activation bytes fit
+    the HBM budget, replacing ``DeviceForest``'s historical hard-coded
+    ``1 << 16``.  ``LGBM_TPU_PREDICT_CHUNK`` pins it outright."""
+    o = _predict_chunk_override()
+    if o:
+        return o
+    if budget is None:
+        limit, _ = hbm_limit_bytes()
+        budget = int(limit * HEADROOM)
+    fb = predict_forest_bytes(num_trees, nodes_dim, leaves_dim, precision,
+                              cat_words, accel, routing_only)
+    best = MIN_BUCKET_ROWS
+    c = MIN_BUCKET_ROWS
+    while c <= MAX_PREDICT_CHUNK_ROWS:
+        if fb + predict_program_bytes(num_trees, c, features,
+                                      accel) > budget:
+            break
+        best = c
+        c = bucket_rows(c + 1)
+    return int(best)
+
+
+def elect_csr_chunk(features: int) -> int:
+    """Host-memory-aware CSR densification chunk for
+    ``predict.predict_csr_chunked``: the dense f64 chunk (plus its
+    densify + result transients, ~3x) may claim a quarter of the host
+    budget.  ``LGBM_TPU_PREDICT_CHUNK`` pins it outright."""
+    o = _predict_chunk_override()
+    if o:
+        return o
+    limit, _ = host_limit_bytes()
+    budget = int(limit * HOST_HEADROOM) // 4
+    per_row = max(int(features), 1) * 8 * 3
+    return int(min(max(budget // per_row, 1 << 12), 1 << 20))
+
+
+class PredictPlan(NamedTuple):
+    """plan_predict's verdict: traversal variant, fused row tile, device
+    chunk, and the byte story the election ran under."""
+
+    variant: str                # "while" | "fori" | "fused"
+    tile_rows: int              # fused VMEM row tile (0 = not fused)
+    chunk_rows: int             # elected device chunk (a ladder rung)
+    forest_bytes: int
+    program_bytes: int          # activations at chunk_rows
+    predicted_peak_bytes: int
+    budget_bytes: int
+    limit_bytes: int
+    limit_source: str
+    feasible: bool
+    elected_by: str             # "env" | "measured" | "analytic"
+    measured_variant: str = ""  # store's best for this bucket ("" = cold)
+    autotune_key: str = ""      # predict-bucket key the election ran under
+
+    def summary(self) -> dict:
+        """JSON-friendly form for bench journals / telemetry."""
+        return {
+            "variant": self.variant,
+            "tile_rows": self.tile_rows,
+            "chunk_rows": self.chunk_rows,
+            "forest_bytes": self.forest_bytes,
+            "program_bytes": self.program_bytes,
+            "predicted_peak_bytes": self.predicted_peak_bytes,
+            "budget_bytes": self.budget_bytes,
+            "hbm_limit_bytes": self.limit_bytes,
+            "limit_source": self.limit_source,
+            "feasible": self.feasible,
+            "elected_by": self.elected_by,
+            "measured_variant": self.measured_variant,
+            "autotune_key": self.autotune_key,
+        }
+
+
+def plan_predict(num_trees: int, nodes_dim: int, leaves_dim: int,
+                 features: int, rows: int = 0, num_class: int = 1,
+                 precision: str = "f32", cat_words: int = 0,
+                 routing_only: bool = False, ledger=None,
+                 accel: Optional[bool] = None,
+                 vmem_bytes: Optional[int] = None) -> PredictPlan:
+    """Elect {variant, tile_rows, chunk_rows} for one model's predict
+    path.
+
+    Budget: the ledger's remaining bytes when one is leased against
+    (serving co-residency, PR 17), else HEADROOM x the device limit.
+    Variant: ``LGBM_TPU_PREDICT_KERNEL`` > the measured predict family
+    > analytic (fused on accelerators when its VMEM tile fits, fori
+    everywhere else — the while arm is never elected, only pinned).
+    """
+    if accel is None:
+        from .histogram import on_accelerator
+        accel = on_accelerator()
+    limit, source = hbm_limit_bytes()
+    if ledger is not None:
+        # ledger budgets are already post-HEADROOM (applied once at the
+        # ledger's limit — see plan_histograms' co-resident arm)
+        limit, source = int(ledger.limit_bytes), "ledger"
+        budget = int(ledger.available_bytes())
+    else:
+        budget = int(limit * HEADROOM)
+    chunk = elect_predict_chunk(num_trees, nodes_dim, leaves_dim, features,
+                                precision, cat_words, routing_only,
+                                accel=accel, budget=budget)
+    if rows:
+        chunk = min(chunk, bucket_rows(rows))
+    fb = predict_forest_bytes(num_trees, nodes_dim, leaves_dim, precision,
+                              cat_words, accel, routing_only)
+    pb = predict_program_bytes(num_trees, chunk, features, accel)
+    ft = plan_predict_fused_tile(num_trees, nodes_dim, features, cat_words,
+                                 leaves_dim, num_class,
+                                 emit_scores=not routing_only,
+                                 vmem_bytes=vmem_bytes)
+    analytic = "fused" if (accel and ft is not None) else "fori"
+    variant, elected_by = analytic, "analytic"
+    measured_variant, autotune_key = "", ""
+    if autotune_enabled():
+        autotune_key = predict_bucket_key(rows or chunk, features,
+                                          num_trees, num_class, precision)
+        m = measured_predict_election(rows or chunk, features, num_trees,
+                                      num_class, precision)
+        with _AUTOTUNE_LOCK:
+            if m is not None:
+                measured_variant = m["variant"]
+                variant, elected_by = measured_variant, "measured"
+                _AUTOTUNE_STATS["hits"] += 1
+                if variant != analytic:
+                    _AUTOTUNE_STATS["flips"] += 1
+            else:
+                _AUTOTUNE_STATS["misses"] += 1
+    o = _predict_kernel_override()
+    if o is not None:
+        variant, elected_by = o, "env"
+    if variant == "fused" and ft is None and elected_by != "env":
+        # a measured "fused" from a bigger core must not OOM this one
+        variant = "fori"
+    tile = (ft["tile_rows"] if ft is not None else FUSED_PREDICT_TILES[-1]) \
+        if variant == "fused" else 0
+    peak = fb + pb
+    return PredictPlan(
+        variant=variant, tile_rows=tile, chunk_rows=chunk,
+        forest_bytes=fb, program_bytes=pb, predicted_peak_bytes=peak,
+        budget_bytes=budget, limit_bytes=limit, limit_source=source,
+        feasible=peak <= budget, elected_by=elected_by,
+        measured_variant=measured_variant, autotune_key=autotune_key)

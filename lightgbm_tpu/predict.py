@@ -21,6 +21,7 @@ compacted out of the working set.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional
 
 import numpy as np
@@ -306,14 +307,15 @@ class DeviceForest:
     paths still work.
     """
 
-    def __init__(self, forest: StackedForest, chunk_rows: int = 1 << 16,
-                 precision: str = "f32", routing_only: bool = False):
+    def __init__(self, forest: StackedForest, chunk_rows: Optional[int] = None,
+                 precision: str = "f32", routing_only: bool = False,
+                 variant: Optional[str] = None,
+                 tile_rows: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         if precision not in ("f32", "bf16", "int8"):
             raise ValueError(f"unknown DeviceForest precision {precision!r}")
         self.forest = forest
-        self.chunk_rows = chunk_rows
         self.precision = precision
         self.routing_only = routing_only
         f = forest
@@ -357,7 +359,61 @@ class DeviceForest:
         self.cat_offset = jnp.asarray(f.cat_offset)
         self.cat_nwords = jnp.asarray(f.cat_nwords)
         self.cat_words = jnp.asarray(f.cat_words)
-        self._leaves_jit = jax.jit(self._leaves)
+        # kernel + chunk election (ops/planner.plan_predict): HBM-aware
+        # chunk, measured-or-analytic variant, fused VMEM row tile.
+        # Explicit arguments always win — tests pin shapes, serving pins
+        # the bucket ladder.
+        from .ops import planner as _planner
+        from .ops import predict_kernels as _pk
+        if chunk_rows is None or variant is None or tile_rows is None:
+            plan = _planner.plan_predict(
+                num_trees=f.num_trees,
+                nodes_dim=f.split_feature.shape[1],
+                leaves_dim=f.leaf_value.shape[1],
+                features=int(f.split_feature.max(initial=0)) + 1,
+                precision=precision, routing_only=routing_only,
+                cat_words=int(f.cat_words.size),
+                ledger=_planner.active_ledger())
+            chunk_rows = plan.chunk_rows if chunk_rows is None else chunk_rows
+            variant = plan.variant if variant is None else variant
+            tile_rows = plan.tile_rows if tile_rows is None else tile_rows
+        self.chunk_rows = int(chunk_rows)
+        self.tile_rows = int(tile_rows) or 512
+        if variant not in _pk.PREDICT_VARIANTS:
+            raise ValueError(f"unknown predict kernel variant {variant!r}")
+        if variant == "fused" and not _pk.fused_predict_verified(self):
+            variant = "fori"               # probe demotion, warned there
+        self.variant = variant
+        if variant == "while":
+            leaves_fn = self._leaves
+        elif variant == "fori":
+            leaves_fn = lambda X: _pk.leaves_fori(self, X)  # noqa: E731
+        else:
+            leaves_fn = lambda X: _pk.fused_traverse(  # noqa: E731
+                self, X, self.tile_rows)
+        self._leaves_jit = jax.jit(leaves_fn)
+        # AOT export arm: the fixed-trip fori variant serializes cleanly
+        # (static trip count, no convergence sync); a fused election
+        # keeps it as the bit-identical export twin (fleet/aot.py)
+        self._leaves_export = (jax.jit(lambda X: _pk.leaves_fori(self, X))
+                               if variant == "fused" else self._leaves_jit)
+        self._epilogue_ok: dict = {}
+        self._leaf_sum_jit = jax.jit(self._leaf_sum, static_argnums=1)
+        # fused score mode: leaf gather + class accumulation stay
+        # in-kernel, only a [K, tile] block ever leaves HBM
+        self._scores_jit = (
+            jax.jit(lambda X, k: _pk.fused_traverse(
+                self, X, self.tile_rows, k, emit_scores=True),
+                static_argnums=1)
+            if variant == "fused" and self.leaf_value is not None else None)
+
+    def _call_chunk(self, n: int) -> int:
+        """Per-call chunk: the elected ``chunk_rows`` ceiling, shrunk to
+        the row-count's ladder rung so a small batch is not padded out
+        to the full chunk (the compiled-shape set stays ladder-bounded
+        either way)."""
+        from .ops.planner import bucket_rows
+        return max(min(self.chunk_rows, bucket_rows(max(n, 1))), 1)
 
     def _thr_at(self, tid2, nd):
         """Gather the [T', nc] threshold block in f32 whatever the device
@@ -373,44 +429,68 @@ class DeviceForest:
         return self.threshold[tid2, nd]
 
     def _leaves(self, Xc):
-        """[nc, F] f32 -> leaf index [T, nc]."""
+        """[nc, F] f32 -> leaf index [T, nc] — the legacy while_loop arm
+        (ops/predict_kernels shares ONE decision-step expression across
+        while/fori/fused, so variant parity is structural)."""
+        from .ops import predict_kernels as _pk
+        return _pk.leaves_while(self, Xc)
+
+    def _leaf_sum(self, leaves, num_class: int):
+        """Device leaf-value epilogue: [T, rows] leaf indices ->
+        [K, rows] f32 raw scores, accumulated in pinned iteration-major
+        order (bit-stable run to run).  Only promoted into
+        ``predict_raw_padded`` after ``_epilogue_verified``."""
         import jax.numpy as jnp
         from jax import lax
+        K = max(num_class, 1)
         T = self.forest.num_trees
-        nc = Xc.shape[0]
-        rows = jnp.arange(nc)[None, :]
         tid2 = jnp.arange(T)[:, None]
+        lv3 = self.leaf_value[tid2, leaves].reshape(
+            T // K, K, leaves.shape[1])
+        return lax.fori_loop(
+            0, T // K, lambda i, acc: acc + lv3[i],
+            jnp.zeros((K, leaves.shape[1]), jnp.float32))
 
-        def cond(node):
-            return jnp.any(node >= 0)
-
-        def body(node):
-            nd = jnp.maximum(node, 0)
-            fval = Xc[rows, self.split_feature[tid2, nd]]
-            thr = self._thr_at(tid2, nd)
-            mt = self.missing_type[tid2, nd]
-            nan = jnp.isnan(fval)
-            fz = jnp.where(nan & (mt != 2), 0.0, fval)
-            is_missing = ((mt == 1) & (jnp.abs(fz) <= K_ZERO_THRESHOLD)) | \
-                         ((mt == 2) & nan)
-            gl = jnp.where(is_missing, self.default_left[tid2, nd], fz <= thr)
-            if self.forest.has_cat:
-                cat = self.is_cat[tid2, nd]
-                # truncate toward zero (reference static_cast<int> semantics)
-                iv = jnp.fix(jnp.where(nan, -1.0, fval)).astype(jnp.int64)
-                nw = self.cat_nwords[tid2, nd]
-                valid = (iv >= 0) & (iv < nw.astype(jnp.int64) * 32)
-                ivc = jnp.clip(iv, 0, None)
-                widx = self.cat_offset[tid2, nd] + jnp.minimum(
-                    ivc // 32, jnp.maximum(nw - 1, 0))
-                inset = (self.cat_words[widx]
-                         >> (ivc % 32).astype(jnp.uint32)) & 1
-                gl = jnp.where(cat, valid & (inset == 1), gl)
-            nxt = jnp.where(gl, self.left[tid2, nd], self.right[tid2, nd])
-            return jnp.where(node < 0, node, nxt)
-
-        node = lax.while_loop(cond, body, jnp.zeros((T, nc), jnp.int32))
-        return ~node
+    def _epilogue_verified(self, num_class: int) -> bool:
+        """One-time per (forest, K) probe: the float32 device leaf-sum
+        epilogue may replace the host float64 ``gather_leaf_sum`` ONLY
+        if it reproduces it bit-exactly on a battery of synthetic leaf
+        patterns (the ``take_from_table`` demotion precedent) — any
+        divergence, now or from a quirky leaf-value distribution, keeps
+        the serving bit-parity contract on the host path.
+        ``LGBM_TPU_PREDICT_EPILOGUE=0`` pins the host path outright."""
+        K = max(num_class, 1)
+        if self.leaf_value is None or self.forest.num_trees % K:
+            return False
+        if os.environ.get("LGBM_TPU_PREDICT_EPILOGUE", "").strip() == "0":
+            return False
+        ok = self._epilogue_ok.get(K)
+        if ok is None:
+            import jax.numpy as jnp
+            T = self.forest.num_trees
+            L = self.forest.leaf_value.shape[1]
+            rng = np.random.RandomState(20260807)
+            leaves = rng.randint(0, L, size=(T, 128)).astype(np.int32)
+            leaves[:, 0] = 0                     # adversarial same-leaf
+            leaves[:, 1] = L - 1                 # columns stress carries
+            try:
+                dev = np.asarray(self._leaf_sum_jit(jnp.asarray(leaves), K),
+                                 np.float64)
+                ok = bool(np.array_equal(
+                    dev, gather_leaf_sum(self.forest, leaves, K)))
+            except Exception:
+                ok = False
+            if not ok:
+                # the COMMON case for real-valued forests (f32 sums
+                # rarely reproduce f64 bit-for-bit) — a debug note, not
+                # a warning; the host path is the contract's default
+                from .utils.log import log_debug
+                log_debug(
+                    "device leaf-sum epilogue demoted: float32 sums not "
+                    "bit-identical to the float64 host gather for this "
+                    "forest; predict_raw_padded keeps the host path")
+            self._epilogue_ok[K] = ok
+        return bool(ok)
 
     def predict_raw_padded(self, Xpad: np.ndarray,
                            num_class: int = 1) -> np.ndarray:
@@ -428,11 +508,20 @@ class DeviceForest:
         ``StackedForest.predict_raw`` uses — so for float32-precision
         feature values the output is bit-identical to the offline host
         path, padding rows included-then-sliced notwithstanding.
+
+        When the one-time ``_epilogue_verified`` probe shows the float32
+        device leaf-sum reproduces that host gather BIT-exactly for this
+        forest, the epilogue stays on device (only [K, rows] crosses the
+        wire); otherwise — and under ``LGBM_TPU_PREDICT_EPILOGUE=0`` —
+        the host path runs, so the contract holds either way.
         """
         import jax.numpy as jnp
-        leaves = np.asarray(self._leaves_jit(
-            jnp.asarray(np.asarray(Xpad, np.float32))))      # [T, rows]
-        return gather_leaf_sum(self.forest, leaves, num_class)
+        leaves = self._leaves_jit(
+            jnp.asarray(np.asarray(Xpad, np.float32)))       # [T, rows]
+        if self._epilogue_verified(num_class):
+            return np.asarray(
+                self._leaf_sum_jit(leaves, max(num_class, 1)), np.float64)
+        return gather_leaf_sum(self.forest, np.asarray(leaves), num_class)
 
     def predict_raw(self, X: np.ndarray, num_class: int = 1) -> np.ndarray:
         """Summed raw scores [K, n] (float32 accumulation on device)."""
@@ -447,12 +536,16 @@ class DeviceForest:
         iters = T // K
         tid2 = jnp.arange(T)[:, None]
         out = np.zeros((K, n), np.float64)
-        cr = self.chunk_rows
+        cr = self._call_chunk(n)
         for s in range(0, n, cr):
             e = min(s + cr, n)
             Xc = np.asarray(X[s:e], np.float32)
             if e - s < cr:   # pad to the compiled chunk shape
                 Xc = np.pad(Xc, ((0, cr - (e - s)), (0, 0)))
+            if self._scores_jit is not None:     # fused in-kernel epilogue
+                out[:, s:e] = np.asarray(self._scores_jit(
+                    jnp.asarray(Xc), K), np.float64)[:, :e - s]
+                continue
             leaves = self._leaves_jit(jnp.asarray(Xc))
             lv = self.leaf_value[tid2, leaves].reshape(iters, K, cr)
             out[:, s:e] = np.asarray(jnp.sum(lv, axis=0),
@@ -463,7 +556,7 @@ class DeviceForest:
         import jax.numpy as jnp
         n = X.shape[0]
         out = np.zeros((n, self.forest.num_trees), np.int32)
-        cr = self.chunk_rows
+        cr = self._call_chunk(n)
         for s in range(0, n, cr):
             e = min(s + cr, n)
             Xc = np.asarray(X[s:e], np.float32)
@@ -503,7 +596,8 @@ def make_early_stop(kind: str, margin: float, freq: int):
     raise ValueError(f"unknown early-stop type {kind!r}")
 
 
-def predict_csr_chunked(forest_predict, data, chunk_rows: int = _CHUNK_ROWS):
+def predict_csr_chunked(forest_predict, data,
+                        chunk_rows: Optional[int] = None):
     """Predict a scipy CSR/CSC matrix without materializing it densely:
     each row chunk is densified on its own (bounded memory), predicted, and
     discarded.  reference predicts CSR natively row-by-row (c_api.h:698);
@@ -511,9 +605,14 @@ def predict_csr_chunked(forest_predict, data, chunk_rows: int = _CHUNK_ROWS):
 
     ``forest_predict`` maps a dense [nc, F] float64 chunk to its result
     (row-major leading axis); results are concatenated on axis 0.
+    ``chunk_rows`` defaults to the planner's host-memory-aware election
+    (``LGBM_TPU_PREDICT_CHUNK`` overrides) instead of a hard-coded size.
     """
     if hasattr(data, "tocsr"):
         data = data.tocsr()
+    if chunk_rows is None:
+        from .ops import planner as _planner
+        chunk_rows = _planner.elect_csr_chunk(int(data.shape[1]))
     n = data.shape[0]
     outs = []
     for s in range(0, n, chunk_rows):

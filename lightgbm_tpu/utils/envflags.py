@@ -93,6 +93,14 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "force ('1') / forbid ('0') tiered ICIxDCN reductions", _PERF),
     _f("LGBM_TPU_PINNED_REDUCE", "", "ops/planner.py",
        "pin the tiered-reduction variant the planner would elect", _PERF),
+    _f("LGBM_TPU_PREDICT_KERNEL", "", "ops/planner.py",
+       "pin the predict traversal variant (while/fori/fused), bypassing "
+       "the measured + analytic election", _PERF),
+    _f("LGBM_TPU_PREDICT_CHUNK", "", "ops/planner.py",
+       "force the predict device chunk / CSR densify chunk (rows)", _PERF),
+    _f("LGBM_TPU_PREDICT_EPILOGUE", "", "predict.py",
+       "'0' pins the host float64 leaf-sum epilogue (skips the device "
+       "bit-exactness probe)", _PERF),
     # ------------------------------------------------------ data plane
     _f("LGBM_TPU_STREAM", "", "ops/planner.py",
        "force ('1') / forbid ('0') out-of-core row-block streaming", _PERF),
@@ -200,6 +208,8 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "out-of-core streaming stage rows", _PERF),
     _f("BENCH_STREAM_TREES", "3", "bench.py",
        "out-of-core streaming stage tree count", _PERF),
+    _f("BENCH_BULK_ROWS", "10000000", "bench.py",
+       "bulk offline-scoring stage rows", _PERF),
     _f("BENCH_TOTAL_BUDGET", "6600", "bench.py",
        "wall-clock budget (seconds) the stage gates spend against", _PERF),
     _f("BENCH_STALL_TIMEOUT", "2400", "bench.py",
@@ -257,6 +267,10 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "'1' skips the journaled tpulint stage", _PERF),
     _f("BENCH_SKIP_SWEEP", "", "bench.py",
        "'1' skips the batched model-axis sweep probe", _PERF),
+    _f("BENCH_SKIP_PREDICT_PROBE", "", "bench.py",
+       "'1' skips the inference-kernel probe", _PERF),
+    _f("BENCH_SKIP_BULK_SCORE", "", "bench.py",
+       "'1' skips the bulk offline-scoring stage", _PERF),
 ]}
 
 

@@ -1,0 +1,200 @@
+"""Bulk offline scoring: ScoreSink commit protocol, block sharding,
+crash-resume byte-identity (data/score.py).
+
+The sink's manifest is rewritten atomically after EVERY banked block —
+so a kill at any instant leaves a manifest naming exactly the blocks
+whose bytes are on disk, and a resume skips them and reproduces the
+rest byte-for-byte (the per-row float64 epilogue makes block boundaries
+bit-invisible).
+"""
+
+import filecmp
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.data.blockstore import BlockStore
+from lightgbm_tpu.data.score import (BulkScorer, ScoreSink, ScoreSinkError,
+                                     plan_block_shards)
+from lightgbm_tpu.predict import DeviceForest
+
+BLOCK_ROWS = 512
+ROWS = 2200           # 5 blocks, ragged tail (2200 = 4*512 + 152)
+
+
+def _dev(slice_id, device_id):
+    return SimpleNamespace(slice_id=slice_id, device_id=device_id)
+
+
+# ----------------------------------------------------------------------
+# ScoreSink
+# ----------------------------------------------------------------------
+
+
+def _mk_sink(path, num_blocks=3, num_class=1):
+    return ScoreSink.open_or_create(
+        str(path), num_rows=num_blocks * BLOCK_ROWS, num_class=num_class,
+        block_rows=BLOCK_ROWS, num_blocks=num_blocks, model_digest="d1")
+
+
+def test_sink_write_read_roundtrip(tmp_path):
+    sink = _mk_sink(tmp_path / "s")
+    rng = np.random.RandomState(0)
+    b0 = rng.randn(1, BLOCK_ROWS)
+    sink.write_block(0, b0)
+    assert sink.banked() == {0} and not sink.complete
+    np.testing.assert_array_equal(sink.read_block(0), b0)
+    with pytest.raises(ScoreSinkError, match="not banked"):
+        sink.read_block(1)
+
+
+def test_sink_reopen_resumes_banked_blocks(tmp_path):
+    sink = _mk_sink(tmp_path / "s")
+    sink.write_block(1, np.ones((1, BLOCK_ROWS)))
+    again = _mk_sink(tmp_path / "s")
+    assert again.banked() == {1}
+    again.write_block(0, np.zeros((1, BLOCK_ROWS)))
+    again.write_block(2, np.zeros((1, 152)))        # ragged tail block
+    assert again.complete
+    assert again.read_block(2).shape == (1, 152)
+
+
+def test_sink_rejects_foreign_geometry(tmp_path):
+    _mk_sink(tmp_path / "s")
+    for kw in ({"num_blocks": 4}, {"num_class": 2}):
+        args = dict(num_rows=3 * BLOCK_ROWS, num_class=1,
+                    block_rows=BLOCK_ROWS, num_blocks=3, model_digest="d1")
+        args.update(kw)
+        with pytest.raises(ScoreSinkError, match="disagrees"):
+            ScoreSink.open_or_create(str(tmp_path / "s"), **args)
+    with pytest.raises(ScoreSinkError, match="disagrees"):
+        ScoreSink.open_or_create(
+            str(tmp_path / "s"), num_rows=3 * BLOCK_ROWS, num_class=1,
+            block_rows=BLOCK_ROWS, num_blocks=3, model_digest="OTHER")
+
+
+def test_sink_detects_corrupt_block(tmp_path):
+    sink = _mk_sink(tmp_path / "s")
+    sink.write_block(0, np.ones((1, BLOCK_ROWS)))
+    fp = tmp_path / "s" / "scores_00000.bin"
+    raw = bytearray(fp.read_bytes())
+    raw[3] ^= 0xFF
+    fp.write_bytes(bytes(raw))
+    with pytest.raises(ScoreSinkError, match="checksum"):
+        sink.read_block(0)
+
+
+def test_sink_rejects_wrong_shape(tmp_path):
+    sink = _mk_sink(tmp_path / "s", num_class=2)
+    with pytest.raises(ValueError, match=r"\[2, rows\]"):
+        sink.write_block(0, np.ones((1, BLOCK_ROWS)))
+
+
+# ----------------------------------------------------------------------
+# block sharding
+# ----------------------------------------------------------------------
+
+
+def test_shards_single_device():
+    assert plan_block_shards(4, [_dev(0, 7)]) == (7, 7, 7, 7)
+
+
+def test_shards_ici_before_dcn():
+    """The coordinator's slice fills first; the remote slice's devices
+    take spillover LAST, whatever order the specs arrive in."""
+    devs = [_dev(1, 10), _dev(0, 20), _dev(1, 11)]   # home slice = 1
+    assert plan_block_shards(6, devs) == (10, 11, 20, 10, 11, 20)
+
+
+def test_shards_empty_devices_raise():
+    with pytest.raises(ValueError):
+        plan_block_shards(3, [])
+
+
+# ----------------------------------------------------------------------
+# BulkScorer end-to-end: scores, crash-resume, byte-identity
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def scoring_setup(tmp_path_factory):
+    root = tmp_path_factory.mktemp("bulk")
+    rng = np.random.RandomState(5)
+    X = rng.randn(ROWS, 6).astype(np.float32)
+    X[rng.rand(ROWS) < 0.1, 1] = np.nan
+    y = (X[:, 0] + X[:, 2] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+         "min_data_in_leaf": 5},
+        lgb.Dataset(X.astype(np.float64), label=y),
+        num_boost_round=6, verbose_eval=False)
+    forest = bst._forest(0, len(bst.models))
+    store = BlockStore.from_array(
+        str(root / "features"), X, block_rows=BLOCK_ROWS)
+    return root, bst, forest, store, X
+
+
+def test_bulk_scores_match_booster(scoring_setup):
+    root, bst, forest, store, X = scoring_setup
+    dev = DeviceForest(forest, variant="fori")
+    stats = BulkScorer(dev, store, str(root / "sink_full")).run()
+    assert stats["complete"] and stats["blocks_scored"] == store.num_blocks
+    assert stats["rows_scored"] == ROWS
+    sink = ScoreSink.open_or_create(
+        str(root / "sink_full"), ROWS, 1, BLOCK_ROWS, store.num_blocks,
+        BulkScorer(dev, store, str(root / "sink_full")).digest)
+    got = np.concatenate(
+        [sink.read_block(i) for i in range(store.num_blocks)], axis=1)[0]
+    ref = bst.predict(X.astype(np.float64), raw_score=True)
+    assert np.array_equal(got, ref), \
+        "bulk scores are not bit-identical to Booster.predict(raw_score)"
+
+
+def test_bulk_crash_resume_byte_identical(scoring_setup):
+    root, bst, forest, store, X = scoring_setup
+    dev = DeviceForest(forest, variant="fori")
+    a = str(root / "sink_a")
+    b = str(root / "sink_b")
+    BulkScorer(dev, store, a).run()
+
+    cut = 2
+    partial = BulkScorer(dev, store, b).run(max_blocks=cut)
+    assert partial["blocks_scored"] == cut and not partial["complete"]
+    resumed = BulkScorer(dev, store, b).run()       # fresh scorer: resume
+    assert resumed["skipped_blocks"] == cut
+    assert resumed["blocks_scored"] == store.num_blocks - cut
+    assert resumed["complete"]
+
+    names = sorted(f for f in os.listdir(a) if f.endswith(".bin"))
+    assert names == sorted(f for f in os.listdir(b) if f.endswith(".bin"))
+    for f in names:
+        assert filecmp.cmp(os.path.join(a, f), os.path.join(b, f),
+                           shallow=False), f"resumed block {f} diverged"
+
+
+def test_bulk_refuses_non_f32_store(tmp_path, scoring_setup):
+    _, _, forest, _, _ = scoring_setup
+    q = BlockStore.from_array(
+        str(tmp_path / "u8"),
+        np.zeros((64, 3), np.uint8), block_rows=32)
+    with pytest.raises(ValueError, match="float32"):
+        BulkScorer(DeviceForest(forest, variant="fori"), q,
+                   str(tmp_path / "sink"))
+
+
+def test_bulk_sharded_run_scores_only_its_blocks(scoring_setup):
+    """Two devices: each participant banks only its shard; together they
+    complete the sink."""
+    root, bst, forest, store, X = scoring_setup
+    dev = DeviceForest(forest, variant="fori")
+    devs = [_dev(0, 0), _dev(0, 1)]
+    sink = str(root / "sink_sharded")
+    s0 = BulkScorer(dev, store, sink, devices=devs, local_device_id=0).run()
+    assert not s0["complete"]
+    assert s0["blocks_scored"] == (store.num_blocks + 1) // 2
+    s1 = BulkScorer(dev, store, sink, devices=devs, local_device_id=1).run()
+    assert s1["complete"]
+    assert s0["blocks_scored"] + s1["blocks_scored"] == store.num_blocks

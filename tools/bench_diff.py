@@ -63,6 +63,11 @@ THRESHOLDS = {
     "autotune_hits": ("higher", 1.5),
     "autotune_misses": ("lower", 1.5),
     "autotune_flips": ("lower", 1.5),
+    # inference-path numbers (predict_probe / bulk_score stages): the
+    # elected traversal kernel's sec/Mrow and the bulk scorer's
+    # per-device throughput are the perf-gate guards for ISSUE 19
+    "predict_sec_per_mrow": ("lower", 1.25),
+    "bulk_rows_per_sec_per_device": ("higher", 1.25),
 }
 # a tiny absolute floor below which timing ratios are noise, not signal
 ABS_FLOOR = {"compile_seconds": 0.5, "bin_seconds": 0.5, "elapsed": 1.0}
