@@ -166,6 +166,101 @@ class BlockPump:
                 pass
 
 
+class IngestPump:
+    """Double-buffered host->device iterator over RAW float chunks —
+    ``BlockPump``'s ingest twin (ops/ingest.py's device binning path).
+
+    The source is the host [n, F] float32 matrix (or anything row-
+    sliceable to one); a daemon reader thread slices chunk t+1 and
+    dispatches its ``jax.device_put`` while the consumer's bucketize+
+    pack kernel runs on chunk t, so raw floats never materialize whole
+    on device and the H2D copy hides under compute.  Yields
+    ``(index, start_row, rows, device_chunk)`` in pinned ascending
+    order (resume-safe: the binned matrix fills front to back).
+
+    With multiple ``devices``, chunk placement round-robins ICI-before-
+    DCN via ``plan_block_shards`` (data/score.py) — each device bins
+    only its own row shard of the construction.
+    """
+
+    def __init__(self, source, chunk_rows: int, depth: int = 2,
+                 devices=None, prefetch: bool = True):
+        self.source = source
+        self.n = int(source.shape[0])
+        self.chunk_rows = max(int(chunk_rows), 1)
+        self.num_chunks = max(-(-self.n // self.chunk_rows), 1)
+        self.depth = max(int(depth), 1)
+        self.prefetch = prefetch
+        self.devices = list(devices) if devices else None
+        if self.devices and len(self.devices) > 1:
+            # describe the jax devices through the topology seam (device
+            # i = spec i, the row-major mesh order), then round-robin
+            # chunks ICI-before-DCN; the returned device_ids index
+            # straight back into ``self.devices``
+            from ..fleet.topology import plan_devices
+            from .score import plan_block_shards
+            specs = plan_devices(len(self.devices))
+            self._owner = list(plan_block_shards(self.num_chunks, specs))
+        else:
+            self._owner = [0] * self.num_chunks
+
+    def _load(self, i: int):
+        start = i * self.chunk_rows
+        rows = min(self.chunk_rows, self.n - start)
+        chunk = np.ascontiguousarray(self.source[start:start + rows],
+                                     dtype=np.float32)
+        dev = self.devices[self._owner[i]] if self.devices else None
+        return i, start, rows, jax.device_put(chunk, dev)
+
+    def __iter__(self):
+        if not self.prefetch:
+            for i in range(self.num_chunks):
+                _obs_registry.counter("ingest_blocks_total").inc()
+                _beat("ingest.pump", count=i + 1)
+                yield self._load(i)
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def reader():
+            try:
+                for i in range(self.num_chunks):
+                    if stop.is_set():
+                        return
+                    with _span("ingest.block_put", block=i):
+                        item = self._load(i)
+                    q.put(item)
+                q.put(None)
+            except BaseException as e:   # surfaced on the consumer side
+                q.put(e)
+
+        t = threading.Thread(target=reader, daemon=True,
+                             name="lgbm-ingest-pump")
+        t.start()
+        gauge = _obs_registry.gauge("ingest_blocks_inflight")
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                gauge.set(q.qsize() + 1)
+                _obs_registry.counter("ingest_blocks_total").inc()
+                # pump heartbeat: a wedged reader thread goes stale here
+                _beat("ingest.pump", count=item[0] + 1)
+                yield item
+        finally:
+            stop.set()
+            gauge.set(0)
+            # drain so the reader's blocked put() can observe stop
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
+
 class StreamContext:
     """Everything the streamed executor hangs off a GBDT instance."""
 

@@ -358,11 +358,14 @@ class Dataset:
             sample_idx = _sample_indices(self.num_data, sample_cnt, seed)
             self._fit_bin_mappers(raw, sp, sample_idx, categorical)
 
-        # second pass: bin every row into the per-GROUP merged columns
+        # second pass: bin every row into the per-GROUP merged columns —
+        # on device when plan_ingest elects the bucketize+pack kernel
+        # (ops/ingest.py), with the host path as fallback/parity oracle
         G = self.num_groups
         dtype = np.uint8 if self.max_group_bin <= 256 else np.uint16
         self.binned = np.zeros((self.num_data, G), dtype=dtype)
-        self._bin_block(raw, sp, self.binned)
+        if not self._maybe_device_bin(raw, sp, self.binned):
+            self._bin_block(raw, sp, self.binned)
 
         self.metadata.check(self.num_data)
         if self.metadata.label is None:
@@ -485,6 +488,108 @@ class Dataset:
         else:
             for g, members in by_group.items():
                 run_group(g, members)
+
+    # -- device-side ingest (ops/ingest.py): the fused bucketize+pack
+    #    kernel path; ``_bin_block`` above is the never-deleted host
+    #    fallback AND the parity oracle its bytes are checked against --
+
+    def _ingest_state(self) -> Optional[dict]:
+        """Build (once per dataset) the device-ingest state: tables,
+        plan, compiled binner.  None == this dataset bins on host
+        (unsupported recipe, or the election said so); the verdict is
+        cached so repeated pushes pay nothing."""
+        st = getattr(self, "_ingest", None)
+        if st is not None:
+            return st or None                 # {} == demoted for good
+        from .ops import ingest as ING
+        from .ops.planner import active_ledger, plan_ingest
+        try:
+            tables = ING.build_ingest_tables(self)
+        except ING.IngestUnsupported as e:
+            ING.demote(str(e), warn=False)
+            self._ingest = {}
+            return None
+        plan = plan_ingest(
+            rows=self.num_data, features=tables.num_features,
+            num_groups=tables.num_groups,
+            item_bytes=tables.out_dtype.itemsize,
+            bounds_width=tables.bounds.shape[1],
+            cats_width=tables.cats.shape[1],
+            ledger=active_ledger())
+        if plan.variant != "kernel":
+            ING.record_ingest_story(
+                path="host", elected_by=plan.elected_by,
+                reason=f"planner elected host ({plan.elected_by})",
+                plan=plan.summary())
+            self._ingest = {}
+            return None
+        st = {"plan": plan, "binner": ING.DeviceBinner(tables,
+                                                       plan.tile_rows),
+              "probed": False}
+        self._ingest = st
+        return st
+
+    def _maybe_device_bin(self, raw, sp, out: np.ndarray) -> bool:
+        """Bin ``raw`` into ``out`` on device when the election says
+        so.  True only when every byte was committed device-side and
+        the salted parity probe passed first (byte-identical to
+        ``_bin_block`` by contract); any failure re-zeroes ``out`` and
+        returns False so the host oracle runs."""
+        from .ops import ingest as ING
+        if sp is not None:
+            return False
+        if not isinstance(raw, np.ndarray) or raw.dtype != np.float32:
+            # the kernel's directed-rounded boundary table is exact
+            # ONLY against f32 inputs (ops/ingest.py); f64 stays host
+            return False
+        st = self._ingest_state()
+        if st is None:
+            return False
+        plan, binner = st["plan"], st["binner"]
+        n = out.shape[0]
+        if n == 0 or (n < 4096 and plan.elected_by != "env"):
+            return False          # dispatch overhead beats tiny blocks
+        import time as _time
+
+        from .obs.trace import span as _span
+        try:
+            if not st["probed"]:
+                with _span("ingest.parity_probe"):
+                    if not ING.parity_probe(binner, self, raw):
+                        ING.demote(
+                            "parity probe: device bytes diverge from "
+                            "host value_to_bin")
+                        self._ingest = {}
+                        return False
+                st["probed"] = True
+            import jax
+
+            from .data.stream import IngestPump
+            local = jax.local_devices()
+            devices = local if len(local) > 1 else None
+            t0 = _time.perf_counter()
+            with _span("ingest.device_bin", rows=n,
+                       chunk_rows=plan.chunk_rows,
+                       tile_rows=plan.tile_rows):
+                for _i, start, rows, chunk in IngestPump(
+                        raw, plan.chunk_rows, devices=devices):
+                    out[start:start + rows] = np.asarray(binner(chunk))
+            dt = _time.perf_counter() - t0
+            rps = round(n / max(dt, 1e-9), 1)
+            ING.record_ingest_story(
+                path="kernel", elected_by=plan.elected_by, rows=n,
+                chunk_rows=plan.chunk_rows, tile_rows=plan.tile_rows,
+                bin_seconds=round(dt, 4), bin_rows_per_sec=rps,
+                parity_probe=True)
+            from .obs.metrics import global_registry
+            global_registry.counter("ingest_rows_total").inc(n)
+            global_registry.gauge("bin_rows_per_sec").set(rps)
+            return True
+        except Exception as e:    # lowering/OOM/backend loss — any of it
+            out[:] = 0            # the host fold assumes zero-init
+            ING.demote(f"{type(e).__name__}: {str(e)[:200]}")
+            self._ingest = {}
+            return False
 
     # -- streaming construction (reference: LGBM_DatasetCreateFromSampledColumn
     #    + LGBM_DatasetPushRows / PushRowsByCSR, c_api.h:98-144) -------------
@@ -639,10 +744,13 @@ class Dataset:
                     (rows, self.num_groups), store.dtype)
             out = self._spill_scratch[:rows]
             out[:] = 0
-            self._bin_block(raw, sp, out)
+            if not self._maybe_device_bin(raw, sp, out):
+                self._bin_block(raw, sp, out)
             store.append_rows(out)
         else:
-            self._bin_block(raw, sp, self.binned[start_row:start_row + rows])
+            out = self.binned[start_row:start_row + rows]
+            if not self._maybe_device_bin(raw, sp, out):
+                self._bin_block(raw, sp, out)
         self._pushed[start_row:start_row + rows] = True
         self._append_cursor = max(self._append_cursor, start_row + rows)
         if self._pushed.all():                   # auto-finish like the C API

@@ -432,3 +432,61 @@ def predict_utilization_table(device_forest, rows: int = 200_000,
         except Exception as e:  # unsupported variant on this backend
             out[name] = {"error": str(e)[:160]}
     return out
+
+
+def ingest_utilization_table(dataset, raw: "np.ndarray", reps: int = 2,
+                             tile_rows: Optional[int] = None) -> dict:
+    """Measured utilization table for the ingest family (ops/ingest.py):
+    the bucketize+pack kernel per tile-ladder rung -> ``measure_program``
+    dicts over one real raw block, plus a wall-clock ``host`` row (the
+    NumPy ``_bin_block`` oracle at the same shape) so the kernel-vs-host
+    speedup is read straight off the table — the number behind the
+    ``ingest_probe`` bench stage and the ``bin_rows_per_sec`` telemetry
+    gauge.  ``dataset`` must be constructed (or sample-fitted) so its
+    bin mappers and EFB layout exist; a rung unsupported on the backend
+    reports ``{"error": ...}`` instead of failing the table.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import ingest as ING
+    from ..ops.planner import INGEST_TILES
+
+    tables = ING.build_ingest_tables(dataset)
+    X = np.ascontiguousarray(np.asarray(raw), dtype=np.float32)
+    n = int(X.shape[0])
+    device = None
+    try:
+        device = jax.devices()[0]
+    except Exception:
+        pass
+    out = {"rows": n, "features": int(tables.num_features),
+           "num_groups": int(tables.num_groups),
+           "out_dtype": str(tables.out_dtype)}
+    ladder = ((int(tile_rows),) if tile_rows else INGEST_TILES)
+    Xd = jnp.asarray(X)
+    for tile in ladder:
+        binner = ING.DeviceBinner(tables, tile)
+        try:
+            out[f"kernel/t{tile}"] = measure_program(
+                binner._call, (Xd,), reps=reps, device=device)
+        except Exception as e:  # unsupported rung on this backend
+            out[f"kernel/t{tile}"] = {"error": str(e)[:160]}
+    # the host oracle at the same shape: wall clock only (no compiler
+    # cost model exists for NumPy) — the denominator of the speedup
+    ref = np.zeros((n, tables.num_groups), tables.out_dtype)
+    dataset._bin_block(X.astype(np.float64), None, ref)   # warm caches
+    t0 = time.perf_counter()
+    for _ in range(max(reps, 1)):
+        dataset._bin_block(X.astype(np.float64), None, ref)
+    sec = (time.perf_counter() - t0) / max(reps, 1)
+    out["host"] = {"seconds_per_call": sec}
+    best = min((v["seconds_per_call"] for k, v in out.items()
+                if k.startswith("kernel/") and isinstance(v, dict)
+                and "seconds_per_call" in v), default=None)
+    if best:
+        out["best_kernel_seconds_per_call"] = best
+        out["kernel_speedup_vs_host"] = round(sec / max(best, 1e-12), 3)
+        out["bin_rows_per_sec"] = round(n / max(best, 1e-12), 1)
+    return out

@@ -50,6 +50,7 @@ OVERLAP_EFFICIENCY_FLOOR = 1.05   # pump gain below this = no overlap
 STRAGGLER_SKEW_MATERIAL = 1.15    # slowest / fastest slice
 MFU_HEALTHY_FLOOR = 0.01          # below this the chip is mostly idle
 CONTENTION_EVENTS_MATERIAL = 1    # >= this many throttles+pauses fires
+BIN_FRACTION_MATERIAL = 0.5       # bin_seconds / train_seconds
 
 
 @dataclass
@@ -142,6 +143,13 @@ def collect_signals(registry=None, stages: Optional[dict] = None) -> dict:
                         if isinstance(v, dict)), default=0.0)
             if best:
                 sig.setdefault("mfu_measured_best", best)
+        sig.setdefault("bin_seconds", _num(full.get("bin_seconds")))
+        sig.setdefault("bin_rows_per_sec",
+                       _num(full.get("bin_rows_per_sec")))
+    ip = stages.get("ingest_probe")
+    if isinstance(ip, dict):
+        sig.setdefault("ingest_kernel_speedup",
+                       _num(ip.get("kernel_speedup_vs_host")))
     sp = stages.get("stream_probe")
     if isinstance(sp, dict):
         sig.setdefault("overlap_efficiency",
@@ -168,6 +176,15 @@ def collect_signals(registry=None, stages: Optional[dict] = None) -> dict:
         al = autotune_last()
         if al:
             sig["autotune_last"] = al
+    except Exception:  # noqa: BLE001
+        pass
+    # the ingest election's last outcome (ops/ingest.py): the input-bound
+    # verdict names whether binning ran on the kernel or fell back + why
+    try:
+        from ..ops.ingest import ingest_last
+        il = ingest_last()
+        if il:
+            sig["ingest_last"] = il
     except Exception:  # noqa: BLE001
         pass
     return sig
@@ -237,6 +254,44 @@ def diagnose(signals: dict) -> List[Verdict]:
                  "stream_blocks_total":
                      int(_num(s.get("stream_blocks_total"))),
                  "floor": OVERLAP_EFFICIENCY_FLOOR}))
+
+    # --- input-bound (ingest flavor): binning dominates training wall
+    # clock — the verdict names its cure: whether the device ingest
+    # kernel (ops/ingest.py) was elected or fell back, and why
+    bin_s = _num(s.get("bin_seconds"))
+    train_s = _num(s.get("train_seconds"))
+    if bin_s > 0 and train_s > 0:
+        frac = bin_s / train_s
+        if frac >= BIN_FRACTION_MATERIAL:
+            il = s.get("ingest_last")
+            ev = {"bin_seconds": bin_s, "train_seconds": train_s,
+                  "fraction": round(frac, 4),
+                  "threshold": BIN_FRACTION_MATERIAL}
+            if _num(s.get("bin_rows_per_sec")):
+                ev["bin_rows_per_sec"] = _num(s.get("bin_rows_per_sec"))
+            cure = ("route construction through the device ingest kernel "
+                    "(ops/ingest.py)")
+            if isinstance(il, dict) and il:
+                ev["ingest_path"] = il.get("path")
+                if il.get("path") == "kernel":
+                    ev["ingest_elected_by"] = il.get("elected_by")
+                    cure = ("the ingest kernel DID run (elected_by="
+                            f"{il.get('elected_by')}) and binning still "
+                            "dominates: grow the chunk "
+                            "(LGBM_TPU_INGEST_CHUNK) or check H2D "
+                            "bandwidth (ingest.block_put spans)")
+                else:
+                    ev["ingest_fallback_reason"] = il.get("reason")
+                    cure = ("ingest fell back to host NumPy binning ("
+                            f"{il.get('reason', 'no election ran')}) — "
+                            "fix that, or pin LGBM_TPU_INGEST_KERNEL to "
+                            "bisect the election")
+            out.append(Verdict(
+                "input-bound", min(0.3 + 0.4 * frac, 1.0),
+                f"Dataset binning took {bin_s:.1f}s against {train_s:.1f}"
+                f"s of training ({frac:.0%}): construction is the "
+                f"bottleneck — {cure}",
+                ev))
 
     # --- straggler: one slice materially slower than its peers
     skew = _num(s.get("pod_straggler_skew"), 1.0)

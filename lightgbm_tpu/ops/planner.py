@@ -2096,3 +2096,259 @@ def plan_predict(num_trees: int, nodes_dim: int, leaves_dim: int,
         budget_bytes=budget, limit_bytes=limit, limit_source=source,
         feasible=peak <= budget, elected_by=elected_by,
         measured_variant=measured_variant, autotune_key=autotune_key)
+
+
+# ======================================================================
+# Ingest kernel + chunk election: plan_ingest.  The binning pass's
+# analogue of plan_predict — byte models answer "what chunk fits the
+# ledger remainder", the measured-timings store (an "i-..." key
+# namespace in the SAME hist_timings.json) answers "kernel or host",
+# and LGBM_TPU_INGEST_KERNEL is the bisect gate over the election.
+# ======================================================================
+
+INGEST_VARIANTS = ("kernel", "host")
+# largest device ingest chunk the election reaches for (a ladder rung)
+MAX_INGEST_CHUNK_ROWS = 1 << 21
+# bucketize+pack row-tile ladder (widest VMEM-resident tile first)
+INGEST_TILES = (2048, 1024, 512, 256)
+# past this width the unrolled per-feature kernel stops being the
+# analytic default (compile time grows with the feature loop); the env
+# pin and the measured store can still elect it
+MAX_INGEST_KERNEL_FEATURES = 1024
+
+
+def _ingest_kernel_override():
+    """LGBM_TPU_INGEST_KERNEL: pin the binning arm ("kernel" | "host"),
+    bypassing measured and analytic election (the bisect gate)."""
+    v = os.environ.get("LGBM_TPU_INGEST_KERNEL", "").strip().lower()
+    return v if v in INGEST_VARIANTS else None
+
+
+def _ingest_chunk_override():
+    """LGBM_TPU_INGEST_CHUNK: pin the device ingest chunk size."""
+    v = os.environ.get("LGBM_TPU_INGEST_CHUNK", "").strip()
+    if not v:
+        return None
+    try:
+        n = int(float(v))
+    except ValueError:
+        return None
+    return max(n, 8) if n > 0 else None
+
+
+def ingest_bucket_key(rows: int, features: int, num_groups: int,
+                      item_bytes: int) -> str:
+    """Store key of the ingest autotune family — prefixed "i-" so it
+    can never collide with the histogram or predict namespaces."""
+    return (f"i-r{bucket_rows(max(int(rows), 1))}-f{int(features)}"
+            f"-g{int(num_groups)}-u{max(int(item_bytes), 1)}")
+
+
+def record_ingest_timing(rows, features, num_groups, item_bytes,
+                         variant, seconds, params=None, path=None):
+    """Bank one measured (ingest shape-bucket, variant) timing in the
+    shared store; returns the store path or None (no store dir)."""
+    p = _autotune_path(path)
+    if not p:
+        return None
+    from ..utils.file_io import write_atomic
+    key = ingest_bucket_key(rows, features, num_groups, item_bytes)
+    with _AUTOTUNE_LOCK:
+        entries = _load_autotune_store(path)
+        slot = dict(entries.get(key) or {})
+        slot[str(variant)] = {"seconds": float(seconds),
+                              "params": dict(params or {})}
+        entries[key] = slot
+        write_atomic(p, json.dumps(
+            {"version": AUTOTUNE_STORE_VERSION, "entries": entries},
+            indent=1, sort_keys=True))
+    return p
+
+
+def measured_ingest_election(rows, features, num_groups, item_bytes,
+                             path=None):
+    """Fastest measured ingest arm for this shape bucket, or None
+    (cold).  Unknown variant names are skipped, not adopted."""
+    key = ingest_bucket_key(rows, features, num_groups, item_bytes)
+    slot = _load_autotune_store(path).get(key)
+    if not isinstance(slot, dict):
+        return None
+    best_v, best = None, None
+    for v, rec in slot.items():
+        if str(v) not in INGEST_VARIANTS:
+            continue
+        try:
+            s = float(rec["seconds"])
+        except Exception:
+            continue
+        if s > 0 and (best is None or s < best["seconds"]):
+            params = rec.get("params")
+            best_v = str(v)
+            best = {"seconds": s,
+                    "params": params if isinstance(params, dict) else {}}
+    if best_v is None:
+        return None
+    return {"key": key, "variant": best_v, **best}
+
+
+def ingest_vmem_bytes(features: int, tile_rows: int, bounds_width: int,
+                      cats_width: int, num_groups: int) -> int:
+    """Predicted VMEM bytes of one bucketize+pack grid step
+    (ops/ingest.py): the double-buffered [tile, F] f32 input window,
+    the resident boundary + category tables, the [tile, G] i32 output
+    block, and the broadcast compare plane (two transient copies).
+    Deliberately simple — the right ORDER for fits/doesn't."""
+    F = max(int(features), 1)
+    C = max(int(tile_rows), 8)
+    G = max(int(num_groups), 1)
+    W = max(int(bounds_width), int(cats_width), 1)
+    x = 2 * C * F * 4
+    tables = F * (max(int(bounds_width), 1) + max(int(cats_width), 1)) * 4
+    out = C * G * 4
+    transients = 2 * C * W * 4
+    return x + tables + out + transients
+
+
+def plan_ingest_tile(features, bounds_width, cats_width, num_groups,
+                     vmem_bytes=None):
+    """Largest ingest row tile whose VMEM prediction fits, or None when
+    no ladder rung does (the election then stays on host)."""
+    limit = int(vmem_bytes if vmem_bytes is not None else vmem_limit_bytes())
+    budget = int(limit * VMEM_HEADROOM)
+    for c in INGEST_TILES:
+        need = ingest_vmem_bytes(features, c, bounds_width, cats_width,
+                                 num_groups)
+        if need <= budget:
+            return {"tile_rows": c, "vmem_bytes": need,
+                    "vmem_limit_bytes": limit}
+    return None
+
+
+def ingest_chunk_bytes(chunk_rows: int, features: int, num_groups: int,
+                       item_bytes: int) -> int:
+    """Device bytes of one in-flight ingest chunk: the double-buffered
+    raw f32 block (the pump keeps chunk t+1 in flight while t bins),
+    the i32 kernel output, and its cast to the group dtype."""
+    c = max(int(chunk_rows), 1)
+    return c * (2 * max(int(features), 1) * 4
+                + max(int(num_groups), 1) * (4 + max(int(item_bytes), 1)))
+
+
+def elect_ingest_chunk(features: int, num_groups: int, item_bytes: int,
+                       budget: Optional[int] = None) -> int:
+    """Largest ladder rung whose in-flight chunk bytes fit the budget —
+    how 11M rows bin without a single 157 GB device_put.
+    ``LGBM_TPU_INGEST_CHUNK`` pins it outright."""
+    o = _ingest_chunk_override()
+    if o:
+        return o
+    if budget is None:
+        limit, _ = hbm_limit_bytes()
+        budget = int(limit * HEADROOM)
+    best = MIN_BUCKET_ROWS
+    c = MIN_BUCKET_ROWS
+    while c <= MAX_INGEST_CHUNK_ROWS:
+        if ingest_chunk_bytes(c, features, num_groups, item_bytes) > budget:
+            break
+        best = c
+        c = bucket_rows(c + 1)
+    return int(best)
+
+
+class IngestPlan(NamedTuple):
+    """plan_ingest's verdict: binning arm, VMEM row tile, device chunk,
+    and the byte story the election ran under."""
+
+    variant: str                # "kernel" | "host"
+    tile_rows: int              # kernel VMEM row tile (0 = host)
+    chunk_rows: int             # elected device chunk (a ladder rung)
+    chunk_bytes: int            # in-flight bytes at chunk_rows
+    budget_bytes: int
+    limit_bytes: int
+    limit_source: str
+    feasible: bool
+    elected_by: str             # "env" | "measured" | "analytic"
+    measured_variant: str = ""  # store's best for this bucket ("" = cold)
+    autotune_key: str = ""      # ingest-bucket key the election ran under
+
+    def summary(self) -> dict:
+        """JSON-friendly form for bench journals / telemetry."""
+        return {
+            "variant": self.variant,
+            "tile_rows": self.tile_rows,
+            "chunk_rows": self.chunk_rows,
+            "chunk_bytes": self.chunk_bytes,
+            "budget_bytes": self.budget_bytes,
+            "hbm_limit_bytes": self.limit_bytes,
+            "limit_source": self.limit_source,
+            "feasible": self.feasible,
+            "elected_by": self.elected_by,
+            "measured_variant": self.measured_variant,
+            "autotune_key": self.autotune_key,
+        }
+
+
+def plan_ingest(rows: int, features: int, num_groups: int,
+                item_bytes: int = 1, bounds_width: int = 1,
+                cats_width: int = 1, ledger=None,
+                accel: Optional[bool] = None,
+                vmem_bytes: Optional[int] = None) -> IngestPlan:
+    """Elect {variant, tile_rows, chunk_rows} for one dataset's binning
+    pass.
+
+    Budget: the ledger's remaining bytes when one is leased against
+    (co-residency, PR 17), else HEADROOM x the device limit.  Variant:
+    ``LGBM_TPU_INGEST_KERNEL`` > the measured "i-..." family > analytic
+    (kernel on accelerators when its VMEM tile fits and the feature
+    width is kernel-sized, host everywhere else).
+    """
+    if accel is None:
+        from .histogram import on_accelerator
+        accel = on_accelerator()
+    limit, source = hbm_limit_bytes()
+    if ledger is not None:
+        # ledger budgets are already post-HEADROOM (plan_predict's rule)
+        limit, source = int(ledger.limit_bytes), "ledger"
+        budget = int(ledger.available_bytes())
+    else:
+        budget = int(limit * HEADROOM)
+    chunk = elect_ingest_chunk(features, num_groups, item_bytes,
+                               budget=budget)
+    if rows:
+        chunk = min(chunk, bucket_rows(rows))
+    tile = plan_ingest_tile(features, bounds_width, cats_width, num_groups,
+                            vmem_bytes=vmem_bytes)
+    analytic = "kernel" if (accel and tile is not None
+                            and features <= MAX_INGEST_KERNEL_FEATURES) \
+        else "host"
+    variant, elected_by = analytic, "analytic"
+    measured_variant, autotune_key = "", ""
+    if autotune_enabled():
+        autotune_key = ingest_bucket_key(rows or chunk, features,
+                                         num_groups, item_bytes)
+        m = measured_ingest_election(rows or chunk, features, num_groups,
+                                     item_bytes)
+        with _AUTOTUNE_LOCK:
+            if m is not None:
+                measured_variant = m["variant"]
+                variant, elected_by = measured_variant, "measured"
+                _AUTOTUNE_STATS["hits"] += 1
+                if variant != analytic:
+                    _AUTOTUNE_STATS["flips"] += 1
+            else:
+                _AUTOTUNE_STATS["misses"] += 1
+    o = _ingest_kernel_override()
+    if o is not None:
+        variant, elected_by = o, "env"
+    if variant == "kernel" and tile is None and elected_by != "env":
+        # a measured "kernel" from a bigger core must not OOM this one
+        variant = "host"
+    cb = ingest_chunk_bytes(chunk, features, num_groups, item_bytes)
+    return IngestPlan(
+        variant=variant,
+        tile_rows=(tile["tile_rows"] if tile is not None
+                   else INGEST_TILES[-1]) if variant == "kernel" else 0,
+        chunk_rows=chunk, chunk_bytes=cb,
+        budget_bytes=budget, limit_bytes=limit, limit_source=source,
+        feasible=cb <= budget, elected_by=elected_by,
+        measured_variant=measured_variant, autotune_key=autotune_key)

@@ -101,6 +101,11 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
     _f("LGBM_TPU_PREDICT_EPILOGUE", "", "predict.py",
        "'0' pins the host float64 leaf-sum epilogue (skips the device "
        "bit-exactness probe)", _PERF),
+    _f("LGBM_TPU_INGEST_KERNEL", "", "ops/planner.py",
+       "pin the device-ingest binning variant ('kernel'/'host'), "
+       "bypassing the measured + analytic election", _PERF),
+    _f("LGBM_TPU_INGEST_CHUNK", "", "ops/planner.py",
+       "force the streamed-ingest chunk size (rows)", _PERF),
     # ------------------------------------------------------ data plane
     _f("LGBM_TPU_STREAM", "", "ops/planner.py",
        "force ('1') / forbid ('0') out-of-core row-block streaming", _PERF),
@@ -271,6 +276,10 @@ FLAGS: Dict[str, EnvFlag] = {f.name: f for f in [
        "'1' skips the inference-kernel probe", _PERF),
     _f("BENCH_SKIP_BULK_SCORE", "", "bench.py",
        "'1' skips the bulk offline-scoring stage", _PERF),
+    _f("BENCH_SKIP_INGEST_PROBE", "", "bench.py",
+       "'1' skips the device-ingest binning probe", _PERF),
+    _f("BENCH_SKIP_INGEST_11M", "", "bench.py",
+       "'1' skips the streamed 11M-row ingest stage", _PERF),
 ]}
 
 

@@ -68,6 +68,12 @@ THRESHOLDS = {
     # per-device throughput are the perf-gate guards for ISSUE 19
     "predict_sec_per_mrow": ("lower", 1.25),
     "bulk_rows_per_sec_per_device": ("higher", 1.25),
+    # ingest-path numbers (ingest_probe / ingest_11m / full stages):
+    # device binning throughput and the kernel-vs-host margin are the
+    # perf-gate guards for ISSUE 20 (bin_seconds rides the existing
+    # lower-is-better rule above)
+    "bin_rows_per_sec": ("higher", 1.25),
+    "kernel_speedup_vs_host": ("higher", 1.25),
 }
 # a tiny absolute floor below which timing ratios are noise, not signal
 ABS_FLOOR = {"compile_seconds": 0.5, "bin_seconds": 0.5, "elapsed": 1.0}
